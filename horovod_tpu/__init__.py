@@ -1,0 +1,94 @@
+"""horovod_tpu — a TPU-native distributed training framework with the
+capability surface of Horovod (reference: DEKHTIARJonathan/horovod, a fork of
+horovod/horovod).
+
+Architecture (see SURVEY.md at the repo root):
+
+- A **C++ core** (``csrc/`` → ``lib/libhvd_tpu.so``) runs one background
+  thread per process that negotiates tensor readiness across ranks over a TCP
+  control plane, fuses small tensors, and executes collectives — the
+  reference's ``operations.cc``/``controller.cc`` design, rebuilt without
+  MPI/Gloo/NCCL.
+- The **host data plane** is a ring/pairwise TCP backend (reference analog:
+  ``mpi_operations.cc``/``gloo_operations.cc``) used for correctness tests,
+  CPU tensors, and DCN-crossing traffic.
+- The **TPU data plane** is XLA collectives over ICI: inside ``jit``,
+  gradients are averaged with ``psum``/``reduce_scatter`` on a
+  ``jax.sharding.Mesh`` (``horovod_tpu.ops.jax_ops``,
+  ``horovod_tpu.parallel``) — zero host round-trips, fused by XLA.
+
+Public API mirrors the reference: ``init/rank/size/...``, the five
+collectives (+ grouped, async, process-set variants), ``DistributedOptimizer``
+wrappers per framework, elastic state/run, timeline, and a ``tpurun``
+launcher.
+"""
+
+__version__ = "0.1.0"
+
+from .basics import basics as _basics
+from .exceptions import (  # noqa: F401
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from .compression import Compression  # noqa: F401
+from .ops.collective_ops import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    Sum,
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_async,
+    alltoall,
+    alltoall_async,
+    barrier,
+    broadcast,
+    broadcast_async,
+    broadcast_object,
+    grouped_allreduce,
+    grouped_allreduce_async,
+    join,
+    poll,
+    reducescatter,
+    reducescatter_async,
+    synchronize,
+)
+from .process_sets import (  # noqa: F401
+    ProcessSet,
+    add_process_set,
+    global_process_set,
+    remove_process_set,
+)
+
+init = _basics.init
+shutdown = _basics.shutdown
+is_initialized = _basics.is_initialized
+rank = _basics.rank
+size = _basics.size
+local_rank = _basics.local_rank
+local_size = _basics.local_size
+cross_rank = _basics.cross_rank
+cross_size = _basics.cross_size
+mpi_threads_supported = _basics.mpi_threads_supported
+nccl_built = _basics.nccl_built
+
+
+def mpi_built():
+    return False
+
+
+def gloo_built():
+    return False
+
+
+def tpu_built():
+    """True when a TPU backend is available to JAX in this process."""
+    try:
+        import jax
+
+        return any(d.platform.startswith(("tpu", "axon")) for d in jax.devices())
+    except Exception:
+        return False

@@ -1,0 +1,172 @@
+"""ctypes binding to the native core (libhvd_tpu.so).
+
+TPU-native counterpart of the reference's ``horovod/common/basics.py``
+(``HorovodBasics``): loads the shared library, declares the C API signatures,
+and exposes the process-control surface (init/rank/size/...). The collective
+wrappers live in :mod:`horovod_tpu.ops.collective_ops`.
+
+The native library is built from ``horovod_tpu/csrc`` by ``make`` (driven by
+setup.py); as a dev convenience we rebuild on import when sources are newer
+than the binary.
+"""
+
+import ctypes
+import os
+import subprocess
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_PKG_DIR, "lib", "libhvd_tpu.so")
+_CSRC_DIR = os.path.join(_PKG_DIR, "csrc")
+
+
+def _maybe_build():
+    if os.path.isdir(_CSRC_DIR):
+        srcs = [
+            os.path.join(_CSRC_DIR, f)
+            for f in os.listdir(_CSRC_DIR)
+            if f.endswith((".cc", ".h", "Makefile"))
+        ]
+        if srcs:
+            newest = max(os.path.getmtime(f) for f in srcs)
+            if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < newest:
+                subprocess.run(
+                    ["make", "-s"], cwd=_CSRC_DIR, check=True,
+                    stdout=subprocess.DEVNULL,
+                )
+    if not os.path.exists(_LIB_PATH):
+        raise ImportError(
+            f"native core not found at {_LIB_PATH}; run `make` in {_CSRC_DIR}"
+        )
+
+
+_maybe_build()
+_lib = ctypes.CDLL(_LIB_PATH)
+
+c_int = ctypes.c_int
+c_int64 = ctypes.c_int64
+c_double = ctypes.c_double
+c_char_p = ctypes.c_char_p
+c_void_p = ctypes.c_void_p
+P_int64 = ctypes.POINTER(c_int64)
+
+_lib.hvd_init.restype = c_int
+_lib.hvd_shutdown.restype = c_int
+_lib.hvd_is_initialized.restype = c_int
+_lib.hvd_rank.restype = c_int
+_lib.hvd_size.restype = c_int
+_lib.hvd_local_rank.restype = c_int
+_lib.hvd_local_size.restype = c_int
+_lib.hvd_cross_rank.restype = c_int
+_lib.hvd_cross_size.restype = c_int
+_lib.hvd_last_error.restype = c_char_p
+_lib.hvd_mpi_threads_supported.restype = c_int
+_lib.hvd_nccl_built.restype = c_int
+
+_lib.hvd_allreduce_async.restype = c_int
+_lib.hvd_allreduce_async.argtypes = [
+    c_char_p, c_void_p, c_void_p, P_int64, c_int, c_int, c_int,
+    c_double, c_double, c_int, c_int, c_int,
+]
+_lib.hvd_allgather_async.restype = c_int
+_lib.hvd_allgather_async.argtypes = [c_char_p, c_void_p, P_int64, c_int, c_int, c_int]
+_lib.hvd_broadcast_async.restype = c_int
+_lib.hvd_broadcast_async.argtypes = [
+    c_char_p, c_void_p, c_void_p, P_int64, c_int, c_int, c_int, c_int,
+]
+_lib.hvd_alltoall_async.restype = c_int
+_lib.hvd_alltoall_async.argtypes = [
+    c_char_p, c_void_p, P_int64, c_int, c_int, P_int64, c_int, c_int,
+]
+_lib.hvd_reducescatter_async.restype = c_int
+_lib.hvd_reducescatter_async.argtypes = [
+    c_char_p, c_void_p, P_int64, c_int, c_int, c_int, c_double, c_double, c_int,
+]
+_lib.hvd_join_async.restype = c_int
+_lib.hvd_join_async.argtypes = [c_char_p, c_int]
+_lib.hvd_barrier_async.restype = c_int
+_lib.hvd_barrier_async.argtypes = [c_char_p, c_int]
+_lib.hvd_add_process_set_async.restype = c_int
+_lib.hvd_add_process_set_async.argtypes = [c_char_p, P_int64, c_int]
+_lib.hvd_remove_process_set_async.restype = c_int
+_lib.hvd_remove_process_set_async.argtypes = [c_char_p, c_int]
+
+_lib.hvd_poll.restype = c_int
+_lib.hvd_poll.argtypes = [c_int]
+_lib.hvd_wait.restype = c_int
+_lib.hvd_wait.argtypes = [c_int]
+_lib.hvd_output_ndim.restype = c_int
+_lib.hvd_output_ndim.argtypes = [c_int]
+_lib.hvd_output_shape.restype = c_int
+_lib.hvd_output_shape.argtypes = [c_int, P_int64]
+_lib.hvd_output_ptr.restype = c_void_p
+_lib.hvd_output_ptr.argtypes = [c_int]
+_lib.hvd_output_meta.restype = c_int
+_lib.hvd_output_meta.argtypes = [c_int, P_int64]
+_lib.hvd_handle_extra.restype = c_int
+_lib.hvd_handle_extra.argtypes = [c_int]
+_lib.hvd_release.argtypes = [c_int]
+_lib.hvd_process_set_size.restype = c_int
+_lib.hvd_process_set_size.argtypes = [c_int]
+_lib.hvd_process_set_rank.restype = c_int
+_lib.hvd_process_set_rank.argtypes = [c_int]
+_lib.hvd_process_set_members.restype = c_int
+_lib.hvd_process_set_members.argtypes = [c_int, P_int64]
+
+
+def last_error():
+    e = _lib.hvd_last_error()
+    return e.decode() if e else ""
+
+
+class HorovodBasics:
+    """Process-control API (reference: HorovodBasics in common/basics.py)."""
+
+    def __init__(self):
+        self.lib = _lib
+
+    def init(self):
+        rc = _lib.hvd_init()
+        if rc < 0:
+            raise RuntimeError(f"horovod_tpu init failed: {last_error()}")
+        return rc
+
+    def shutdown(self):
+        return _lib.hvd_shutdown()
+
+    def is_initialized(self):
+        return bool(_lib.hvd_is_initialized())
+
+    def rank(self):
+        return _check_init(_lib.hvd_rank())
+
+    def size(self):
+        return _check_init(_lib.hvd_size())
+
+    def local_rank(self):
+        return _check_init(_lib.hvd_local_rank())
+
+    def local_size(self):
+        return _check_init(_lib.hvd_local_size())
+
+    def cross_rank(self):
+        return _check_init(_lib.hvd_cross_rank())
+
+    def cross_size(self):
+        return _check_init(_lib.hvd_cross_size())
+
+    def mpi_threads_supported(self):
+        return bool(_lib.hvd_mpi_threads_supported())
+
+    def nccl_built(self):
+        return bool(_lib.hvd_nccl_built())
+
+
+def _check_init(v):
+    if v < 0:
+        raise ValueError(
+            "horovod_tpu has not been initialized; call horovod_tpu.init() first"
+        )
+    return v
+
+
+basics = HorovodBasics()

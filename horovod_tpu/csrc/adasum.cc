@@ -1,0 +1,178 @@
+#include "adasum.h"
+
+#include <cmath>
+#include <cstring>
+#include <stdexcept>
+
+#include "reduce.h"
+
+namespace hvd {
+
+namespace {
+
+// Partial dot products over a piece: out[0] += a·b, out[1] += a·a,
+// out[2] += b·b (accumulated in double regardless of dtype).
+template <typename T>
+void DotsTyped(const T* a, const T* b, int64_t n, double* out) {
+  double ab = 0, aa = 0, bb = 0;
+  for (int64_t i = 0; i < n; i++) {
+    double x = (double)a[i], y = (double)b[i];
+    ab += x * y;
+    aa += x * x;
+    bb += y * y;
+  }
+  out[0] += ab;
+  out[1] += aa;
+  out[2] += bb;
+}
+
+template <float (*ToF)(uint16_t)>
+void Dots16(const uint16_t* a, const uint16_t* b, int64_t n, double* out) {
+  double ab = 0, aa = 0, bb = 0;
+  for (int64_t i = 0; i < n; i++) {
+    double x = ToF(a[i]), y = ToF(b[i]);
+    ab += x * y;
+    aa += x * x;
+    bb += y * y;
+  }
+  out[0] += ab;
+  out[1] += aa;
+  out[2] += bb;
+}
+
+void Dots(const void* a, const void* b, int64_t n, DataType dtype,
+          double* out) {
+  switch (dtype) {
+    case DataType::kFloat32:
+      DotsTyped((const float*)a, (const float*)b, n, out);
+      break;
+    case DataType::kFloat64:
+      DotsTyped((const double*)a, (const double*)b, n, out);
+      break;
+    case DataType::kFloat16:
+      Dots16<half_to_float>((const uint16_t*)a, (const uint16_t*)b, n, out);
+      break;
+    case DataType::kBFloat16:
+      Dots16<bf16_to_float>((const uint16_t*)a, (const uint16_t*)b, n, out);
+      break;
+    default:
+      throw std::runtime_error("Adasum requires a floating-point dtype");
+  }
+}
+
+// a = sa*a + sb*b elementwise.
+template <typename T>
+void CombineTyped(T* a, const T* b, int64_t n, double sa, double sb) {
+  for (int64_t i = 0; i < n; i++)
+    a[i] = (T)(sa * (double)a[i] + sb * (double)b[i]);
+}
+
+template <float (*ToF)(uint16_t), uint16_t (*FromF)(float)>
+void Combine16(uint16_t* a, const uint16_t* b, int64_t n, double sa,
+               double sb) {
+  for (int64_t i = 0; i < n; i++)
+    a[i] = FromF((float)(sa * ToF(a[i]) + sb * ToF(b[i])));
+}
+
+void Combine(void* a, const void* b, int64_t n, DataType dtype, double sa,
+             double sb) {
+  switch (dtype) {
+    case DataType::kFloat32:
+      CombineTyped((float*)a, (const float*)b, n, sa, sb);
+      break;
+    case DataType::kFloat64:
+      CombineTyped((double*)a, (const double*)b, n, sa, sb);
+      break;
+    case DataType::kFloat16:
+      Combine16<half_to_float, float_to_half>((uint16_t*)a, (const uint16_t*)b,
+                                              n, sa, sb);
+      break;
+    case DataType::kBFloat16:
+      Combine16<bf16_to_float, float_to_bf16>((uint16_t*)a,
+                                              (const uint16_t*)b, n, sa, sb);
+      break;
+    default:
+      throw std::runtime_error("Adasum requires a floating-point dtype");
+  }
+}
+
+}  // namespace
+
+void AdasumAllreduce(DataPlane& dp, void* buf, int64_t nelem, DataType dtype,
+                     const std::vector<int32_t>& members) {
+  int m = (int)members.size();
+  if (m <= 1) return;
+  if (m & (m - 1))
+    throw std::runtime_error(
+        "Adasum requires a power-of-two number of ranks (got " +
+        std::to_string(m) + ")");
+  int my_idx = -1;
+  for (int i = 0; i < m; i++)
+    if (members[i] == dp.rank()) my_idx = i;
+  if (my_idx < 0) throw std::runtime_error("rank not in adasum process set");
+
+  size_t esz = DataTypeSize(dtype);
+  uint8_t* p = (uint8_t*)buf;
+  std::vector<uint8_t> tmp((size_t)((nelem + 1) / 2) * esz);
+
+  // Piece tracked as [start, len) element range of buf; identical for both
+  // ranks of each pair at every level.
+  int64_t start = 0, len = nelem;
+  struct Level {
+    int64_t start, len;  // parent range
+    bool kept_left;
+  };
+  std::vector<Level> stack;
+
+  // Vector-halving distance-doubling (reduce phase).
+  for (int dist = 1; dist < m; dist <<= 1) {
+    int partner = my_idx ^ dist;
+    Socket& ps = dp.peer(members[partner]);
+    int64_t mid = len / 2;
+    bool keep_left = (my_idx & dist) == 0;
+    int64_t kstart = keep_left ? start : start + mid;   // kept piece
+    int64_t klen = keep_left ? mid : len - mid;
+    int64_t sstart = keep_left ? start + mid : start;   // sent piece
+    int64_t slen = keep_left ? len - mid : mid;
+
+    // Exchange: send my other half, receive partner's piece covering my kept
+    // range. (Partner keeps the opposite half, so it sends exactly my range.)
+    dp.FullDuplex(ps, p + sstart * esz, (size_t)slen * esz, ps,
+                        tmp.data(), (size_t)klen * esz);
+
+    // Dot products over the full aggregate pair: partial dots from every rank
+    // in the 2*dist block, reduced with a small ring allreduce of 3 doubles.
+    double dots[3] = {0, 0, 0};
+    Dots(p + kstart * esz, tmp.data(), klen, dtype, dots);
+    int block_base = my_idx & ~(2 * dist - 1);
+    std::vector<int32_t> block;
+    for (int i = 0; i < 2 * dist; i++) block.push_back(members[block_base + i]);
+    dp.RingAllreduce(dots, 3, DataType::kFloat64, ReduceOp::kSum, block);
+
+    double ab = dots[0], aa = dots[1], bb = dots[2];
+    double sa = aa > 0 ? 1.0 - ab / (2.0 * aa) : 1.0;
+    double sb = bb > 0 ? 1.0 - ab / (2.0 * bb) : 1.0;
+    Combine(p + kstart * esz, tmp.data(), klen, dtype, sa, sb);
+
+    stack.push_back({start, len, keep_left});
+    start = kstart;
+    len = klen;
+  }
+
+  // Distance-halving allgather (reassembly phase).
+  for (int dist = m >> 1; dist >= 1; dist >>= 1) {
+    Level lv = stack.back();
+    stack.pop_back();
+    int partner = my_idx ^ dist;
+    Socket& ps = dp.peer(members[partner]);
+    int64_t mid = lv.len / 2;
+    int64_t ostart = lv.kept_left ? lv.start + mid : lv.start;  // other piece
+    int64_t olen = lv.kept_left ? lv.len - mid : mid;
+    dp.FullDuplex(ps, p + start * esz, (size_t)len * esz, ps,
+                        p + ostart * esz, (size_t)olen * esz);
+    start = lv.start;
+    len = lv.len;
+  }
+}
+
+}  // namespace hvd

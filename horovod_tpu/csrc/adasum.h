@@ -1,0 +1,28 @@
+// adasum.h — Adasum adaptive-summation reduction (vector-halving
+// distance-doubling).
+//
+// TPU-native reimplementation of the reference's Adasum operator
+// (horovod/common/ops/adasum/adasum.h, ops/adasum_mpi_operations.cc —
+// `AdasumMPI`, VHDD): at each doubling distance, paired ranks exchange vector
+// halves, the dot products a·b, ‖a‖², ‖b‖² are reduced over the block of
+// ranks holding pieces of the same aggregate pair, and the pieces combine as
+//   adasum(a, b) = (1 − a·b / 2‖a‖²)·a + (1 − a·b / 2‖b‖²)·b,
+// which is scale-invariant (orthogonal gradients add, parallel gradients
+// average). A distance-halving allgather reassembles the full vector.
+#pragma once
+
+#include <cstdint>
+#include <vector>
+
+#include "collectives.h"
+#include "common.h"
+
+namespace hvd {
+
+// In-place adasum allreduce of buf (nelem elements of dtype) over `members`
+// (sorted global ranks including the caller). Requires |members| to be a
+// power of two (matches the reference's VHDD constraint); throws otherwise.
+void AdasumAllreduce(DataPlane& dp, void* buf, int64_t nelem, DataType dtype,
+                     const std::vector<int32_t>& members);
+
+}  // namespace hvd

@@ -1,0 +1,235 @@
+#include "collectives.h"
+
+#include <fcntl.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <string.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdlib>
+#include <stdexcept>
+
+#include "reduce.h"
+
+namespace hvd {
+
+namespace {
+
+int IndexOf(const std::vector<int32_t>& members, int rank) {
+  for (size_t i = 0; i < members.size(); i++)
+    if (members[i] == rank) return (int)i;
+  throw std::runtime_error("rank not in process set members");
+}
+
+// Even-ish split of nelem into m chunks (remainder spread over the first
+// chunks), matching the reference's fusion-chunk layout.
+std::vector<int64_t> SplitChunks(int64_t nelem, int m) {
+  std::vector<int64_t> lens(m, nelem / m);
+  for (int i = 0; i < (int)(nelem % m); i++) lens[i]++;
+  return lens;
+}
+
+std::vector<int64_t> Offsets(const std::vector<int64_t>& lens) {
+  std::vector<int64_t> off(lens.size() + 1, 0);
+  for (size_t i = 0; i < lens.size(); i++) off[i + 1] = off[i] + lens[i];
+  return off;
+}
+
+void SetNonBlocking(int fd, bool on) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  if (on)
+    fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+  else
+    fcntl(fd, F_SETFL, flags & ~O_NONBLOCK);
+}
+
+}  // namespace
+
+void DataPlane::FullDuplex(Socket& to, const void* sbuf, size_t sn,
+                           Socket& from, void* rbuf, size_t rn) {
+  const uint8_t* sp = (const uint8_t*)sbuf;
+  uint8_t* rp = (uint8_t*)rbuf;
+  size_t sent = 0, recvd = 0;
+  bool same = to.fd() == from.fd();
+  SetNonBlocking(to.fd(), true);
+  if (!same) SetNonBlocking(from.fd(), true);
+  try {
+    while (sent < sn || recvd < rn) {
+      pollfd fds[2];
+      int nfds = 0;
+      if (same) {
+        fds[0] = {to.fd(), 0, 0};
+        if (sent < sn) fds[0].events |= POLLOUT;
+        if (recvd < rn) fds[0].events |= POLLIN;
+        nfds = 1;
+      } else {
+        if (sent < sn) fds[nfds++] = {to.fd(), POLLOUT, 0};
+        if (recvd < rn) fds[nfds++] = {from.fd(), POLLIN, 0};
+      }
+      int rc = ::poll(fds, nfds, 30000);
+      if (rc < 0) {
+        if (errno == EINTR) continue;
+        throw std::runtime_error("poll failed");
+      }
+      if (rc == 0) throw std::runtime_error("data-plane poll timeout (30s)");
+      for (int i = 0; i < nfds; i++) {
+        if ((fds[i].revents & (POLLERR | POLLHUP | POLLNVAL)) &&
+            !(fds[i].revents & (POLLIN | POLLOUT)))
+          throw std::runtime_error("data-plane peer failed");
+        if ((fds[i].revents & POLLOUT) && sent < sn) {
+          ssize_t k = ::send(to.fd(), sp + sent, sn - sent, MSG_NOSIGNAL);
+          if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+            throw std::runtime_error("data-plane send failed");
+          if (k > 0) sent += (size_t)k;
+        }
+        if ((fds[i].revents & POLLIN) && recvd < rn) {
+          ssize_t k = ::recv(from.fd(), rp + recvd, rn - recvd, 0);
+          if (k == 0) throw std::runtime_error("data-plane peer closed");
+          if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+            throw std::runtime_error("data-plane recv failed");
+          if (k > 0) recvd += (size_t)k;
+        }
+      }
+    }
+  } catch (...) {
+    SetNonBlocking(to.fd(), false);
+    if (!same) SetNonBlocking(from.fd(), false);
+    throw;
+  }
+  SetNonBlocking(to.fd(), false);
+  if (!same) SetNonBlocking(from.fd(), false);
+}
+
+void DataPlane::RingAllreduce(void* buf, int64_t nelem, DataType dtype,
+                              ReduceOp op, const std::vector<int32_t>& members) {
+  int m = (int)members.size();
+  if (m <= 1 || nelem == 0) return;
+  int my = IndexOf(members, rank_);
+  Socket& next = peer(members[(my + 1) % m]);
+  Socket& prev = peer(members[(my - 1 + m) % m]);
+  size_t esz = DataTypeSize(dtype);
+  auto lens = SplitChunks(nelem, m);
+  auto off = Offsets(lens);
+  int64_t max_len = *std::max_element(lens.begin(), lens.end());
+  std::vector<uint8_t> tmp((size_t)max_len * esz);
+  uint8_t* p = (uint8_t*)buf;
+
+  // Phase 1: reduce-scatter. After m-1 steps, member i owns the complete
+  // reduction of chunk (i+1) mod m.
+  for (int s = 0; s < m - 1; s++) {
+    int sc = ((my - s) % m + m) % m;
+    int rc = ((my - s - 1) % m + m) % m;
+    FullDuplex(next, p + off[sc] * esz, (size_t)lens[sc] * esz, prev, tmp.data(),
+               (size_t)lens[rc] * esz);
+    Accumulate(p + off[rc] * esz, tmp.data(), lens[rc], dtype, op);
+  }
+  // Phase 2: allgather of completed chunks.
+  for (int s = 0; s < m - 1; s++) {
+    int sc = ((my + 1 - s) % m + m) % m;
+    int rc = ((my - s) % m + m) % m;
+    FullDuplex(next, p + off[sc] * esz, (size_t)lens[sc] * esz, prev,
+               p + off[rc] * esz, (size_t)lens[rc] * esz);
+  }
+}
+
+void DataPlane::RingAllgatherv(const void* my_data, void* out,
+                               const std::vector<int64_t>& bytes_per_member,
+                               const std::vector<int32_t>& members) {
+  int m = (int)members.size();
+  auto off = Offsets(bytes_per_member);
+  int my = IndexOf(members, rank_);
+  uint8_t* o = (uint8_t*)out;
+  // Place own contribution.
+  if (bytes_per_member[my] > 0 && my_data != o + off[my])
+    memcpy(o + off[my], my_data, (size_t)bytes_per_member[my]);
+  if (m <= 1) return;
+  Socket& next = peer(members[(my + 1) % m]);
+  Socket& prev = peer(members[(my - 1 + m) % m]);
+  // Ring: at step s, forward chunk (my - s) and receive chunk (my - s - 1).
+  for (int s = 0; s < m - 1; s++) {
+    int sc = ((my - s) % m + m) % m;
+    int rc = ((my - s - 1) % m + m) % m;
+    FullDuplex(next, o + off[sc], (size_t)bytes_per_member[sc], prev,
+               o + off[rc], (size_t)bytes_per_member[rc]);
+  }
+}
+
+void DataPlane::Broadcast(void* buf, int64_t nbytes, int root_idx,
+                          const std::vector<int32_t>& members) {
+  int m = (int)members.size();
+  if (m <= 1 || nbytes == 0) return;
+  int my = IndexOf(members, rank_);
+  int vr = (my - root_idx + m) % m;  // rank relative to root
+  int mask = 1;
+  while (mask < m) {
+    if (vr & mask) {
+      int src = ((vr - mask + root_idx) % m + m) % m;
+      peer(members[src]).RecvAll(buf, (size_t)nbytes);
+      break;
+    }
+    mask <<= 1;
+  }
+  mask >>= 1;
+  while (mask > 0) {
+    if (vr + mask < m && !(vr & mask)) {
+      int dst = (vr + mask + root_idx) % m;
+      peer(members[dst]).SendAll(buf, (size_t)nbytes);
+    }
+    mask >>= 1;
+  }
+}
+
+void DataPlane::AlltoAllv(const void* send,
+                          const std::vector<int64_t>& send_bytes, void* out,
+                          const std::vector<int64_t>& recv_bytes,
+                          const std::vector<int32_t>& members) {
+  int m = (int)members.size();
+  auto soff = Offsets(send_bytes);
+  auto roff = Offsets(recv_bytes);
+  int my = IndexOf(members, rank_);
+  const uint8_t* s = (const uint8_t*)send;
+  uint8_t* o = (uint8_t*)out;
+  // Self chunk.
+  if (send_bytes[my] > 0) memcpy(o + roff[my], s + soff[my], (size_t)send_bytes[my]);
+  // Pairwise exchange with increasing offset.
+  for (int k = 1; k < m; k++) {
+    int to_idx = (my + k) % m;
+    int from_idx = (my - k + m) % m;
+    FullDuplex(peer(members[to_idx]), s + soff[to_idx],
+               (size_t)send_bytes[to_idx], peer(members[from_idx]),
+               o + roff[from_idx], (size_t)recv_bytes[from_idx]);
+  }
+}
+
+void DataPlane::RingReduceScatter(void* work, void* out,
+                                  const std::vector<int64_t>& chunk_elems,
+                                  DataType dtype, ReduceOp op,
+                                  const std::vector<int32_t>& members) {
+  int m = (int)members.size();
+  int my = IndexOf(members, rank_);
+  size_t esz = DataTypeSize(dtype);
+  auto off = Offsets(chunk_elems);
+  uint8_t* p = (uint8_t*)work;
+  if (m == 1) {
+    if (chunk_elems[0] > 0) memcpy(out, p, (size_t)chunk_elems[0] * esz);
+    return;
+  }
+  Socket& next = peer(members[(my + 1) % m]);
+  Socket& prev = peer(members[(my - 1 + m) % m]);
+  int64_t max_len = *std::max_element(chunk_elems.begin(), chunk_elems.end());
+  std::vector<uint8_t> tmp((size_t)max_len * esz);
+  // Shifted reduce-scatter so member i finishes owning chunk i: at step s,
+  // send chunk (i - s - 1) and reduce into chunk (i - s - 2).
+  for (int s = 0; s < m - 1; s++) {
+    int sc = ((my - s - 1) % m + m) % m;
+    int rc = ((my - s - 2) % m + m) % m;
+    FullDuplex(next, p + off[sc] * esz, (size_t)chunk_elems[sc] * esz, prev,
+               tmp.data(), (size_t)chunk_elems[rc] * esz);
+    Accumulate(p + off[rc] * esz, tmp.data(), chunk_elems[rc], dtype, op);
+  }
+  if (chunk_elems[my] > 0)
+    memcpy(out, p + off[my] * esz, (size_t)chunk_elems[my] * esz);
+}
+
+}  // namespace hvd

@@ -1,0 +1,78 @@
+// collectives.h — CPU/TCP reference data plane: ring + pairwise collectives
+// over a full mesh of sockets between ranks.
+//
+// This is the TPU build's analog of the reference's CPU backends
+// (horovod/common/ops/mpi_operations.cc, gloo_operations.cc): a baseline
+// data plane that works with zero accelerators, used for correctness tests
+// and as the DCN fallback. The TPU-ICI data plane executes as XLA collectives
+// inside jit (see horovod_tpu/ops/jax_ops.py) — by design it does not pass
+// through these host buffers.
+#pragma once
+
+#include <vector>
+
+#include "common.h"
+#include "tcp.h"
+
+namespace hvd {
+
+// Full-mesh data-plane connections. peer(r) is a connected socket to global
+// rank r (invalid for self). Only the background thread touches these, and
+// every rank executes responses in the same order, so streams stay aligned.
+class DataPlane {
+ public:
+  DataPlane() = default;
+  void Init(int rank, int size, std::vector<Socket> peers) {
+    rank_ = rank;
+    size_ = size;
+    peers_ = std::move(peers);
+  }
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+  Socket& peer(int r) { return peers_[r]; }
+
+  // In-place ring allreduce over `members` (sorted global ranks incl. self).
+  // buf holds nelem elements of dtype; op applied elementwise.
+  void RingAllreduce(void* buf, int64_t nelem, DataType dtype, ReduceOp op,
+                     const std::vector<int32_t>& members);
+
+  // Ring allgatherv: each member i contributes bytes_per_member[i] bytes; the
+  // concatenation (in member order) lands in out on every member. my_data is
+  // this rank's contribution.
+  void RingAllgatherv(const void* my_data, void* out,
+                      const std::vector<int64_t>& bytes_per_member,
+                      const std::vector<int32_t>& members);
+
+  // Binomial-tree broadcast of nbytes from members[root_idx].
+  void Broadcast(void* buf, int64_t nbytes, int root_idx,
+                 const std::vector<int32_t>& members);
+
+  // Pairwise alltoallv: send_bytes[j] bytes from send buffer (packed in member
+  // order) to member j; receive recv_bytes[j] from member j into out (packed
+  // in member order).
+  void AlltoAllv(const void* send, const std::vector<int64_t>& send_bytes,
+                 void* out, const std::vector<int64_t>& recv_bytes,
+                 const std::vector<int32_t>& members);
+
+  // Ring reduce-scatter: input has nelem = sum(chunk_elems) elements; after
+  // the call, out holds this member's reduced chunk (chunk_elems[my_idx]).
+  // Scratch-free variant: operates on a copy the caller provides in `work`.
+  void RingReduceScatter(void* work, void* out,
+                         const std::vector<int64_t>& chunk_elems,
+                         DataType dtype, ReduceOp op,
+                         const std::vector<int32_t>& members);
+
+  // Simultaneously send sn bytes to `to` and receive rn bytes from `from`
+  // without deadlocking (poll-driven full duplex). Public for Adasum's
+  // pairwise exchanges.
+  void FullDuplex(Socket& to, const void* sbuf, size_t sn, Socket& from,
+                  void* rbuf, size_t rn);
+
+ private:
+  int rank_ = 0;
+  int size_ = 1;
+  std::vector<Socket> peers_;
+};
+
+}  // namespace hvd

@@ -1,0 +1,326 @@
+// common.h — shared types for the horovod_tpu native core.
+//
+// TPU-native re-design of the reference core's message/type layer
+// (reference: horovod/common/common.h, horovod/common/message.h —
+// Request/Response/DataType). Hand-rolled little-endian wire format instead
+// of FlatBuffers (no vendored third_party in this build).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+#include <stdexcept>
+
+namespace hvd {
+
+// ---------------------------------------------------------------------------
+// Data types (mirrors reference DataType in horovod/common/message.h)
+enum class DataType : uint8_t {
+  kUInt8 = 0,
+  kInt8 = 1,
+  kInt32 = 2,
+  kInt64 = 3,
+  kFloat16 = 4,
+  kFloat32 = 5,
+  kFloat64 = 6,
+  kBool = 7,
+  kBFloat16 = 8,
+};
+
+inline size_t DataTypeSize(DataType t) {
+  switch (t) {
+    case DataType::kUInt8:
+    case DataType::kInt8:
+    case DataType::kBool:
+      return 1;
+    case DataType::kFloat16:
+    case DataType::kBFloat16:
+      return 2;
+    case DataType::kInt32:
+    case DataType::kFloat32:
+      return 4;
+    case DataType::kInt64:
+    case DataType::kFloat64:
+      return 8;
+  }
+  return 0;
+}
+
+inline const char* DataTypeName(DataType t) {
+  switch (t) {
+    case DataType::kUInt8: return "uint8";
+    case DataType::kInt8: return "int8";
+    case DataType::kInt32: return "int32";
+    case DataType::kInt64: return "int64";
+    case DataType::kFloat16: return "float16";
+    case DataType::kFloat32: return "float32";
+    case DataType::kFloat64: return "float64";
+    case DataType::kBool: return "bool";
+    case DataType::kBFloat16: return "bfloat16";
+  }
+  return "?";
+}
+
+// Reduction ops (reference: ReduceOp in horovod/common/message.h + Adasum flag)
+enum class ReduceOp : uint8_t {
+  kSum = 0,
+  kAverage = 1,
+  kMin = 2,
+  kMax = 3,
+  kProduct = 4,
+  kAdasum = 5,
+};
+
+// Collective kinds (reference: Request::RequestType)
+enum class OpType : uint8_t {
+  kAllreduce = 0,
+  kAllgather = 1,
+  kBroadcast = 2,
+  kAlltoall = 3,
+  kReducescatter = 4,
+  kJoin = 5,
+  kBarrier = 6,
+  kAddProcessSet = 7,
+  kRemoveProcessSet = 8,
+};
+
+// Status codes surfaced through the C API.
+enum class StatusCode : uint8_t {
+  kOk = 0,
+  kInProgress = 1,
+  kAborted = 2,       // shutdown while pending -> HorovodInternalError in Python
+  kInvalid = 3,
+  kUnknownError = 4,
+};
+
+struct Status {
+  StatusCode code = StatusCode::kOk;
+  std::string reason;
+  static Status Ok() { return Status{}; }
+  static Status Error(const std::string& r) {
+    return Status{StatusCode::kUnknownError, r};
+  }
+  static Status Aborted(const std::string& r) {
+    return Status{StatusCode::kAborted, r};
+  }
+  bool ok() const { return code == StatusCode::kOk; }
+};
+
+// ---------------------------------------------------------------------------
+// Wire serialization: little-endian, length-prefixed frames.
+class Writer {
+ public:
+  std::vector<uint8_t> buf;
+  void u8(uint8_t v) { buf.push_back(v); }
+  void u32(uint32_t v) { append(&v, 4); }
+  void i32(int32_t v) { append(&v, 4); }
+  void u64(uint64_t v) { append(&v, 8); }
+  void i64(int64_t v) { append(&v, 8); }
+  void f64(double v) { append(&v, 8); }
+  void str(const std::string& s) {
+    u32((uint32_t)s.size());
+    append(s.data(), s.size());
+  }
+  void i64vec(const std::vector<int64_t>& v) {
+    u32((uint32_t)v.size());
+    for (auto x : v) i64(x);
+  }
+ private:
+  void append(const void* p, size_t n) {
+    const uint8_t* b = (const uint8_t*)p;
+    buf.insert(buf.end(), b, b + n);
+  }
+};
+
+class Reader {
+ public:
+  Reader(const uint8_t* data, size_t len) : p_(data), end_(data + len) {}
+  uint8_t u8() { return *take(1); }
+  uint32_t u32() { uint32_t v; memcpy(&v, take(4), 4); return v; }
+  int32_t i32() { int32_t v; memcpy(&v, take(4), 4); return v; }
+  uint64_t u64() { uint64_t v; memcpy(&v, take(8), 8); return v; }
+  int64_t i64() { int64_t v; memcpy(&v, take(8), 8); return v; }
+  double f64() { double v; memcpy(&v, take(8), 8); return v; }
+  std::string str() {
+    uint32_t n = u32();
+    const uint8_t* s = take(n);
+    return std::string((const char*)s, n);
+  }
+  std::vector<int64_t> i64vec() {
+    uint32_t n = u32();
+    std::vector<int64_t> v(n);
+    for (uint32_t i = 0; i < n; i++) v[i] = i64();
+    return v;
+  }
+ private:
+  const uint8_t* take(size_t n) {
+    if (p_ + n > end_) throw std::runtime_error("wire: truncated message");
+    const uint8_t* r = p_;
+    p_ += n;
+    return r;
+  }
+  const uint8_t* p_;
+  const uint8_t* end_;
+};
+
+// ---------------------------------------------------------------------------
+// Negotiation messages (reference: Request/Response in message.cc).
+// A Request announces "this rank's tensor is ready". The coordinator tallies
+// Requests from all ranks of the tensor's process set and emits a Response.
+struct Request {
+  OpType op_type = OpType::kAllreduce;
+  int32_t rank = 0;
+  std::string name;
+  DataType dtype = DataType::kFloat32;
+  ReduceOp red_op = ReduceOp::kSum;
+  int32_t root = 0;          // broadcast
+  int32_t process_set = 0;
+  int32_t group_id = -1;     // grouped collectives; -1 = ungrouped
+  int32_t group_size = 0;    // number of tensors in the group
+  double prescale = 1.0;
+  double postscale = 1.0;
+  std::vector<int64_t> shape;     // this rank's shape
+  std::vector<int64_t> splits;    // alltoall send splits (rows per dest rank)
+
+  void serialize(Writer& w) const {
+    w.u8((uint8_t)op_type);
+    w.i32(rank);
+    w.str(name);
+    w.u8((uint8_t)dtype);
+    w.u8((uint8_t)red_op);
+    w.i32(root);
+    w.i32(process_set);
+    w.i32(group_id);
+    w.i32(group_size);
+    w.f64(prescale);
+    w.f64(postscale);
+    w.i64vec(shape);
+    w.i64vec(splits);
+  }
+  static Request deserialize(Reader& r) {
+    Request q;
+    q.op_type = (OpType)r.u8();
+    q.rank = r.i32();
+    q.name = r.str();
+    q.dtype = (DataType)r.u8();
+    q.red_op = (ReduceOp)r.u8();
+    q.root = r.i32();
+    q.process_set = r.i32();
+    q.group_id = r.i32();
+    q.group_size = r.i32();
+    q.prescale = r.f64();
+    q.postscale = r.f64();
+    q.shape = r.i64vec();
+    q.splits = r.i64vec();
+    return q;
+  }
+};
+
+// A RequestList is what each rank sends the coordinator every cycle.
+struct RequestList {
+  std::vector<Request> requests;
+  bool shutdown = false;
+
+  void serialize(Writer& w) const {
+    w.u8(shutdown ? 1 : 0);
+    w.u32((uint32_t)requests.size());
+    for (auto& q : requests) q.serialize(w);
+  }
+  static RequestList deserialize(Reader& r) {
+    RequestList l;
+    l.shutdown = r.u8() != 0;
+    uint32_t n = r.u32();
+    l.requests.reserve(n);
+    for (uint32_t i = 0; i < n; i++) l.requests.push_back(Request::deserialize(r));
+    return l;
+  }
+};
+
+// A Response instructs every rank to execute one (possibly fused) collective.
+struct Response {
+  OpType op_type = OpType::kAllreduce;
+  std::vector<std::string> names;  // >1 => fused
+  DataType dtype = DataType::kFloat32;
+  ReduceOp red_op = ReduceOp::kSum;
+  int32_t root = 0;
+  int32_t process_set = 0;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  std::string error;  // non-empty => deliver error to these tensors
+  // Per-tensor, per-set-member metadata the executor needs:
+  //  - allgather: first-dim size contributed by each member, per tensor
+  //  - alltoall: flattened [member][dest] row-splits matrix, per tensor
+  //  - broadcast/allreduce fused: element counts per tensor (from root/any)
+  std::vector<std::vector<int64_t>> per_rank_meta;
+  std::vector<std::vector<int64_t>> shapes;  // canonical shape per tensor
+  int32_t new_process_set_id = -1;           // AddProcessSet result
+
+  void serialize(Writer& w) const {
+    w.u8((uint8_t)op_type);
+    w.u32((uint32_t)names.size());
+    for (auto& n : names) w.str(n);
+    w.u8((uint8_t)dtype);
+    w.u8((uint8_t)red_op);
+    w.i32(root);
+    w.i32(process_set);
+    w.f64(prescale);
+    w.f64(postscale);
+    w.str(error);
+    w.u32((uint32_t)per_rank_meta.size());
+    for (auto& v : per_rank_meta) w.i64vec(v);
+    w.u32((uint32_t)shapes.size());
+    for (auto& v : shapes) w.i64vec(v);
+    w.i32(new_process_set_id);
+  }
+  static Response deserialize(Reader& r) {
+    Response s;
+    s.op_type = (OpType)r.u8();
+    uint32_t n = r.u32();
+    s.names.reserve(n);
+    for (uint32_t i = 0; i < n; i++) s.names.push_back(r.str());
+    s.dtype = (DataType)r.u8();
+    s.red_op = (ReduceOp)r.u8();
+    s.root = r.i32();
+    s.process_set = r.i32();
+    s.prescale = r.f64();
+    s.postscale = r.f64();
+    s.error = r.str();
+    uint32_t m = r.u32();
+    s.per_rank_meta.resize(m);
+    for (uint32_t i = 0; i < m; i++) s.per_rank_meta[i] = r.i64vec();
+    uint32_t k = r.u32();
+    s.shapes.resize(k);
+    for (uint32_t i = 0; i < k; i++) s.shapes[i] = r.i64vec();
+    s.new_process_set_id = r.i32();
+    return s;
+  }
+};
+
+struct ResponseList {
+  std::vector<Response> responses;
+  bool shutdown = false;
+
+  void serialize(Writer& w) const {
+    w.u8(shutdown ? 1 : 0);
+    w.u32((uint32_t)responses.size());
+    for (auto& s : responses) s.serialize(w);
+  }
+  static ResponseList deserialize(Reader& r) {
+    ResponseList l;
+    l.shutdown = r.u8() != 0;
+    uint32_t n = r.u32();
+    l.responses.reserve(n);
+    for (uint32_t i = 0; i < n; i++)
+      l.responses.push_back(Response::deserialize(r));
+    return l;
+  }
+};
+
+inline int64_t NumElements(const std::vector<int64_t>& shape) {
+  int64_t n = 1;
+  for (auto d : shape) n *= d;
+  return n;
+}
+
+}  // namespace hvd

@@ -1,0 +1,339 @@
+#include "controller.h"
+
+#include <algorithm>
+
+#include "timeline.h"
+
+namespace hvd {
+
+// ---------------------------------------------------------------------------
+// StallInspector
+
+bool StallInspector::Check(
+    const std::unordered_map<std::string, std::map<int32_t, Request>>& table,
+    const ProcessSetTable& process_sets, int64_t now_us) {
+  bool shutdown = false;
+  for (auto& kv : table) {
+    const std::string& key = kv.first;
+    const std::string& name = kv.second.begin()->second.name;
+    auto it = first_seen_.find(key);
+    if (it == first_seen_.end()) {
+      first_seen_[key] = now_us;
+      continue;
+    }
+    double age = (now_us - it->second) / 1e6;
+    if (age > warn_sec_) {
+      auto& lw = last_warned_[key];
+      if ((now_us - lw) / 1e6 > warn_sec_) {
+        lw = now_us;
+        int ps = kv.second.begin()->second.process_set;
+        std::string present, missing;
+        if (process_sets.Contains(ps)) {
+          for (int32_t r : process_sets.Members(ps)) {
+            if (kv.second.count(r))
+              present += std::to_string(r) + " ";
+            else
+              missing += std::to_string(r) + " ";
+          }
+        }
+        fprintf(stderr,
+                "[horovod_tpu] WARNING: potential stall: tensor '%s' was "
+                "submitted by ranks [ %s] but NOT by ranks [ %s] for %.0f s. "
+                "Collectives must be submitted by every rank of the process "
+                "set in the same order.\n",
+                name.c_str(), present.c_str(), missing.c_str(), age);
+      }
+    }
+    if (shutdown_sec_ > 0 && age > shutdown_sec_) shutdown = true;
+  }
+  // Drop trackers for names no longer pending.
+  for (auto it = first_seen_.begin(); it != first_seen_.end();) {
+    if (!table.count(it->first)) {
+      last_warned_.erase(it->first);
+      it = first_seen_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  return shutdown;
+}
+
+// ---------------------------------------------------------------------------
+// Coordinator
+
+namespace {
+
+std::string ShapeStr(const std::vector<int64_t>& s) {
+  std::string out = "(";
+  for (size_t i = 0; i < s.size(); i++) {
+    if (i) out += ",";
+    out += std::to_string(s[i]);
+  }
+  return out + ")";
+}
+
+}  // namespace
+
+Response Coordinator::BuildResponse(const std::string& name,
+                                    std::map<int32_t, Request>& per_rank) {
+  Response resp;
+  const Request& first = per_rank.begin()->second;
+  resp.op_type = first.op_type;
+  resp.names = {name};
+  resp.dtype = first.dtype;
+  resp.red_op = first.red_op;
+  resp.root = first.root;
+  resp.process_set = first.process_set;
+  resp.prescale = first.prescale;
+  resp.postscale = first.postscale;
+
+  auto error = [&](const std::string& msg) {
+    resp.error = msg;
+    return resp;
+  };
+
+  // Consistency validation across ranks (reference: ConstructResponse checks
+  // in controller.cc).
+  for (auto& kv : per_rank) {
+    const Request& q = kv.second;
+    if (q.op_type != OpType::kAddProcessSet &&
+        q.op_type != OpType::kRemoveProcessSet &&
+        process_sets_->Contains(q.process_set) &&
+        process_sets_->RankIn(q.process_set, q.rank) < 0)
+      return error("rank " + std::to_string(q.rank) +
+                   " submitted tensor " + name +
+                   " but is not a member of process set " +
+                   std::to_string(q.process_set));
+    if (q.op_type != first.op_type)
+      return error("mismatched collective type for tensor " + name);
+    if (q.dtype != first.dtype)
+      return error("mismatched dtype for tensor " + name + ": rank " +
+                   std::to_string(q.rank) + " has " + DataTypeName(q.dtype) +
+                   ", expected " + DataTypeName(first.dtype));
+    if (q.red_op != first.red_op)
+      return error("mismatched reduce op for tensor " + name);
+    if (q.root != first.root)
+      return error("mismatched root rank for tensor " + name);
+  }
+
+  switch (first.op_type) {
+    case OpType::kAllreduce:
+    case OpType::kReducescatter:
+    case OpType::kBroadcast: {
+      // Shapes must match exactly. For broadcast the root's shape is
+      // canonical; others may submit an empty shape meaning "unknown".
+      std::vector<int64_t> canon = first.shape;
+      if (first.op_type == OpType::kBroadcast) {
+        auto root_it = per_rank.find(first.root);
+        if (root_it == per_rank.end())
+          return error("broadcast root not in process set for " + name);
+        canon = root_it->second.shape;
+      }
+      for (auto& kv : per_rank) {
+        const Request& q = kv.second;
+        if (first.op_type == OpType::kBroadcast && q.shape.empty()) continue;
+        if (q.shape != canon)
+          return error("mismatched shape for tensor " + name + ": rank " +
+                       std::to_string(q.rank) + " has " + ShapeStr(q.shape) +
+                       ", expected " + ShapeStr(canon));
+      }
+      resp.shapes = {canon};
+      break;
+    }
+    case OpType::kAllgather: {
+      // dim0 may differ per rank; trailing dims must match.
+      const auto& members = process_sets_->Members(first.process_set);
+      std::vector<int64_t> dim0(members.size(), 0);
+      for (auto& kv : per_rank) {
+        const Request& q = kv.second;
+        if (q.shape.empty())
+          return error("allgather requires rank >= 1 tensors: " + name);
+        if (q.shape.size() != first.shape.size() ||
+            !std::equal(q.shape.begin() + 1, q.shape.end(),
+                        first.shape.begin() + 1))
+          return error("mismatched trailing dims for allgather " + name);
+        int idx = process_sets_->RankIn(first.process_set, q.rank);
+        dim0[idx] = q.shape[0];
+      }
+      resp.per_rank_meta = {dim0};
+      resp.shapes = {first.shape};
+      break;
+    }
+    case OpType::kAlltoall: {
+      const auto& members = process_sets_->Members(first.process_set);
+      size_t m = members.size();
+      // Flattened [src_idx * m + dst_idx] row-count matrix.
+      std::vector<int64_t> matrix(m * m, 0);
+      for (auto& kv : per_rank) {
+        const Request& q = kv.second;
+        if (q.splits.size() != m)
+          return error("alltoall splits length != process set size for " +
+                       name);
+        int64_t total = 0;
+        for (auto s : q.splits) total += s;
+        int64_t dim0 = q.shape.empty() ? 0 : q.shape[0];
+        if (total != dim0)
+          return error("alltoall splits sum != dim0 for " + name);
+        int idx = process_sets_->RankIn(first.process_set, q.rank);
+        for (size_t j = 0; j < m; j++) matrix[idx * m + j] = q.splits[j];
+      }
+      resp.per_rank_meta = {matrix};
+      resp.shapes = {first.shape};
+      break;
+    }
+    case OpType::kJoin:
+    case OpType::kBarrier:
+      resp.shapes = {{}};
+      break;
+    case OpType::kAddProcessSet: {
+      // splits carries the requested global ranks; all ranks must agree.
+      for (auto& kv : per_rank) {
+        if (kv.second.splits != first.splits)
+          return error("add_process_set: rank lists disagree");
+      }
+      std::vector<int32_t> ranks(first.splits.begin(), first.splits.end());
+      resp.new_process_set_id = process_sets_->Add(ranks);
+      // Carry the member list so every rank can mirror the table.
+      resp.per_rank_meta = {first.splits};
+      break;
+    }
+    case OpType::kRemoveProcessSet: {
+      if (!process_sets_->Remove(first.root))
+        return error("remove_process_set: unknown or global set " +
+                     std::to_string(first.root));
+      resp.new_process_set_id = first.root;
+      break;
+    }
+  }
+  return resp;
+}
+
+void Coordinator::Fuse(std::vector<Response>& ready, ResponseList& out) {
+  // Groups must be emitted atomically; grouped tensors were already held back
+  // until complete, and arrive here adjacent. Fuse consecutive compatible
+  // allreduces under the threshold (reference: FuseResponses).
+  size_t i = 0;
+  while (i < ready.size()) {
+    Response& r = ready[i];
+    if (r.op_type != OpType::kAllreduce || !r.error.empty()) {
+      out.responses.push_back(std::move(r));
+      i++;
+      continue;
+    }
+    int64_t esz = (int64_t)DataTypeSize(r.dtype);
+    int64_t bytes = NumElements(r.shapes[0]) * esz;
+    size_t j = i + 1;
+    while (j < ready.size()) {
+      Response& n = ready[j];
+      if (n.op_type != OpType::kAllreduce || !n.error.empty() ||
+          n.dtype != r.dtype || n.red_op != r.red_op ||
+          n.process_set != r.process_set || n.prescale != r.prescale ||
+          n.postscale != r.postscale)
+        break;
+      int64_t nbytes = NumElements(n.shapes[0]) * esz;
+      if (bytes + nbytes > fusion_threshold_) break;
+      bytes += nbytes;
+      r.names.push_back(n.names[0]);
+      r.shapes.push_back(n.shapes[0]);
+      j++;
+    }
+    out.responses.push_back(std::move(r));
+    i = j;
+  }
+}
+
+ResponseList Coordinator::Update(std::vector<RequestList>& lists,
+                                 bool* all_shutdown) {
+  // Negotiation is keyed by (process set, name): the same tensor name may be
+  // legitimately in flight in disjoint process sets at once (the reference
+  // keeps per-process-set controller state for the same reason).
+  for (size_t r = 0; r < lists.size(); r++) {
+    if (lists[r].shutdown) shutdown_ranks_.insert((int32_t)r);
+    for (auto& req : lists[r].requests) {
+      std::string key = std::to_string(req.process_set) + "\x01" + req.name;
+      if (!message_table_.count(key)) arrival_order_.push_back(key);
+      message_table_[key][req.rank] = req;
+    }
+  }
+
+  // Collect tensors reported by every member of their process set, preserving
+  // arrival order.
+  std::vector<Response> ready;
+  std::vector<std::string> still_pending;
+
+  for (auto& key : arrival_order_) {
+    auto it = message_table_.find(key);
+    if (it == message_table_.end()) continue;  // already handled
+    auto& per_rank = it->second;
+    const Request& first = per_rank.begin()->second;
+    int required;
+    if (first.op_type == OpType::kAddProcessSet ||
+        first.op_type == OpType::kRemoveProcessSet) {
+      required = size_;  // global collectives
+    } else if (!process_sets_->Contains(first.process_set)) {
+      Response err;
+      err.op_type = first.op_type;
+      err.names = {first.name};
+      err.process_set = first.process_set;  // so ranks can match their entry
+      err.error = "unknown process set " + std::to_string(first.process_set);
+      ready.push_back(err);
+      message_table_.erase(it);
+      continue;
+    } else {
+      required = process_sets_->Size(first.process_set);
+    }
+    if ((int)per_rank.size() < required) {
+      still_pending.push_back(key);
+      continue;
+    }
+    Response resp = BuildResponse(first.name, per_rank);
+    stall_.OnReady(key);
+    int32_t gid = first.group_id;
+    int32_t gsize = first.group_size;
+    message_table_.erase(it);
+    if (gid >= 0) {
+      if (!pending_group_sizes_.count(gid)) pending_group_sizes_[gid] = gsize;
+      if (resp.error.empty()) {
+        pending_groups_[gid].push_back(std::move(resp));
+      } else {
+        // Deliver the error immediately and shrink the group's expected
+        // count so its healthy members are not stranded forever.
+        ready.push_back(std::move(resp));
+        pending_group_sizes_[gid]--;
+      }
+    } else {
+      ready.push_back(std::move(resp));
+    }
+  }
+  arrival_order_ = std::move(still_pending);
+
+  // Release groups whose member tensors are all ready on all ranks
+  // (reference: group_table.cc atomic-group negotiation).
+  for (auto it = pending_groups_.begin(); it != pending_groups_.end();) {
+    if ((int32_t)it->second.size() >= pending_group_sizes_[it->first]) {
+      for (auto& r : it->second) ready.push_back(std::move(r));
+      pending_group_sizes_.erase(it->first);
+      it = pending_groups_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  // Groups whose members all errored leave a zero count behind; drop it.
+  for (auto it = pending_group_sizes_.begin();
+       it != pending_group_sizes_.end();) {
+    if (it->second <= 0 && !pending_groups_.count(it->first))
+      it = pending_group_sizes_.erase(it);
+    else
+      ++it;
+  }
+
+  stall_.Check(message_table_, *process_sets_, NowUs());
+
+  ResponseList out;
+  Fuse(ready, out);
+  *all_shutdown = (int)shutdown_ranks_.size() >= size_;
+  out.shutdown = *all_shutdown;
+  return out;
+}
+
+}  // namespace hvd

@@ -1,0 +1,146 @@
+// controller.h — rank-0 coordinator: tensor-readiness negotiation, response
+// fusion, process-set registry, stall inspection.
+//
+// TPU-native redesign of the reference's Controller
+// (horovod/common/controller.cc `ComputeResponseList`/`FuseResponses`,
+// mpi_controller.cc / gloo_controller.cc) with a TCP control plane instead of
+// MPI/Gloo: every cycle each rank ships its RequestList to rank 0, which
+// tallies readiness per process set, fuses ready tensors under the fusion
+// threshold, and broadcasts an ordered ResponseList all ranks execute
+// identically. Also hosts the StallInspector
+// (horovod/common/stall_inspector.cc) and the process-set table
+// (horovod/common/process_set.cc).
+#pragma once
+
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+
+namespace hvd {
+
+// Process sets: id -> sorted global ranks. Id 0 is the global set. Kept in
+// sync on every rank by applying coordinator responses in order. Mutated by
+// the background thread; read by frontend threads (process-set queries) —
+// all access is mutex-guarded, and Members() returns a copy.
+class ProcessSetTable {
+ public:
+  void InitGlobal(int size) {
+    std::lock_guard<std::mutex> l(mu_);
+    std::vector<int32_t> all(size);
+    for (int i = 0; i < size; i++) all[i] = i;
+    sets_[0] = all;
+    next_id_ = 1;
+  }
+  int Add(const std::vector<int32_t>& ranks) {
+    std::lock_guard<std::mutex> l(mu_);
+    int id = next_id_++;
+    sets_[id] = ranks;
+    return id;
+  }
+  void AddWithId(int id, const std::vector<int32_t>& ranks) {
+    std::lock_guard<std::mutex> l(mu_);
+    sets_[id] = ranks;
+    if (id >= next_id_) next_id_ = id + 1;
+  }
+  bool Remove(int id) {
+    if (id == 0) return false;
+    std::lock_guard<std::mutex> l(mu_);
+    return sets_.erase(id) > 0;
+  }
+  bool Contains(int id) const {
+    std::lock_guard<std::mutex> l(mu_);
+    return sets_.count(id) > 0;
+  }
+  std::vector<int32_t> Members(int id) const {
+    std::lock_guard<std::mutex> l(mu_);
+    return sets_.at(id);
+  }
+  int Size(int id) const {
+    std::lock_guard<std::mutex> l(mu_);
+    return (int)sets_.at(id).size();
+  }
+  int RankIn(int id, int global_rank) const {
+    std::lock_guard<std::mutex> l(mu_);
+    auto& m = sets_.at(id);
+    for (size_t i = 0; i < m.size(); i++)
+      if (m[i] == global_rank) return (int)i;
+    return -1;
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::map<int32_t, std::vector<int32_t>> sets_;
+  int next_id_ = 1;
+};
+
+// Warns when some ranks submitted a tensor and others have not for too long —
+// the classic collective deadlock (reference: stall_inspector.cc).
+class StallInspector {
+ public:
+  void Configure(double warn_sec, double shutdown_sec) {
+    warn_sec_ = warn_sec;
+    shutdown_sec_ = shutdown_sec;
+  }
+  // Called by the coordinator each cycle with the partially-ready table.
+  // Returns true if the stall exceeded the shutdown threshold.
+  bool Check(
+      const std::unordered_map<std::string, std::map<int32_t, Request>>& table,
+      const ProcessSetTable& process_sets, int64_t now_us);
+  void OnReady(const std::string& name) { first_seen_.erase(name); }
+
+ private:
+  double warn_sec_ = 60.0;
+  double shutdown_sec_ = -1.0;  // <0 => never shut down
+  std::unordered_map<std::string, int64_t> first_seen_;
+  std::unordered_map<std::string, int64_t> last_warned_;
+};
+
+// Coordinator bookkeeping that runs on rank 0 only.
+class Coordinator {
+ public:
+  // `process_sets` is shared with GlobalState: the coordinator reads it for
+  // readiness counts and writes newly-created sets; every rank (including 0)
+  // additionally applies set changes when executing the response, which is
+  // idempotent on rank 0.
+  void Init(int size, int64_t fusion_threshold_bytes,
+            ProcessSetTable* process_sets) {
+    size_ = size;
+    fusion_threshold_ = fusion_threshold_bytes;
+    process_sets_ = process_sets;
+  }
+
+  StallInspector& stall() { return stall_; }
+
+  // Ingest one cycle's worth of RequestLists (index = global rank; rank 0's
+  // own list included). Returns the ordered, fused ResponseList every rank
+  // must execute, and sets *all_shutdown when every rank has requested
+  // shutdown.
+  ResponseList Update(std::vector<RequestList>& lists, bool* all_shutdown);
+
+ private:
+  Response BuildResponse(const std::string& name,
+                         std::map<int32_t, Request>& per_rank);
+  void Fuse(std::vector<Response>& ready, ResponseList& out);
+
+  int size_ = 1;
+  int64_t fusion_threshold_ = 64 * 1024 * 1024;
+  // name -> (global rank -> request)
+  std::unordered_map<std::string, std::map<int32_t, Request>> message_table_;
+  // FIFO of names in arrival order (determinism of response ordering).
+  std::vector<std::string> arrival_order_;
+  std::set<int32_t> shutdown_ranks_;
+  ProcessSetTable* process_sets_ = nullptr;
+  StallInspector stall_;
+  // Grouped collectives staged until every member tensor of the group is
+  // ready on every rank (reference: group_table.cc).
+  std::map<int32_t, std::vector<Response>> pending_groups_;
+  std::map<int32_t, int32_t> pending_group_sizes_;
+};
+
+}  // namespace hvd

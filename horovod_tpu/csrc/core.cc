@@ -1,0 +1,843 @@
+// core.cc — per-process runtime: global state, init rendezvous, the
+// background negotiation/execution thread, and the C API exported to Python.
+//
+// TPU-native redesign of the reference's horovod/common/operations.cc
+// (`horovod_init`, `BackgroundThreadLoop`, `RunLoopOnce`, `PerformOperation`,
+// `EnqueueTensorAllreduce` et al.) and global_state.h (`HorovodGlobalState`).
+// The architecture is preserved — frontend threads enqueue, one background
+// thread per process negotiates readiness and executes fused collectives —
+// while the control plane is hand-rolled TCP (no MPI/Gloo) and the host data
+// plane is the ring/pairwise TCP backend in collectives.cc. On TPU the hot
+// data path runs as XLA collectives inside jit (horovod_tpu/ops/jax_ops.py);
+// this core carries the out-of-graph path, gradient negotiation for the
+// eager/hook APIs, and all coordination subsystems (fusion, timeline, stall
+// inspection, process sets, elastic error propagation).
+#include <atomic>
+#include <condition_variable>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "adasum.h"
+#include "collectives.h"
+#include "common.h"
+#include "controller.h"
+#include "tcp.h"
+#include "tensor_queue.h"
+#include "timeline.h"
+#include "reduce.h"
+
+namespace hvd {
+namespace {
+
+// ---------------------------------------------------------------------------
+// Env helpers (reference: horovod/common/utils/env_parser.cc)
+
+std::string EnvStr(const char* name, const std::string& dflt) {
+  const char* v = getenv(name);
+  return v ? std::string(v) : dflt;
+}
+
+double EnvDouble(const char* name, double dflt) {
+  const char* v = getenv(name);
+  return v ? atof(v) : dflt;
+}
+
+int64_t EnvInt(const char* name, int64_t dflt) {
+  const char* v = getenv(name);
+  return v ? atoll(v) : dflt;
+}
+
+// ---------------------------------------------------------------------------
+// Handle manager (reference: horovod/torch/handle_manager.cc)
+
+struct HandleState {
+  bool done = false;
+  Status status;
+  // Core-owned output for gather-type ops (allgather/alltoall/reducescatter);
+  // exposed to Python via hvd_output_ptr, freed by hvd_release.
+  std::vector<uint8_t> out_buf;
+  std::vector<int64_t> out_shape;
+  std::vector<int64_t> out_meta;  // alltoall: received rows per member
+  DataType dtype = DataType::kFloat32;
+  int32_t extra = -1;  // e.g. new process set id
+};
+
+struct Global {
+  std::atomic<bool> initialized{false};
+  std::atomic<bool> shutdown_requested{false};
+  std::atomic<bool> dead{false};  // background thread exited
+  int rank = 0, size = 1, local_rank = 0, local_size = 1;
+  int cross_rank = 0, cross_size = 1;
+
+  TensorQueue queue;
+  DataPlane data;
+  ProcessSetTable process_sets;
+  Coordinator coordinator;  // used on rank 0 only
+  Timeline timeline;
+
+  // Control plane.
+  Socket to_coordinator;           // rank != 0
+  std::vector<Socket> workers;     // rank 0: index = rank (index 0 unused)
+  Listener control_listener;
+  Listener data_listener;
+
+  // Fusion buffer (reference: fusion_buffer_manager.cc). Background thread
+  // only; grown on demand up to max(threshold, largest fused response).
+  std::vector<uint8_t> fusion_buf;
+  int64_t fusion_threshold = 64 * 1024 * 1024;
+  double cycle_time_ms = 1.0;
+
+  std::thread background;
+
+  std::mutex handle_mu;
+  std::condition_variable handle_cv;
+  std::unordered_map<int, std::shared_ptr<HandleState>> handles;
+  int next_handle = 1;
+  std::atomic<int> joined_count{0};
+
+  std::mutex error_mu;
+  std::string last_error;
+};
+
+Global* g = nullptr;
+
+thread_local std::string tl_error;
+
+void SetError(const std::string& e) { tl_error = e; }
+
+// ---------------------------------------------------------------------------
+// Handle helpers
+
+int NewHandle() {
+  std::lock_guard<std::mutex> l(g->handle_mu);
+  int h = g->next_handle++;
+  g->handles[h] = std::make_shared<HandleState>();
+  return h;
+}
+
+std::shared_ptr<HandleState> GetHandle(int h) {
+  std::lock_guard<std::mutex> l(g->handle_mu);
+  auto it = g->handles.find(h);
+  return it == g->handles.end() ? nullptr : it->second;
+}
+
+void CompleteHandle(int h, Status s) {
+  std::lock_guard<std::mutex> l(g->handle_mu);
+  auto it = g->handles.find(h);
+  if (it != g->handles.end()) {
+    it->second->status = std::move(s);
+    it->second->done = true;
+  }
+  g->handle_cv.notify_all();
+}
+
+void hvd_release_internal(int h) {
+  std::lock_guard<std::mutex> l(g->handle_mu);
+  g->handles.erase(h);
+}
+
+// ---------------------------------------------------------------------------
+// Operation execution (reference: PerformOperation in operations.cc +
+// ops/collective_operations.cc MemcpyInFusionBuffer/MemcpyOutFusionBuffer)
+
+void EnsureFusionCapacity(int64_t bytes) {
+  if ((int64_t)g->fusion_buf.size() < bytes) g->fusion_buf.resize(bytes);
+}
+
+void FailEntries(std::vector<TensorTableEntry>& entries,
+                 const std::string& why) {
+  for (auto& e : entries) CompleteHandle(e.handle, Status::Error(why));
+}
+
+double EffectivePostscale(const Response& resp, int m) {
+  double post = resp.postscale;
+  if (resp.red_op == ReduceOp::kAverage) post /= (double)m;
+  return post;
+}
+
+void ExecAllreduce(const Response& resp,
+                   std::vector<TensorTableEntry>& entries,
+                   const std::vector<int32_t>& members) {
+  int m = (int)members.size();
+  size_t esz = DataTypeSize(resp.dtype);
+  double post = EffectivePostscale(resp, m);
+  ReduceOp ring_op =
+      resp.red_op == ReduceOp::kAverage ? ReduceOp::kSum : resp.red_op;
+
+  if (entries.size() == 1) {
+    // Unfused fast path: operate in place on the user's output buffer.
+    auto& e = entries[0];
+    int64_t n = NumElements(e.req.shape);
+    if (e.output != e.input) memcpy(e.output, e.input, (size_t)n * esz);
+    if (resp.prescale != 1.0) ScaleBuffer(e.output, n, resp.dtype, resp.prescale);
+    int64_t t0 = NowUs();
+    if (resp.red_op == ReduceOp::kAdasum)
+      AdasumAllreduce(g->data, e.output, n, resp.dtype, members);
+    else
+      g->data.RingAllreduce(e.output, n, resp.dtype, ring_op, members);
+    g->timeline.Record(e.req.name, "TCP_ALLREDUCE", t0, NowUs());
+    if (post != 1.0) ScaleBuffer(e.output, n, resp.dtype, post);
+    CompleteHandle(e.handle, Status::Ok());
+    return;
+  }
+
+  // Fused path: pack into the fusion buffer, one ring, unpack.
+  int64_t total = 0;
+  for (auto& e : entries) total += NumElements(e.req.shape);
+  EnsureFusionCapacity(total * (int64_t)esz);
+  uint8_t* fb = g->fusion_buf.data();
+  int64_t t0 = NowUs();
+  int64_t off = 0;
+  for (auto& e : entries) {
+    int64_t n = NumElements(e.req.shape);
+    memcpy(fb + off * esz, e.input, (size_t)n * esz);
+    off += n;
+  }
+  int64_t t1 = NowUs();
+  if (resp.prescale != 1.0) ScaleBuffer(fb, total, resp.dtype, resp.prescale);
+  if (resp.red_op == ReduceOp::kAdasum)
+    AdasumAllreduce(g->data, fb, total, resp.dtype, members);
+  else
+    g->data.RingAllreduce(fb, total, resp.dtype, ring_op, members);
+  int64_t t2 = NowUs();
+  if (post != 1.0) ScaleBuffer(fb, total, resp.dtype, post);
+  off = 0;
+  for (auto& e : entries) {
+    int64_t n = NumElements(e.req.shape);
+    memcpy(e.output, fb + off * esz, (size_t)n * esz);
+    off += n;
+    g->timeline.Record(e.req.name, "MEMCPY_IN_FUSION_BUFFER", t0, t1);
+    g->timeline.Record(e.req.name, "TCP_ALLREDUCE", t1, t2);
+    g->timeline.Record(e.req.name, "MEMCPY_OUT_FUSION_BUFFER", t2, NowUs());
+    CompleteHandle(e.handle, Status::Ok());
+  }
+}
+
+void ExecAllgather(const Response& resp, TensorTableEntry& e,
+                   const std::vector<int64_t>& dim0s,
+                   const std::vector<int32_t>& members) {
+  size_t esz = DataTypeSize(resp.dtype);
+  int64_t row_elems = 1;
+  for (size_t i = 1; i < e.req.shape.size(); i++) row_elems *= e.req.shape[i];
+  std::vector<int64_t> bytes(members.size());
+  int64_t total_rows = 0;
+  for (size_t i = 0; i < members.size(); i++) {
+    bytes[i] = dim0s[i] * row_elems * (int64_t)esz;
+    total_rows += dim0s[i];
+  }
+  auto hs = GetHandle(e.handle);
+  hs->out_shape = e.req.shape;
+  hs->out_shape[0] = total_rows;
+  hs->dtype = resp.dtype;
+  hs->out_buf.resize((size_t)(total_rows * row_elems) * esz);
+  int64_t t0 = NowUs();
+  g->data.RingAllgatherv(e.input, hs->out_buf.data(), bytes, members);
+  g->timeline.Record(e.req.name, "TCP_ALLGATHER", t0, NowUs());
+  CompleteHandle(e.handle, Status::Ok());
+}
+
+void ExecBroadcast(const Response& resp, TensorTableEntry& e,
+                   const std::vector<int32_t>& members) {
+  size_t esz = DataTypeSize(resp.dtype);
+  int64_t n = NumElements(resp.shapes[0]);
+  int root_idx = -1;
+  for (size_t i = 0; i < members.size(); i++)
+    if (members[i] == resp.root) root_idx = (int)i;
+  void* buf = e.output ? e.output : (void*)e.input;
+  if (g->rank == resp.root && e.output && e.output != e.input)
+    memcpy(e.output, e.input, (size_t)n * esz);
+  int64_t t0 = NowUs();
+  g->data.Broadcast(buf, n * (int64_t)esz, root_idx, members);
+  g->timeline.Record(e.req.name, "TCP_BROADCAST", t0, NowUs());
+  CompleteHandle(e.handle, Status::Ok());
+}
+
+void ExecAlltoall(const Response& resp, TensorTableEntry& e,
+                  const std::vector<int64_t>& matrix,
+                  const std::vector<int32_t>& members) {
+  size_t m = members.size();
+  size_t esz = DataTypeSize(resp.dtype);
+  int my_idx = -1;
+  for (size_t i = 0; i < m; i++)
+    if (members[i] == g->rank) my_idx = (int)i;
+  int64_t row_elems = 1;
+  for (size_t i = 1; i < e.req.shape.size(); i++) row_elems *= e.req.shape[i];
+  int64_t row_bytes = row_elems * (int64_t)esz;
+  std::vector<int64_t> send_bytes(m), recv_bytes(m);
+  int64_t recv_rows = 0;
+  for (size_t j = 0; j < m; j++) {
+    send_bytes[j] = matrix[my_idx * m + j] * row_bytes;
+    recv_bytes[j] = matrix[j * m + my_idx] * row_bytes;
+    recv_rows += matrix[j * m + my_idx];
+  }
+  auto hs = GetHandle(e.handle);
+  hs->out_shape = e.req.shape;
+  if (hs->out_shape.empty()) hs->out_shape = {0};
+  hs->out_shape[0] = recv_rows;
+  hs->dtype = resp.dtype;
+  hs->out_buf.resize((size_t)(recv_rows * row_elems) * esz);
+  hs->out_meta.resize(m);
+  for (size_t j = 0; j < m; j++) hs->out_meta[j] = matrix[j * m + my_idx];
+  int64_t t0 = NowUs();
+  g->data.AlltoAllv(e.input, send_bytes, hs->out_buf.data(), recv_bytes,
+                    members);
+  g->timeline.Record(e.req.name, "TCP_ALLTOALL", t0, NowUs());
+  CompleteHandle(e.handle, Status::Ok());
+}
+
+void ExecReducescatter(const Response& resp, TensorTableEntry& e,
+                       const std::vector<int32_t>& members) {
+  int m = (int)members.size();
+  size_t esz = DataTypeSize(resp.dtype);
+  const auto& shape = resp.shapes[0];
+  int64_t rows = shape.empty() ? 1 : shape[0];
+  int64_t row_elems = 1;
+  for (size_t i = 1; i < shape.size(); i++) row_elems *= shape[i];
+  // dim0 split: remainder rows go to the first members (reference semantics).
+  std::vector<int64_t> chunk_rows(m, rows / m);
+  for (int i = 0; i < (int)(rows % m); i++) chunk_rows[i]++;
+  std::vector<int64_t> chunk_elems(m);
+  for (int i = 0; i < m; i++) chunk_elems[i] = chunk_rows[i] * row_elems;
+  int my_idx = -1;
+  for (int i = 0; i < m; i++)
+    if (members[i] == g->rank) my_idx = i;
+
+  int64_t total = rows * row_elems;
+  EnsureFusionCapacity(total * (int64_t)esz);
+  memcpy(g->fusion_buf.data(), e.input, (size_t)total * esz);
+  if (resp.prescale != 1.0)
+    ScaleBuffer(g->fusion_buf.data(), total, resp.dtype, resp.prescale);
+
+  auto hs = GetHandle(e.handle);
+  hs->out_shape = shape;
+  if (!hs->out_shape.empty()) hs->out_shape[0] = chunk_rows[my_idx];
+  hs->dtype = resp.dtype;
+  hs->out_buf.resize((size_t)chunk_elems[my_idx] * esz);
+  ReduceOp ring_op =
+      resp.red_op == ReduceOp::kAverage ? ReduceOp::kSum : resp.red_op;
+  int64_t t0 = NowUs();
+  g->data.RingReduceScatter(g->fusion_buf.data(), hs->out_buf.data(),
+                            chunk_elems, resp.dtype, ring_op, members);
+  g->timeline.Record(e.req.name, "TCP_REDUCESCATTER", t0, NowUs());
+  double post = EffectivePostscale(resp, m);
+  if (post != 1.0)
+    ScaleBuffer(hs->out_buf.data(), chunk_elems[my_idx], resp.dtype, post);
+  CompleteHandle(e.handle, Status::Ok());
+}
+
+void PerformOperation(const Response& resp) {
+  // Process-set table updates apply on every rank (idempotent on rank 0,
+  // whose coordinator already updated the shared table).
+  if (resp.op_type == OpType::kAddProcessSet && resp.error.empty()) {
+    std::vector<int32_t> ranks;
+    for (auto r : resp.per_rank_meta[0]) ranks.push_back((int32_t)r);
+    g->process_sets.AddWithId(resp.new_process_set_id, ranks);
+  }
+  if (resp.op_type == OpType::kRemoveProcessSet && resp.error.empty())
+    g->process_sets.Remove(resp.new_process_set_id);
+
+  std::vector<TensorTableEntry> entries;
+  for (auto& name : resp.names) {
+    TensorTableEntry e;
+    if (g->queue.Take(name, resp.process_set, &e))
+      entries.push_back(std::move(e));
+  }
+  if (entries.empty()) return;  // not a participant
+
+  if (!resp.error.empty()) {
+    FailEntries(entries, resp.error);
+    return;
+  }
+  for (auto& e : entries)
+    g->timeline.Record(e.req.name, "QUEUE", e.enqueue_us, NowUs());
+
+  const auto& members = g->process_sets.Contains(resp.process_set)
+                            ? g->process_sets.Members(resp.process_set)
+                            : std::vector<int32_t>{};
+  try {
+    switch (resp.op_type) {
+      case OpType::kAllreduce:
+        ExecAllreduce(resp, entries, members);
+        break;
+      case OpType::kAllgather:
+        ExecAllgather(resp, entries[0], resp.per_rank_meta[0], members);
+        break;
+      case OpType::kBroadcast:
+        ExecBroadcast(resp, entries[0], members);
+        break;
+      case OpType::kAlltoall:
+        ExecAlltoall(resp, entries[0], resp.per_rank_meta[0], members);
+        break;
+      case OpType::kReducescatter:
+        ExecReducescatter(resp, entries[0], members);
+        break;
+      case OpType::kJoin:
+      case OpType::kBarrier:
+        for (auto& e : entries) CompleteHandle(e.handle, Status::Ok());
+        break;
+      case OpType::kAddProcessSet:
+        for (auto& e : entries) {
+          auto hs = GetHandle(e.handle);
+          if (hs) hs->extra = resp.new_process_set_id;
+          CompleteHandle(e.handle, Status::Ok());
+        }
+        break;
+      case OpType::kRemoveProcessSet:
+        for (auto& e : entries) CompleteHandle(e.handle, Status::Ok());
+        break;
+    }
+  } catch (const std::exception& ex) {
+    FailEntries(entries, std::string("collective failed: ") + ex.what());
+    throw;  // data-plane failure is fatal for the background loop
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Background thread (reference: BackgroundThreadLoop / RunLoopOnce)
+
+void FailAllPending(const std::string& why) {
+  auto entries = g->queue.DrainAll();
+  for (auto& e : entries) CompleteHandle(e.handle, Status::Aborted(why));
+}
+
+void BackgroundLoop() {
+  bool mark_cycles = EnvInt("HVD_TIMELINE_MARK_CYCLES", 0) != 0;
+  try {
+    while (true) {
+      std::this_thread::sleep_for(
+          std::chrono::duration<double, std::milli>(g->cycle_time_ms));
+      if (mark_cycles) g->timeline.Mark("CYCLE_START");
+
+      RequestList mine;
+      mine.requests = g->queue.PopRequests();
+      mine.shutdown = g->shutdown_requested.load();
+
+      ResponseList rl;
+      if (g->size == 1) {
+        // Single process: negotiate locally.
+        std::vector<RequestList> lists(1);
+        lists[0] = std::move(mine);
+        bool all_shutdown = false;
+        rl = g->coordinator.Update(lists, &all_shutdown);
+      } else if (g->rank == 0) {
+        std::vector<RequestList> lists(g->size);
+        lists[0] = std::move(mine);
+        for (int r = 1; r < g->size; r++) {
+          auto frame = g->workers[r].RecvFrame();
+          Reader rd(frame.data(), frame.size());
+          lists[r] = RequestList::deserialize(rd);
+        }
+        bool all_shutdown = false;
+        rl = g->coordinator.Update(lists, &all_shutdown);
+        Writer w;
+        rl.serialize(w);
+        for (int r = 1; r < g->size; r++) g->workers[r].SendFrame(w.buf);
+      } else {
+        Writer w;
+        mine.serialize(w);
+        g->to_coordinator.SendFrame(w.buf);
+        auto frame = g->to_coordinator.RecvFrame();
+        Reader rd(frame.data(), frame.size());
+        rl = ResponseList::deserialize(rd);
+      }
+
+      for (auto& resp : rl.responses) PerformOperation(resp);
+      if (rl.shutdown) break;
+    }
+    FailAllPending("horovod_tpu shutdown");
+  } catch (const std::exception& ex) {
+    // Control- or data-plane failure: the elastic path. Every pending and
+    // future operation fails with HorovodInternalError in Python.
+    {
+      std::lock_guard<std::mutex> l(g->error_mu);
+      g->last_error = ex.what();
+    }
+    FailAllPending(std::string("HorovodInternalError: ") + ex.what());
+    // Close every connection so peers blocked in recv unblock and fail too
+    // (the analog of the reference's ncclCommAbort on elastic failure).
+    g->to_coordinator.Close();
+    for (auto& w : g->workers) w.Close();
+    if (g->size > 1) {
+      for (int i = 0; i < g->size; i++)
+        if (i != g->rank) g->data.peer(i).Close();
+    }
+  }
+  g->dead = true;
+  g->handle_cv.notify_all();
+}
+
+// ---------------------------------------------------------------------------
+// Init rendezvous
+
+void ParseHostPort(const std::string& addr, std::string* host, int* port) {
+  auto pos = addr.rfind(':');
+  if (pos == std::string::npos)
+    throw std::runtime_error("bad address (want host:port): " + addr);
+  *host = addr.substr(0, pos);
+  *port = atoi(addr.c_str() + pos + 1);
+}
+
+void EstablishMesh() {
+  // Rendezvous: workers connect to the coordinator's control port and
+  // advertise their data-plane listener; the coordinator broadcasts the
+  // address table; then a deterministic full-mesh connect (j dials i for
+  // i < j). Reference analog: gloo_context.cc rendezvous via the launcher's
+  // HTTP KV store.
+  std::string ctrl = EnvStr("HVD_CONTROLLER_ADDR", "");
+  if (ctrl.empty())
+    throw std::runtime_error("HVD_CONTROLLER_ADDR required when size > 1");
+  std::string chost;
+  int cport = 0;
+  ParseHostPort(ctrl, &chost, &cport);
+  double timeout = EnvDouble("HVD_START_TIMEOUT", 60.0);
+
+  g->data_listener.Listen(0);
+  std::vector<std::string> hosts(g->size);
+  std::vector<int> ports(g->size);
+
+  if (g->rank == 0) {
+    g->control_listener.Listen(cport);
+    g->workers.resize(g->size);
+    hosts[0] = chost == "0.0.0.0" ? "127.0.0.1" : chost;
+    ports[0] = g->data_listener.port();
+    for (int i = 1; i < g->size; i++) {
+      Socket s = g->control_listener.Accept();
+      auto frame = s.RecvFrame();
+      Reader rd(frame.data(), frame.size());
+      int r = rd.i32();
+      int dport = rd.i32();
+      hosts[r] = PeerAddr(s);
+      ports[r] = dport;
+      g->workers[r] = std::move(s);
+    }
+    Writer w;
+    for (int i = 0; i < g->size; i++) {
+      w.str(hosts[i]);
+      w.i32(ports[i]);
+    }
+    for (int r = 1; r < g->size; r++) g->workers[r].SendFrame(w.buf);
+  } else {
+    g->to_coordinator = ConnectRetry(chost, cport, timeout);
+    Writer w;
+    w.i32(g->rank);
+    w.i32(g->data_listener.port());
+    g->to_coordinator.SendFrame(w.buf);
+    auto frame = g->to_coordinator.RecvFrame();
+    Reader rd(frame.data(), frame.size());
+    for (int i = 0; i < g->size; i++) {
+      hosts[i] = rd.str();
+      ports[i] = rd.i32();
+    }
+  }
+
+  // Full-mesh data plane.
+  std::vector<Socket> peers(g->size);
+  std::exception_ptr accept_err;
+  std::thread acceptor([&] {
+    try {
+      int expect = g->size - 1 - g->rank;
+      for (int i = 0; i < expect; i++) {
+        Socket s = g->data_listener.Accept();
+        uint32_t r = 0;
+        s.RecvAll(&r, 4);
+        peers[r] = std::move(s);
+      }
+    } catch (...) {
+      accept_err = std::current_exception();
+    }
+  });
+  for (int j = 0; j < g->rank; j++) {
+    Socket s = ConnectRetry(hosts[j], ports[j], timeout);
+    uint32_t me = (uint32_t)g->rank;
+    s.SendAll(&me, 4);
+    peers[j] = std::move(s);
+  }
+  acceptor.join();
+  if (accept_err) std::rethrow_exception(accept_err);
+  g->data.Init(g->rank, g->size, std::move(peers));
+}
+
+// ---------------------------------------------------------------------------
+// Enqueue helper
+
+int Enqueue(OpType type, const char* name, const void* input, void* output,
+            const int64_t* shape, int ndim, int dtype, int red_op, int root,
+            int process_set, int group_id, int group_size, double prescale,
+            double postscale, const int64_t* splits, int nsplits) {
+  if (!g || !g->initialized) {
+    SetError("horovod_tpu has not been initialized; call init() first");
+    return -1;
+  }
+  if (g->dead) {
+    std::lock_guard<std::mutex> l(g->error_mu);
+    SetError("HorovodInternalError: background thread dead: " + g->last_error);
+    return -1;
+  }
+  TensorTableEntry e;
+  e.req.op_type = type;
+  e.req.rank = g->rank;
+  e.req.name = name;
+  e.req.dtype = (DataType)dtype;
+  e.req.red_op = (ReduceOp)red_op;
+  e.req.root = root;
+  e.req.process_set = process_set;
+  e.req.group_id = group_id;
+  e.req.group_size = group_size;
+  e.req.prescale = prescale;
+  e.req.postscale = postscale;
+  if (shape && ndim > 0) e.req.shape.assign(shape, shape + ndim);
+  if (splits && nsplits > 0) e.req.splits.assign(splits, splits + nsplits);
+  e.input = input;
+  e.output = output;
+  int handle = NewHandle();
+  e.handle = handle;
+  e.enqueue_us = NowUs();
+  if (!g->queue.Add(std::move(e))) {
+    hvd_release_internal(handle);
+    SetError(std::string("a tensor named '") + name +
+             "' is already pending; names must be unique among in-flight "
+             "collectives");
+    return -1;
+  }
+  return handle;
+}
+
+}  // namespace
+}  // namespace hvd
+
+// ---------------------------------------------------------------------------
+// C API (reference: the C interface in horovod/common/operations.h consumed
+// by horovod/common/basics.py via ctypes)
+
+using namespace hvd;
+
+extern "C" {
+
+int hvd_init() {
+  try {
+    if (g && g->initialized) {
+      SetError("already initialized");
+      return 0;  // idempotent
+    }
+    delete g;
+    g = new Global();
+    g->rank = (int)EnvInt("HVD_RANK", 0);
+    g->size = (int)EnvInt("HVD_SIZE", 1);
+    g->local_rank = (int)EnvInt("HVD_LOCAL_RANK", g->rank);
+    g->local_size = (int)EnvInt("HVD_LOCAL_SIZE", g->size);
+    g->cross_rank = (int)EnvInt("HVD_CROSS_RANK", 0);
+    g->cross_size = (int)EnvInt("HVD_CROSS_SIZE", 1);
+    g->fusion_threshold =
+        EnvInt("HVD_FUSION_THRESHOLD", 64 * 1024 * 1024);
+    g->cycle_time_ms = EnvDouble("HVD_CYCLE_TIME_MS", 1.0);
+    g->process_sets.InitGlobal(g->size);
+    g->coordinator.Init(g->size, g->fusion_threshold, &g->process_sets);
+    g->coordinator.stall().Configure(
+        EnvDouble("HVD_STALL_CHECK_TIME_SECONDS", 60.0),
+        EnvDouble("HVD_STALL_SHUTDOWN_TIME_SECONDS", -1.0));
+    if (g->size > 1) EstablishMesh();
+    // One timeline file per job at the given path (rank 0, like the
+    // reference); other ranks append a .rankN suffix so every process can
+    // still be traced without clobbering.
+    std::string tl_path = EnvStr("HVD_TIMELINE", "");
+    if (!tl_path.empty() && g->rank != 0)
+      tl_path += ".rank" + std::to_string(g->rank);
+    g->timeline.Init(tl_path, g->rank);
+    g->initialized = true;
+    g->background = std::thread(BackgroundLoop);
+    return 1;
+  } catch (const std::exception& ex) {
+    SetError(ex.what());
+    if (g) {
+      delete g;
+      g = nullptr;
+    }
+    return -1;
+  }
+}
+
+int hvd_shutdown() {
+  if (!g || !g->initialized) return 0;
+  g->shutdown_requested = true;
+  if (g->background.joinable()) g->background.join();
+  g->timeline.Shutdown();
+  delete g;
+  g = nullptr;
+  return 1;
+}
+
+int hvd_is_initialized() { return g && g->initialized ? 1 : 0; }
+int hvd_rank() { return g ? g->rank : -1; }
+int hvd_size() { return g ? g->size : -1; }
+int hvd_local_rank() { return g ? g->local_rank : -1; }
+int hvd_local_size() { return g ? g->local_size : -1; }
+int hvd_cross_rank() { return g ? g->cross_rank : -1; }
+int hvd_cross_size() { return g ? g->cross_size : -1; }
+
+const char* hvd_last_error() { return tl_error.c_str(); }
+
+int hvd_allreduce_async(const char* name, const void* input, void* output,
+                        const int64_t* shape, int ndim, int dtype, int red_op,
+                        double prescale, double postscale, int process_set,
+                        int group_id, int group_size) {
+  return Enqueue(OpType::kAllreduce, name, input, output, shape, ndim, dtype,
+                 red_op, 0, process_set, group_id, group_size, prescale,
+                 postscale, nullptr, 0);
+}
+
+int hvd_allgather_async(const char* name, const void* input,
+                        const int64_t* shape, int ndim, int dtype,
+                        int process_set) {
+  return Enqueue(OpType::kAllgather, name, input, nullptr, shape, ndim, dtype,
+                 0, 0, process_set, -1, 0, 1.0, 1.0, nullptr, 0);
+}
+
+int hvd_broadcast_async(const char* name, const void* input, void* output,
+                        const int64_t* shape, int ndim, int dtype, int root,
+                        int process_set) {
+  return Enqueue(OpType::kBroadcast, name, input, output, shape, ndim, dtype,
+                 0, root, process_set, -1, 0, 1.0, 1.0, nullptr, 0);
+}
+
+int hvd_alltoall_async(const char* name, const void* input,
+                       const int64_t* shape, int ndim, int dtype,
+                       const int64_t* splits, int nsplits, int process_set) {
+  return Enqueue(OpType::kAlltoall, name, input, nullptr, shape, ndim, dtype,
+                 0, 0, process_set, -1, 0, 1.0, 1.0, splits, nsplits);
+}
+
+int hvd_reducescatter_async(const char* name, const void* input,
+                            const int64_t* shape, int ndim, int dtype,
+                            int red_op, double prescale, double postscale,
+                            int process_set) {
+  return Enqueue(OpType::kReducescatter, name, input, nullptr, shape, ndim,
+                 dtype, red_op, 0, process_set, -1, 0, prescale, postscale,
+                 nullptr, 0);
+}
+
+int hvd_join_async(const char* name, int process_set) {
+  return Enqueue(OpType::kJoin, name, nullptr, nullptr, nullptr, 0, 0, 0, 0,
+                 process_set, -1, 0, 1.0, 1.0, nullptr, 0);
+}
+
+int hvd_barrier_async(const char* name, int process_set) {
+  return Enqueue(OpType::kBarrier, name, nullptr, nullptr, nullptr, 0, 0, 0, 0,
+                 process_set, -1, 0, 1.0, 1.0, nullptr, 0);
+}
+
+int hvd_add_process_set_async(const char* name, const int64_t* ranks,
+                              int nranks) {
+  return Enqueue(OpType::kAddProcessSet, name, nullptr, nullptr, nullptr, 0, 0,
+                 0, 0, 0, -1, 0, 1.0, 1.0, ranks, nranks);
+}
+
+int hvd_remove_process_set_async(const char* name, int process_set_id) {
+  return Enqueue(OpType::kRemoveProcessSet, name, nullptr, nullptr, nullptr, 0,
+                 0, 0, process_set_id, 0, -1, 0, 1.0, 1.0, nullptr, 0);
+}
+
+// Poll: 0 = in progress, 1 = done ok, -1 = done with error, -2 = bad handle.
+int hvd_poll(int handle) {
+  auto hs = GetHandle(handle);
+  if (!hs) {
+    SetError("unknown handle");
+    return -2;
+  }
+  std::lock_guard<std::mutex> l(g->handle_mu);
+  if (!hs->done) return 0;
+  if (!hs->status.ok()) {
+    SetError(hs->status.reason);
+    return -1;
+  }
+  return 1;
+}
+
+// Blocking wait: 1 ok, -1 error (reason via hvd_last_error).
+int hvd_wait(int handle) {
+  auto hs = GetHandle(handle);
+  if (!hs) {
+    SetError("unknown handle");
+    return -1;
+  }
+  std::unique_lock<std::mutex> l(g->handle_mu);
+  g->handle_cv.wait(l, [&] { return hs->done || g->dead.load(); });
+  if (!hs->done) {
+    std::lock_guard<std::mutex> el(g->error_mu);
+    SetError("HorovodInternalError: " + g->last_error);
+    return -1;
+  }
+  if (!hs->status.ok()) {
+    SetError(hs->status.reason);
+    return -1;
+  }
+  return 1;
+}
+
+// Core-owned output access for gather-type ops.
+int hvd_output_ndim(int handle) {
+  auto hs = GetHandle(handle);
+  return hs ? (int)hs->out_shape.size() : -1;
+}
+
+int hvd_output_shape(int handle, int64_t* shape_out) {
+  auto hs = GetHandle(handle);
+  if (!hs) return -1;
+  for (size_t i = 0; i < hs->out_shape.size(); i++)
+    shape_out[i] = hs->out_shape[i];
+  return (int)hs->out_shape.size();
+}
+
+const void* hvd_output_ptr(int handle) {
+  auto hs = GetHandle(handle);
+  return hs ? (const void*)hs->out_buf.data() : nullptr;
+}
+
+// Pass out=null to query the length, then call again with a buffer of that
+// size (the Python wrapper does exactly this).
+int hvd_output_meta(int handle, int64_t* out) {
+  auto hs = GetHandle(handle);
+  if (!hs) return -1;
+  if (out != nullptr)
+    for (size_t i = 0; i < hs->out_meta.size(); i++) out[i] = hs->out_meta[i];
+  return (int)hs->out_meta.size();
+}
+
+int hvd_handle_extra(int handle) {
+  auto hs = GetHandle(handle);
+  return hs ? hs->extra : -1;
+}
+
+void hvd_release(int handle) {
+  if (!g) return;
+  std::lock_guard<std::mutex> l(g->handle_mu);
+  g->handles.erase(handle);
+}
+
+int hvd_process_set_size(int id) {
+  if (!g || !g->process_sets.Contains(id)) return -1;
+  return g->process_sets.Size(id);
+}
+
+int hvd_process_set_rank(int id) {
+  if (!g || !g->process_sets.Contains(id)) return -1;
+  return g->process_sets.RankIn(id, g->rank);
+}
+
+int hvd_process_set_members(int id, int64_t* out) {
+  if (!g || !g->process_sets.Contains(id)) return -1;
+  const auto& m = g->process_sets.Members(id);
+  for (size_t i = 0; i < m.size(); i++) out[i] = m[i];
+  return (int)m.size();
+}
+
+int hvd_mpi_threads_supported() { return 0; }
+int hvd_nccl_built() { return 0; }
+
+}  // extern "C"

@@ -1,0 +1,214 @@
+// reduce.h — element-wise reduction kernels for the CPU/TCP data plane.
+//
+// Plays the role of the reference's per-backend reduction (MPI_SUM custom op
+// for fp16 in horovod/common/half.h plus NCCL's built-in reductions). On TPU
+// the fused data plane is XLA; these kernels back the host/TCP reference
+// backend and Adasum's host-side math.
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+
+#include "common.h"
+
+namespace hvd {
+
+// --- fp16 / bf16 <-> float conversion -------------------------------------
+inline float half_to_float(uint16_t h) {
+  uint32_t sign = (uint32_t)(h >> 15) << 31;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t mant = h & 0x3ff;
+  uint32_t f;
+  if (exp == 0) {
+    if (mant == 0) {
+      f = sign;
+    } else {
+      // subnormal
+      exp = 127 - 15 + 1;
+      while ((mant & 0x400) == 0) {
+        mant <<= 1;
+        exp--;
+      }
+      mant &= 0x3ff;
+      f = sign | (exp << 23) | (mant << 13);
+    }
+  } else if (exp == 0x1f) {
+    f = sign | 0x7f800000u | (mant << 13);
+  } else {
+    f = sign | ((exp + 127 - 15) << 23) | (mant << 13);
+  }
+  float out;
+  memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t float_to_half(float v) {
+  uint32_t f;
+  memcpy(&f, &v, 4);
+  uint32_t sign = (f >> 31) & 1;
+  int32_t exp = (int32_t)((f >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = f & 0x7fffff;
+  uint16_t h;
+  if (exp <= 0) {
+    if (exp < -10) {
+      h = (uint16_t)(sign << 15);
+    } else {
+      mant |= 0x800000;
+      uint32_t shift = (uint32_t)(14 - exp);
+      uint32_t rounded = (mant + (1u << (shift - 1))) >> shift;
+      h = (uint16_t)((sign << 15) | rounded);
+    }
+  } else if (exp >= 0x1f) {
+    // inf/nan
+    h = (uint16_t)((sign << 15) | 0x7c00 | (mant ? 0x200 : 0));
+  } else {
+    // round to nearest even
+    uint32_t rounded = mant + 0xfff + ((mant >> 13) & 1);
+    if (rounded & 0x800000) {
+      rounded = 0;
+      exp++;
+      if (exp >= 0x1f) return (uint16_t)((sign << 15) | 0x7c00);
+    }
+    h = (uint16_t)((sign << 15) | (exp << 10) | (rounded >> 13));
+  }
+  return h;
+}
+
+inline float bf16_to_float(uint16_t h) {
+  uint32_t f = (uint32_t)h << 16;
+  float out;
+  memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t float_to_bf16(float v) {
+  uint32_t f;
+  memcpy(&f, &v, 4);
+  // round to nearest even
+  uint32_t lsb = (f >> 16) & 1;
+  f += 0x7fff + lsb;
+  return (uint16_t)(f >> 16);
+}
+
+// --- accumulate: dst = dst OP src, n elements ------------------------------
+template <typename T>
+inline void AccumulateTyped(T* dst, const T* src, int64_t n, ReduceOp op) {
+  switch (op) {
+    case ReduceOp::kSum:
+    case ReduceOp::kAverage:  // averaged via postscale
+    case ReduceOp::kAdasum:   // adasum host math handled separately
+      for (int64_t i = 0; i < n; i++) dst[i] = (T)(dst[i] + src[i]);
+      break;
+    case ReduceOp::kMin:
+      for (int64_t i = 0; i < n; i++) dst[i] = src[i] < dst[i] ? src[i] : dst[i];
+      break;
+    case ReduceOp::kMax:
+      for (int64_t i = 0; i < n; i++) dst[i] = src[i] > dst[i] ? src[i] : dst[i];
+      break;
+    case ReduceOp::kProduct:
+      for (int64_t i = 0; i < n; i++) dst[i] = (T)(dst[i] * src[i]);
+      break;
+  }
+}
+
+template <float (*ToF)(uint16_t), uint16_t (*FromF)(float)>
+inline void Accumulate16(uint16_t* dst, const uint16_t* src, int64_t n,
+                         ReduceOp op) {
+  for (int64_t i = 0; i < n; i++) {
+    float a = ToF(dst[i]), b = ToF(src[i]), r;
+    switch (op) {
+      case ReduceOp::kMin: r = b < a ? b : a; break;
+      case ReduceOp::kMax: r = b > a ? b : a; break;
+      case ReduceOp::kProduct: r = a * b; break;
+      default: r = a + b; break;
+    }
+    dst[i] = FromF(r);
+  }
+}
+
+// dst = dst OP src over raw buffers of `n` elements of `dtype`.
+inline void Accumulate(void* dst, const void* src, int64_t n, DataType dtype,
+                       ReduceOp op) {
+  switch (dtype) {
+    case DataType::kUInt8:
+    case DataType::kBool:
+      AccumulateTyped((uint8_t*)dst, (const uint8_t*)src, n, op);
+      break;
+    case DataType::kInt8:
+      AccumulateTyped((int8_t*)dst, (const int8_t*)src, n, op);
+      break;
+    case DataType::kInt32:
+      AccumulateTyped((int32_t*)dst, (const int32_t*)src, n, op);
+      break;
+    case DataType::kInt64:
+      AccumulateTyped((int64_t*)dst, (const int64_t*)src, n, op);
+      break;
+    case DataType::kFloat32:
+      AccumulateTyped((float*)dst, (const float*)src, n, op);
+      break;
+    case DataType::kFloat64:
+      AccumulateTyped((double*)dst, (const double*)src, n, op);
+      break;
+    case DataType::kFloat16:
+      Accumulate16<half_to_float, float_to_half>((uint16_t*)dst,
+                                                 (const uint16_t*)src, n, op);
+      break;
+    case DataType::kBFloat16:
+      Accumulate16<bf16_to_float, float_to_bf16>((uint16_t*)dst,
+                                                 (const uint16_t*)src, n, op);
+      break;
+  }
+}
+
+// buf *= factor (used for prescale/postscale, Average divides by set size).
+inline void ScaleBuffer(void* buf, int64_t n, DataType dtype, double factor) {
+  if (factor == 1.0) return;
+  switch (dtype) {
+    case DataType::kUInt8:
+    case DataType::kBool: {
+      auto* p = (uint8_t*)buf;
+      for (int64_t i = 0; i < n; i++) p[i] = (uint8_t)(p[i] * factor);
+      break;
+    }
+    case DataType::kInt8: {
+      auto* p = (int8_t*)buf;
+      for (int64_t i = 0; i < n; i++) p[i] = (int8_t)(p[i] * factor);
+      break;
+    }
+    case DataType::kInt32: {
+      auto* p = (int32_t*)buf;
+      for (int64_t i = 0; i < n; i++) p[i] = (int32_t)(p[i] * factor);
+      break;
+    }
+    case DataType::kInt64: {
+      auto* p = (int64_t*)buf;
+      for (int64_t i = 0; i < n; i++) p[i] = (int64_t)(p[i] * factor);
+      break;
+    }
+    case DataType::kFloat32: {
+      auto* p = (float*)buf;
+      float f = (float)factor;
+      for (int64_t i = 0; i < n; i++) p[i] *= f;
+      break;
+    }
+    case DataType::kFloat64: {
+      auto* p = (double*)buf;
+      for (int64_t i = 0; i < n; i++) p[i] *= factor;
+      break;
+    }
+    case DataType::kFloat16: {
+      auto* p = (uint16_t*)buf;
+      for (int64_t i = 0; i < n; i++)
+        p[i] = float_to_half(half_to_float(p[i]) * (float)factor);
+      break;
+    }
+    case DataType::kBFloat16: {
+      auto* p = (uint16_t*)buf;
+      for (int64_t i = 0; i < n; i++)
+        p[i] = float_to_bf16(bf16_to_float(p[i]) * (float)factor);
+      break;
+    }
+  }
+}
+
+}  // namespace hvd

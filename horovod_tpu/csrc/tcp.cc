@@ -1,0 +1,171 @@
+#include "tcp.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <stdexcept>
+#include <thread>
+
+namespace hvd {
+
+static void throw_errno(const std::string& what) {
+  throw std::runtime_error(what + ": " + strerror(errno));
+}
+
+Socket& Socket::operator=(Socket&& o) noexcept {
+  if (this != &o) {
+    Close();
+    fd_ = o.fd_;
+    o.fd_ = -1;
+  }
+  return *this;
+}
+
+void Socket::Close() {
+  if (fd_ >= 0) {
+    ::shutdown(fd_, SHUT_RDWR);
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+void Socket::SetNoDelay() {
+  int one = 1;
+  setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+void Socket::SendAll(const void* buf, size_t n) {
+  const uint8_t* p = (const uint8_t*)buf;
+  while (n > 0) {
+    ssize_t k = ::send(fd_, p, n, MSG_NOSIGNAL);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      throw_errno("send");
+    }
+    if (k == 0) throw std::runtime_error("send: peer closed");
+    p += k;
+    n -= (size_t)k;
+  }
+}
+
+void Socket::RecvAll(void* buf, size_t n) {
+  uint8_t* p = (uint8_t*)buf;
+  while (n > 0) {
+    ssize_t k = ::recv(fd_, p, n, 0);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      throw_errno("recv");
+    }
+    if (k == 0) throw std::runtime_error("recv: peer closed");
+    p += k;
+    n -= (size_t)k;
+  }
+}
+
+void Socket::SendFrame(const std::vector<uint8_t>& payload) {
+  uint32_t len = (uint32_t)payload.size();
+  SendAll(&len, 4);
+  if (len) SendAll(payload.data(), len);
+}
+
+std::vector<uint8_t> Socket::RecvFrame() {
+  uint32_t len = 0;
+  RecvAll(&len, 4);
+  std::vector<uint8_t> payload(len);
+  if (len) RecvAll(payload.data(), len);
+  return payload;
+}
+
+void Listener::Listen(int port) {
+  fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd_ < 0) throw_errno("socket");
+  int one = 1;
+  setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (::bind(fd_, (sockaddr*)&addr, sizeof(addr)) < 0) throw_errno("bind");
+  if (::listen(fd_, 128) < 0) throw_errno("listen");
+  socklen_t len = sizeof(addr);
+  if (::getsockname(fd_, (sockaddr*)&addr, &len) < 0) throw_errno("getsockname");
+  port_ = ntohs(addr.sin_port);
+}
+
+Socket Listener::Accept() {
+  while (true) {
+    int fd = ::accept(fd_, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      throw_errno("accept");
+    }
+    Socket s(fd);
+    s.SetNoDelay();
+    return s;
+  }
+}
+
+void Listener::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+Socket ConnectRetry(const std::string& host, int port, double timeout_sec) {
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::duration<double>(timeout_sec);
+  std::string err;
+  while (std::chrono::steady_clock::now() < deadline) {
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    std::string port_s = std::to_string(port);
+    int rc = getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res);
+    if (rc == 0) {
+      int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+        freeaddrinfo(res);
+        Socket s(fd);
+        s.SetNoDelay();
+        return s;
+      }
+      err = strerror(errno);
+      if (fd >= 0) ::close(fd);
+      freeaddrinfo(res);
+    } else {
+      err = gai_strerror(rc);
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  }
+  throw std::runtime_error("connect to " + host + ":" + std::to_string(port) +
+                           " timed out: " + err);
+}
+
+std::string LocalAddr(const Socket& s) {
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (::getsockname(s.fd(), (sockaddr*)&addr, &len) < 0) throw_errno("getsockname");
+  char buf[INET_ADDRSTRLEN];
+  inet_ntop(AF_INET, &addr.sin_addr, buf, sizeof(buf));
+  return std::string(buf);
+}
+
+std::string PeerAddr(const Socket& s) {
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (::getpeername(s.fd(), (sockaddr*)&addr, &len) < 0) throw_errno("getpeername");
+  char buf[INET_ADDRSTRLEN];
+  inet_ntop(AF_INET, &addr.sin_addr, buf, sizeof(buf));
+  return std::string(buf);
+}
+
+}  // namespace hvd

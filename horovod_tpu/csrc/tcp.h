@@ -1,0 +1,68 @@
+// tcp.h — minimal TCP transport for control plane + CPU data plane.
+//
+// Replaces the reference's MPI/Gloo control plane (horovod/common/mpi/
+// mpi_controller.cc, horovod/common/gloo/gloo_controller.cc) with a
+// hand-rolled, dependency-free socket layer. Frames are [u32 len][payload].
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvd {
+
+class Socket {
+ public:
+  Socket() : fd_(-1) {}
+  explicit Socket(int fd) : fd_(fd) {}
+  Socket(const Socket&) = delete;
+  Socket& operator=(const Socket&) = delete;
+  Socket(Socket&& o) noexcept : fd_(o.fd_) { o.fd_ = -1; }
+  Socket& operator=(Socket&& o) noexcept;
+  ~Socket() { Close(); }
+
+  bool valid() const { return fd_ >= 0; }
+  int fd() const { return fd_; }
+  void Close();
+
+  // Exact-length IO; throws std::runtime_error on peer failure.
+  void SendAll(const void* buf, size_t n);
+  void RecvAll(void* buf, size_t n);
+
+  void SendFrame(const std::vector<uint8_t>& payload);
+  std::vector<uint8_t> RecvFrame();
+
+  void SetNoDelay();
+
+ private:
+  int fd_;
+};
+
+// Listening socket bound to 0.0.0.0:port (port=0 -> ephemeral).
+class Listener {
+ public:
+  Listener() : fd_(-1), port_(0) {}
+  void Listen(int port);
+  Socket Accept();  // blocking
+  int port() const { return port_; }
+  void Close();
+  ~Listener() { Close(); }
+
+ private:
+  int fd_;
+  int port_;
+};
+
+// Blocking connect with retry (rendezvous races are expected at startup).
+Socket ConnectRetry(const std::string& host, int port, double timeout_sec);
+
+// Local address of a connected socket (used to advertise the data-plane addr).
+std::string LocalAddr(const Socket& s);
+
+// Remote address of a connected socket (coordinator learns each worker's
+// data-plane host from its control connection).
+std::string PeerAddr(const Socket& s);
+
+}  // namespace hvd

@@ -1,0 +1,89 @@
+// tensor_queue.h — thread-safe table of pending collective submissions.
+//
+// TPU-native counterpart of the reference's TensorQueue/TensorTableEntry
+// (horovod/common/tensor_queue.cc): frontend threads add entries + requests;
+// the background thread drains requests each cycle and claims entries when
+// their Response arrives.
+#pragma once
+
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+
+namespace hvd {
+
+struct TensorTableEntry {
+  Request req;
+  const void* input = nullptr;  // user buffer, valid until handle completes
+  void* output = nullptr;       // user output buffer (may equal input) or null
+  int handle = -1;
+  int64_t enqueue_us = 0;  // for timeline QUEUE phase
+};
+
+class TensorQueue {
+ public:
+  // Pending entries are keyed by (process set, name), matching the
+  // coordinator's negotiation key: the same tensor name may be in flight in
+  // disjoint process sets simultaneously.
+  static std::string Key(int32_t process_set, const std::string& name) {
+    return std::to_string(process_set) + "\x01" + name;
+  }
+
+  // Returns false if a tensor with this (process set, name) is already
+  // pending (the reference treats duplicate in-flight names as a fatal
+  // usage error).
+  bool Add(TensorTableEntry entry) {
+    std::lock_guard<std::mutex> l(mu_);
+    std::string key = Key(entry.req.process_set, entry.req.name);
+    if (table_.count(key)) return false;
+    pending_.push_back(entry.req);
+    table_.emplace(std::move(key), std::move(entry));
+    return true;
+  }
+
+  // Drain requests not yet sent to the coordinator (called once per cycle).
+  std::vector<Request> PopRequests() {
+    std::lock_guard<std::mutex> l(mu_);
+    std::vector<Request> out;
+    out.swap(pending_);
+    return out;
+  }
+
+  // Claim the entry for an arrived Response. Returns false if absent (e.g.
+  // this rank is not a participant of the response's process set).
+  bool Take(const std::string& name, int32_t process_set,
+            TensorTableEntry* out) {
+    std::lock_guard<std::mutex> l(mu_);
+    auto it = table_.find(Key(process_set, name));
+    if (it == table_.end()) return false;
+    *out = std::move(it->second);
+    table_.erase(it);
+    return true;
+  }
+
+  // Fail everything still pending (shutdown / internal error path).
+  std::vector<TensorTableEntry> DrainAll() {
+    std::lock_guard<std::mutex> l(mu_);
+    std::vector<TensorTableEntry> out;
+    out.reserve(table_.size());
+    for (auto& kv : table_) out.push_back(std::move(kv.second));
+    table_.clear();
+    pending_.clear();
+    return out;
+  }
+
+  size_t size() {
+    std::lock_guard<std::mutex> l(mu_);
+    return table_.size();
+  }
+
+ private:
+  std::mutex mu_;
+  std::unordered_map<std::string, TensorTableEntry> table_;
+  std::vector<Request> pending_;
+};
+
+}  // namespace hvd

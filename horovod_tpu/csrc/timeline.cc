@@ -1,0 +1,103 @@
+#include "timeline.h"
+
+namespace hvd {
+
+namespace {
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    if (c == '"' || c == '\\') {
+      out.push_back('\\');
+      out.push_back(c);
+    } else if ((unsigned char)c < 0x20) {
+      char buf[8];
+      snprintf(buf, sizeof(buf), "\\u%04x", c);
+      out += buf;
+    } else {
+      out.push_back(c);
+    }
+  }
+  return out;
+}
+}  // namespace
+
+void Timeline::Init(const std::string& path, int rank) {
+  if (path.empty()) return;
+  rank_ = rank;
+  file_ = fopen(path.c_str(), "w");
+  if (!file_) return;
+  fputs("[\n", file_);
+  first_event_ = true;
+  stop_ = false;
+  enabled_ = true;
+  writer_ = std::thread([this] { WriterLoop(); });
+}
+
+void Timeline::Shutdown() {
+  if (!enabled_) return;
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    stop_ = true;
+  }
+  cv_.notify_all();
+  if (writer_.joinable()) writer_.join();
+  if (file_) {
+    fputs("\n]\n", file_);
+    fclose(file_);
+    file_ = nullptr;
+  }
+  enabled_ = false;
+}
+
+void Timeline::Record(const std::string& tensor, const std::string& phase,
+                      int64_t start_us, int64_t end_us) {
+  if (!enabled_) return;
+  char buf[512];
+  snprintf(buf, sizeof(buf),
+           "{\"name\": \"%s\", \"ph\": \"X\", \"ts\": %lld, \"dur\": %lld, "
+           "\"pid\": %d, \"tid\": \"%s\"}",
+           JsonEscape(phase).c_str(), (long long)start_us,
+           (long long)(end_us - start_us), rank_, JsonEscape(tensor).c_str());
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    queue_.emplace_back(buf);
+  }
+  cv_.notify_one();
+}
+
+void Timeline::Mark(const std::string& label) {
+  if (!enabled_) return;
+  char buf[256];
+  snprintf(buf, sizeof(buf),
+           "{\"name\": \"%s\", \"ph\": \"i\", \"ts\": %lld, \"pid\": %d, "
+           "\"s\": \"p\"}",
+           JsonEscape(label).c_str(), (long long)NowUs(), rank_);
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    queue_.emplace_back(buf);
+  }
+  cv_.notify_one();
+}
+
+void Timeline::WriterLoop() {
+  std::vector<std::string> batch;
+  while (true) {
+    {
+      std::unique_lock<std::mutex> l(mu_);
+      cv_.wait_for(l, std::chrono::milliseconds(100),
+                   [this] { return stop_ || !queue_.empty(); });
+      batch.swap(queue_);
+      if (batch.empty() && stop_) break;
+    }
+    for (auto& e : batch) {
+      if (!first_event_) fputs(",\n", file_);
+      first_event_ = false;
+      fputs(e.c_str(), file_);
+    }
+    fflush(file_);
+    batch.clear();
+  }
+}
+
+}  // namespace hvd

@@ -1,0 +1,56 @@
+// timeline.h — Chrome-trace timeline of per-tensor collective lifecycle.
+//
+// Equivalent of the reference's horovod/common/timeline.cc (Timeline +
+// async TimelineWriter): phases NEGOTIATE / QUEUE / WAIT_FOR_DATA /
+// MEMCPY_IN_FUSION_BUFFER / <BACKEND>_<OP> / MEMCPY_OUT_FUSION_BUFFER are
+// emitted as complete ("X") events; an async writer thread keeps file IO out
+// of the background loop. Enabled via HVD_TIMELINE=<path.json>; load the
+// output in chrome://tracing or Perfetto.
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace hvd {
+
+inline int64_t NowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+class Timeline {
+ public:
+  void Init(const std::string& path, int rank);
+  void Shutdown();
+  bool enabled() const { return enabled_; }
+
+  // Complete event: [start_us, end_us) on track `tensor`, labeled `phase`.
+  void Record(const std::string& tensor, const std::string& phase,
+              int64_t start_us, int64_t end_us);
+  // Instant event (negotiation cycle markers, HVD_TIMELINE_MARK_CYCLES).
+  void Mark(const std::string& label);
+
+  ~Timeline() { Shutdown(); }
+
+ private:
+  void WriterLoop();
+
+  std::atomic<bool> enabled_{false};
+  std::atomic<bool> stop_{false};
+  int rank_ = 0;
+  FILE* file_ = nullptr;
+  bool first_event_ = true;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::vector<std::string> queue_;
+  std::thread writer_;
+};
+
+}  // namespace hvd

@@ -1,0 +1,28 @@
+"""Exceptions shared across the framework.
+
+TPU-native counterpart of the reference's ``horovod/common/exceptions.py``:
+``HorovodInternalError`` signals that a collective failed mid-flight (a peer
+died, the control/data plane broke) — the elastic retry loop catches it and
+rolls back to the last committed state. ``HostsUpdatedInterrupt`` signals a
+membership change discovered by the driver — state is synced, not rolled back.
+"""
+
+
+class HorovodInternalError(RuntimeError):
+    """Collective failed: a peer died or the communication plane broke."""
+
+
+class HostsUpdatedInterrupt(RuntimeError):
+    """Host membership changed (elastic); re-initialize and continue.
+
+    ``skip_sync`` mirrors the reference: when True the worker may continue
+    without a state sync (the update did not invalidate its state).
+    """
+
+    def __init__(self, skip_sync=False):
+        super().__init__("hosts updated")
+        self.skip_sync = skip_sync
+
+
+class HorovodVersionMismatchError(ImportError):
+    """Native library and Python package versions disagree."""

@@ -1,0 +1,1 @@
+from . import collective_ops  # noqa: F401

@@ -1,0 +1,354 @@
+"""NumPy-level collective operations over the native core.
+
+TPU-native counterpart of the reference's per-framework op layers
+(``horovod/torch/mpi_ops.py``, ``horovod/tensorflow/mpi_ops.py``): async
+enqueue returning integer handles, ``synchronize``/``poll`` completion, sync
+convenience wrappers, grouped variants, join/barrier, and process-set
+management. Framework bindings (JAX/TF/Torch) adapt their tensors to NumPy
+host buffers and call through here; the TPU in-graph path
+(:mod:`horovod_tpu.ops.jax_ops`) bypasses the host entirely.
+"""
+
+import ctypes
+import threading
+
+import numpy as np
+
+try:
+    import ml_dtypes
+
+    _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _BFLOAT16 = None
+
+from ..basics import _lib, last_error
+from ..exceptions import HorovodInternalError
+
+# ReduceOp values (must match csrc/common.h).
+Sum = 0
+Average = 1
+Min = 2
+Max = 3
+Product = 4
+Adasum = 5
+
+_DT_MAP = {
+    np.dtype(np.uint8): 0,
+    np.dtype(np.int8): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+    np.dtype(np.float16): 4,
+    np.dtype(np.float32): 5,
+    np.dtype(np.float64): 6,
+    np.dtype(np.bool_): 7,
+}
+if _BFLOAT16 is not None:
+    _DT_MAP[_BFLOAT16] = 8
+
+_lock = threading.Lock()
+_counters = {}
+_group_counter = [0]
+# Keep buffers alive while the background thread may touch them.
+_live = {}
+
+
+def _auto_name(kind, name):
+    if name is not None:
+        return name
+    with _lock:
+        n = _counters.get(kind, 0)
+        _counters[kind] = n + 1
+    return f"{kind}.noname.{n}"
+
+
+def _dtype_code(arr):
+    try:
+        return _DT_MAP[arr.dtype]
+    except KeyError:
+        raise ValueError(f"unsupported dtype for horovod_tpu: {arr.dtype}")
+
+
+def _shape_arg(arr):
+    shape = (ctypes.c_int64 * max(arr.ndim, 1))(*arr.shape)
+    return shape, arr.ndim
+
+
+def _ptr(arr):
+    return ctypes.c_void_p(arr.ctypes.data)
+
+
+def _check_handle(h):
+    if h < 0:
+        err = last_error()
+        if err.startswith("HorovodInternalError"):
+            raise HorovodInternalError(err)
+        raise ValueError(err or "enqueue failed")
+    return h
+
+
+class Handle:
+    """An in-flight collective (reference: horovod/torch/handle_manager.cc)."""
+
+    __slots__ = ("id", "kind", "inputs", "output", "dtype", "name")
+
+    def __init__(self, hid, kind, inputs, output, dtype, name):
+        self.id = hid
+        self.kind = kind
+        self.inputs = inputs  # keep alive
+        self.output = output
+        self.dtype = dtype
+        self.name = name
+
+
+def _register(handle):
+    with _lock:
+        _live[handle.id] = handle
+    return handle
+
+
+def synchronize(handle):
+    """Block until `handle` completes; return its result array(s)."""
+    if isinstance(handle, (list, tuple)):
+        return [synchronize(h) for h in handle]
+    rc = _lib.hvd_wait(handle.id)
+    try:
+        if rc != 1:
+            err = last_error()
+            if "HorovodInternalError" in err or "shutdown" in err:
+                raise HorovodInternalError(err)
+            raise RuntimeError(f"collective '{handle.name}' failed: {err}")
+        return _collect_result(handle)
+    finally:
+        _lib.hvd_release(handle.id)
+        with _lock:
+            _live.pop(handle.id, None)
+
+
+def poll(handle):
+    """True if `handle` has completed (successfully or not)."""
+    return _lib.hvd_poll(handle.id) != 0
+
+
+def _collect_result(handle):
+    if handle.kind in ("allreduce", "broadcast"):
+        return handle.output
+    # Core-owned output: copy into a fresh numpy array.
+    ndim = _lib.hvd_output_ndim(handle.id)
+    shape_buf = (ctypes.c_int64 * max(ndim, 1))()
+    _lib.hvd_output_shape(handle.id, shape_buf)
+    shape = tuple(shape_buf[i] for i in range(ndim))
+    out = np.empty(shape, dtype=handle.dtype)
+    nbytes = out.nbytes
+    src = _lib.hvd_output_ptr(handle.id)
+    if nbytes and src:
+        ctypes.memmove(out.ctypes.data, src, nbytes)
+    if handle.kind == "add_process_set":
+        return _lib.hvd_handle_extra(handle.id)
+    if handle.kind == "alltoall":
+        mlen = _lib.hvd_output_meta(handle.id, None)  # query length only
+        if mlen > 0:
+            meta_buf = (ctypes.c_int64 * mlen)()
+            mlen = _lib.hvd_output_meta(handle.id, meta_buf)
+            recv_splits = np.array([meta_buf[i] for i in range(mlen)],
+                                   dtype=np.int64)
+            return out, recv_splits
+        return out, None
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Allreduce
+
+def allreduce_async(tensor, op=Average, name=None, prescale_factor=1.0,
+                    postscale_factor=1.0, process_set=0, _group=(-1, 0)):
+    arr = np.ascontiguousarray(tensor)
+    out = np.empty_like(arr)
+    name = _auto_name("allreduce", name)
+    shape, ndim = _shape_arg(arr)
+    h = _check_handle(_lib.hvd_allreduce_async(
+        name.encode(), _ptr(arr), _ptr(out), shape, ndim, _dtype_code(arr),
+        int(op), float(prescale_factor), float(postscale_factor),
+        int(process_set), _group[0], _group[1]))
+    return _register(Handle(h, "allreduce", (arr,), out, arr.dtype, name))
+
+
+def allreduce(tensor, op=Average, name=None, prescale_factor=1.0,
+              postscale_factor=1.0, process_set=0):
+    return synchronize(allreduce_async(tensor, op, name, prescale_factor,
+                                       postscale_factor, process_set))
+
+
+def grouped_allreduce_async(tensors, op=Average, name=None, process_set=0,
+                            prescale_factor=1.0, postscale_factor=1.0):
+    """Negotiate and fuse `tensors` as one atomic group (reference:
+    grouped_allreduce / group_table.cc)."""
+    with _lock:
+        gid = _group_counter[0]
+        _group_counter[0] += 1
+    base = _auto_name("grouped_allreduce", name)
+    return [
+        allreduce_async(t, op, f"{base}.{i}", prescale_factor,
+                        postscale_factor, process_set,
+                        _group=(gid, len(tensors)))
+        for i, t in enumerate(tensors)
+    ]
+
+
+def grouped_allreduce(tensors, op=Average, name=None, process_set=0,
+                      prescale_factor=1.0, postscale_factor=1.0):
+    return synchronize(grouped_allreduce_async(
+        tensors, op, name, process_set, prescale_factor, postscale_factor))
+
+
+# ---------------------------------------------------------------------------
+# Allgather
+
+def allgather_async(tensor, name=None, process_set=0):
+    arr = np.ascontiguousarray(tensor)
+    if arr.ndim == 0:
+        arr = arr.reshape(1)
+    name = _auto_name("allgather", name)
+    shape, ndim = _shape_arg(arr)
+    h = _check_handle(_lib.hvd_allgather_async(
+        name.encode(), _ptr(arr), shape, ndim, _dtype_code(arr),
+        int(process_set)))
+    return _register(Handle(h, "allgather", (arr,), None, arr.dtype, name))
+
+
+def allgather(tensor, name=None, process_set=0):
+    return synchronize(allgather_async(tensor, name, process_set))
+
+
+# ---------------------------------------------------------------------------
+# Broadcast
+
+def broadcast_async(tensor, root_rank, name=None, process_set=0):
+    arr = np.ascontiguousarray(tensor)
+    out = arr.copy()
+    name = _auto_name("broadcast", name)
+    shape, ndim = _shape_arg(arr)
+    h = _check_handle(_lib.hvd_broadcast_async(
+        name.encode(), _ptr(arr), _ptr(out), shape, ndim, _dtype_code(arr),
+        int(root_rank), int(process_set)))
+    return _register(Handle(h, "broadcast", (arr,), out, arr.dtype, name))
+
+
+def broadcast(tensor, root_rank, name=None, process_set=0):
+    return synchronize(broadcast_async(tensor, root_rank, name, process_set))
+
+
+def broadcast_object(obj, root_rank=0, name=None, process_set=0):
+    """Broadcast an arbitrary picklable object (reference:
+    horovod/torch/mpi_ops.py `broadcast_object`)."""
+    import pickle
+
+    from ..basics import basics
+
+    name = _auto_name("broadcast_object", name)
+    if basics.rank() == root_rank:
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+        length = np.array([payload.size], dtype=np.int64)
+    else:
+        payload = None
+        length = np.zeros(1, dtype=np.int64)
+    length = broadcast(length, root_rank, name + ".len", process_set)
+    if payload is None:
+        payload = np.zeros(int(length[0]), dtype=np.uint8)
+    payload = broadcast(payload, root_rank, name + ".data", process_set)
+    return pickle.loads(payload.tobytes())
+
+
+# ---------------------------------------------------------------------------
+# Alltoall
+
+def alltoall_async(tensor, splits=None, name=None, process_set=0):
+    arr = np.ascontiguousarray(tensor)
+    if arr.ndim == 0:
+        raise ValueError("alltoall requires a tensor with at least 1 dim")
+    psize = _lib.hvd_process_set_size(int(process_set))
+    if splits is None:
+        if arr.shape[0] % psize != 0:
+            raise ValueError(
+                f"alltoall without splits requires dim0 ({arr.shape[0]}) "
+                f"divisible by process set size ({psize})")
+        splits_arr = np.full(psize, arr.shape[0] // psize, dtype=np.int64)
+    else:
+        splits_arr = np.asarray(splits, dtype=np.int64)
+    name = _auto_name("alltoall", name)
+    shape, ndim = _shape_arg(arr)
+    c_splits = (ctypes.c_int64 * len(splits_arr))(*splits_arr)
+    h = _check_handle(_lib.hvd_alltoall_async(
+        name.encode(), _ptr(arr), shape, ndim, _dtype_code(arr), c_splits,
+        len(splits_arr), int(process_set)))
+    return _register(Handle(h, "alltoall", (arr,), None, arr.dtype, name))
+
+
+def alltoall(tensor, splits=None, name=None, process_set=0):
+    out, recv_splits = synchronize(
+        alltoall_async(tensor, splits, name, process_set))
+    if splits is None:
+        return out
+    return out, recv_splits
+
+
+# ---------------------------------------------------------------------------
+# Reducescatter
+
+def reducescatter_async(tensor, op=Average, name=None, prescale_factor=1.0,
+                        postscale_factor=1.0, process_set=0):
+    arr = np.ascontiguousarray(tensor)
+    if arr.ndim == 0:
+        raise ValueError("reducescatter requires a tensor with at least 1 dim")
+    name = _auto_name("reducescatter", name)
+    shape, ndim = _shape_arg(arr)
+    h = _check_handle(_lib.hvd_reducescatter_async(
+        name.encode(), _ptr(arr), shape, ndim, _dtype_code(arr), int(op),
+        float(prescale_factor), float(postscale_factor), int(process_set)))
+    return _register(Handle(h, "reducescatter", (arr,), None, arr.dtype, name))
+
+
+def reducescatter(tensor, op=Average, name=None, prescale_factor=1.0,
+                  postscale_factor=1.0, process_set=0):
+    return synchronize(reducescatter_async(
+        tensor, op, name, prescale_factor, postscale_factor, process_set))
+
+
+# ---------------------------------------------------------------------------
+# Join / barrier / process sets
+
+def join(process_set=0):
+    """Block until every rank of the process set has joined.
+
+    Note: unlike the reference's join (which lets remaining ranks continue
+    collectives with zero-filled stand-ins), this build's join is a
+    termination barrier: call it when the rank has no more collectives to
+    submit. Returns 0. Zero-fill participation is tracked for a later round.
+    """
+    name = _auto_name("join", None)
+    h = _check_handle(_lib.hvd_join_async(name.encode(), int(process_set)))
+    handle = _register(Handle(h, "join", (), None, None, name))
+    synchronize(handle)
+    return 0
+
+
+def barrier(process_set=0):
+    name = _auto_name("barrier", None)
+    h = _check_handle(_lib.hvd_barrier_async(name.encode(), int(process_set)))
+    synchronize(_register(Handle(h, "barrier", (), None, None, name)))
+
+
+def add_process_set_collective(ranks):
+    """Collectively register a new process set; returns its id."""
+    name = _auto_name("add_process_set", None)
+    ranks_arr = (ctypes.c_int64 * len(ranks))(*[int(r) for r in ranks])
+    h = _check_handle(
+        _lib.hvd_add_process_set_async(name.encode(), ranks_arr, len(ranks)))
+    handle = _register(Handle(h, "add_process_set", (), None, None, name))
+    return synchronize(handle)
+
+
+def remove_process_set_collective(process_set_id):
+    name = _auto_name("remove_process_set", None)
+    h = _check_handle(
+        _lib.hvd_remove_process_set_async(name.encode(), int(process_set_id)))
+    synchronize(_register(Handle(h, "remove_process_set", (), None, None, name)))

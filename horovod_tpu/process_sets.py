@@ -1,0 +1,71 @@
+"""Process sets: collectives over subsets of ranks.
+
+TPU-native counterpart of the reference's ``horovod/common/process_sets.py``
++ ``process_set.cc``: a :class:`ProcessSet` names a subset of global ranks and
+every collective accepts ``process_set=``. Registration is itself a collective
+(all ranks must call :func:`add_process_set` with the same ranks). These are
+the building block for hierarchical/hybrid parallelism (e.g. per-replica-group
+allreduce in dp×tp meshes).
+"""
+
+from .basics import _lib, basics
+from .ops import collective_ops as _ops
+
+
+class ProcessSet:
+    def __init__(self, ranks, process_set_id=None):
+        self._ranks = sorted(int(r) for r in ranks)
+        self.process_set_id = process_set_id
+
+    @property
+    def ranks(self):
+        # The global set spans all ranks; its membership is only known after
+        # init, so resolve lazily.
+        if self.process_set_id == 0 and not self._ranks and basics.is_initialized():
+            self._ranks = list(range(basics.size()))
+        return self._ranks
+
+    @ranks.setter
+    def ranks(self, value):
+        self._ranks = sorted(int(r) for r in value)
+
+    def included(self):
+        if self.process_set_id == 0:
+            return True
+        return basics.rank() in self.ranks
+
+    def rank(self):
+        """This process's rank within the set, or -1 if not a member."""
+        if self.process_set_id is None:
+            raise ValueError("process set has not been registered")
+        return _lib.hvd_process_set_rank(self.process_set_id)
+
+    def size(self):
+        if self.process_set_id is None:
+            return len(self.ranks)
+        return _lib.hvd_process_set_size(self.process_set_id)
+
+    def __repr__(self):
+        return f"ProcessSet(id={self.process_set_id}, ranks={self.ranks})"
+
+
+global_process_set = ProcessSet([], process_set_id=0)
+
+
+def add_process_set(process_set_or_ranks):
+    """Collectively register a process set; all ranks must call this with the
+    same ranks in the same order relative to other collectives."""
+    if isinstance(process_set_or_ranks, ProcessSet):
+        ps = process_set_or_ranks
+    else:
+        ps = ProcessSet(process_set_or_ranks)
+    ps.process_set_id = _ops.add_process_set_collective(ps.ranks)
+    return ps
+
+
+def remove_process_set(process_set):
+    """Collectively deregister a process set."""
+    if process_set.process_set_id in (None, 0):
+        raise ValueError("cannot remove the global process set")
+    _ops.remove_process_set_collective(process_set.process_set_id)
+    process_set.process_set_id = None

@@ -1,0 +1,6 @@
+"""Launcher package (reference: horovod/runner/).
+
+Import submodules directly (``horovod_tpu.runner.local``,
+``horovod_tpu.runner.launch``) — kept lazy here so ``python -m
+horovod_tpu.runner.local`` does not re-execute an already-imported module.
+"""

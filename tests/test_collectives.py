@@ -1,0 +1,29 @@
+"""Distributed correctness tier (reference: test/parallel/test_torch.py,
+test_tensorflow.py — the collective × dtype × shape matrix, process sets,
+grouped ops, error paths), executed as N local processes rendezvousing over
+localhost TCP (SURVEY.md §4 'fake pod')."""
+
+import pytest
+
+from .util import run_worker_job
+
+
+@pytest.mark.parametrize("np_", [2, 4])
+def test_collective_matrix(np_):
+    run_worker_job(np_, "collective_worker.py")
+
+
+def test_adasum_semantics():
+    run_worker_job(2, "adasum_worker.py")
+
+
+def test_process_sets():
+    run_worker_job(4, "process_set_worker.py")
+
+
+def test_negotiation_errors():
+    run_worker_job(2, "error_worker.py")
+
+
+def test_peer_death_raises_internal_error():
+    run_worker_job(3, "elastic_error_worker.py")

@@ -1,0 +1,77 @@
+"""Single-process tier (reference: test/single/): API behavior with size=1,
+launcher utilities, no cluster."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    import os
+
+    # Slow the negotiation cycle so the duplicate-name test below can enqueue
+    # its second tensor before the first leaves the queue.
+    os.environ["HVD_CYCLE_TIME_MS"] = "30"
+    hvd.init()
+    yield
+    hvd.shutdown()
+    os.environ.pop("HVD_CYCLE_TIME_MS", None)
+
+
+def test_rank_size():
+    assert hvd.rank() == 0
+    assert hvd.size() == 1
+    assert hvd.local_rank() == 0
+    assert hvd.is_initialized()
+
+
+def test_allreduce_identity():
+    x = np.random.default_rng(0).normal(size=(4, 5)).astype(np.float32)
+    out = hvd.allreduce(x, op=hvd.Sum)
+    assert np.allclose(out, x)
+    out = hvd.allreduce(x, op=hvd.Average)
+    assert np.allclose(out, x)
+
+
+def test_dtypes():
+    for dt in [np.uint8, np.int8, np.int32, np.int64, np.float16,
+               np.float32, np.float64]:
+        x = np.ones((3,), dtype=dt)
+        assert hvd.allreduce(x, op=hvd.Sum).dtype == dt
+
+
+def test_bfloat16():
+    import ml_dtypes
+
+    x = np.ones((5,), dtype=ml_dtypes.bfloat16)
+    out = hvd.allreduce(x, op=hvd.Sum)
+    assert out.dtype == ml_dtypes.bfloat16
+    assert np.allclose(out.astype(np.float32), 1.0)
+
+
+def test_allgather_single():
+    x = np.arange(6, dtype=np.int32).reshape(2, 3)
+    assert (hvd.allgather(x) == x).all()
+
+
+def test_broadcast_object():
+    obj = {"a": 1, "b": [1, 2, 3]}
+    assert hvd.broadcast_object(obj, root_rank=0) == obj
+
+
+def test_duplicate_name_rejected():
+    x = np.ones(4, dtype=np.float32)
+    h1 = hvd.allreduce_async(x, name="dup")
+    with pytest.raises(ValueError, match="already pending"):
+        # Enqueue a second in-flight tensor with the same name immediately.
+        hvd.allreduce_async(x, name="dup")
+    hvd.synchronize(h1)
+
+
+def test_prescale_postscale():
+    x = np.full(4, 2.0, dtype=np.float32)
+    out = hvd.allreduce(x, op=hvd.Sum, prescale_factor=0.5,
+                        postscale_factor=3.0)
+    assert np.allclose(out, 3.0)

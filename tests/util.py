@@ -1,0 +1,37 @@
+"""Helpers for multi-process tests (SURVEY.md §4: the 'fake pod' is N local
+processes rendezvousing on localhost)."""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKERS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "workers")
+
+
+def run_worker_job(np_, worker_file, extra_env=None, timeout=120):
+    """Launch `worker_file` as an np_-rank job; assert every rank exits 0."""
+    from horovod_tpu.runner.local import run_local
+
+    env = {"PYTHONPATH": _REPO}
+    # Workers are plain-python (no JAX); keep them off any real TPU.
+    env["JAX_PLATFORMS"] = "cpu"
+    if extra_env:
+        env.update(extra_env)
+    codes = run_local(
+        np_, [sys.executable, os.path.join(WORKERS, worker_file)],
+        env=env, timeout=timeout,
+    )
+    assert codes == [0] * np_, f"worker exit codes: {codes}"
+
+
+def run_single(worker_file, extra_env=None, timeout=120):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO
+    if extra_env:
+        env.update({k: str(v) for k, v in extra_env.items()})
+    p = subprocess.run(
+        [sys.executable, os.path.join(WORKERS, worker_file)],
+        env=env, timeout=timeout, capture_output=True, text=True,
+    )
+    assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr}"

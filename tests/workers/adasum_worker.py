@@ -1,0 +1,16 @@
+import numpy as np
+import horovod_tpu as hvd
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+# identical vectors -> adasum == average == the vector itself
+v = np.array([1.0, 2.0, 3.0, 4.0], dtype=np.float32)
+out = hvd.allreduce(v.copy(), op=hvd.Adasum)
+assert np.allclose(out, v, atol=1e-5), (r, out)
+# orthogonal vectors (2 ranks): adasum == sum
+if s == 2:
+    v2 = np.zeros(4, dtype=np.float32); v2[r] = 1.0
+    out2 = hvd.allreduce(v2, op=hvd.Adasum)
+    exp = np.zeros(4); exp[0] = 1; exp[1] = 1
+    assert np.allclose(out2, exp, atol=1e-5), (r, out2)
+hvd.shutdown()
+print(f"rank {r}: ADASUM PASS", flush=True)

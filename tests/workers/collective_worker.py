@@ -1,0 +1,42 @@
+import os, numpy as np
+import horovod_tpu as hvd
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+# allreduce
+x = np.full(1000, float(r + 1), dtype=np.float32)
+y = hvd.allreduce(x, op=hvd.Sum)
+assert np.allclose(y, sum(range(1, s + 1))), y[:4]
+# average
+y = hvd.allreduce(x, op=hvd.Average)
+assert np.allclose(y, sum(range(1, s + 1)) / s), y[:4]
+# allgather (uneven)
+g = hvd.allgather(np.full((r + 1, 2), r, dtype=np.int32))
+assert g.shape == (s * (s + 1) // 2, 2), g.shape
+exp = np.concatenate([np.full((i + 1, 2), i) for i in range(s)])
+assert (g == exp).all()
+# broadcast
+b = hvd.broadcast(np.arange(5, dtype=np.float64) * (r + 1), root_rank=2 % s)
+assert np.allclose(b, np.arange(5) * (2 % s + 1))
+# alltoall with splits
+t = np.arange(s * 3, dtype=np.float32).reshape(s * 3) + 100 * r
+out, rs = hvd.alltoall(t, splits=[3] * s)
+assert out.shape == (3 * s,)
+assert (rs == 3).all()
+# reducescatter
+m = np.ones((s * 2 + 1, 4), dtype=np.float32) * (r + 1)
+rsout = hvd.reducescatter(m, op=hvd.Sum)
+assert np.allclose(rsout, sum(range(1, s + 1)))
+# grouped allreduce (fusion)
+outs = hvd.grouped_allreduce([np.full(10, float(r), np.float32), np.full(20, 2.0 * r, np.float32)], op=hvd.Sum)
+assert np.allclose(outs[0], sum(range(s)))
+assert np.allclose(outs[1], 2 * sum(range(s)))
+# fp16 + bf16
+h = hvd.allreduce(np.full(7, 1.0, dtype=np.float16), op=hvd.Sum)
+assert np.allclose(h.astype(np.float32), s)
+# adasum (power of 2 sizes only)
+if s & (s - 1) == 0:
+    a = hvd.allreduce(np.full(9, float(r + 1), np.float32), op=hvd.Adasum)
+    assert a.shape == (9,)
+hvd.barrier()
+hvd.shutdown()
+print(f"rank {r}: PASS", flush=True)

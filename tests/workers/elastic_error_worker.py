@@ -1,0 +1,32 @@
+"""Worker: a dying peer must surface HorovodInternalError on survivors —
+the elastic recovery hook (reference: HorovodInternalError raised when a
+collective fails; SURVEY.md §3.4)."""
+import os
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.exceptions import HorovodInternalError
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+
+# A couple of healthy rounds first.
+for i in range(3):
+    out = hvd.allreduce(np.ones(8, dtype=np.float32), op=hvd.Sum, name=f"ok.{i}")
+    assert np.allclose(out, s)
+
+if r == s - 1:
+    # Die abruptly mid-job (no shutdown handshake).
+    os._exit(0)
+
+try:
+    hvd.allreduce(np.ones(8, dtype=np.float32), op=hvd.Sum, name="after.death")
+    print(f"rank {r}: expected HorovodInternalError", flush=True)
+    sys.exit(1)
+except HorovodInternalError:
+    pass
+
+print(f"rank {r}: PASS", flush=True)
+os._exit(0)  # skip shutdown handshake; the job is already degraded

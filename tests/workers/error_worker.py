@@ -1,0 +1,31 @@
+"""Worker: negotiation error handling — mismatched shapes must produce a
+clean per-tensor error on every rank, not a hang or a crash (reference:
+controller.cc ConstructResponse error paths)."""
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+
+# Mismatched allreduce shapes.
+x = np.ones(4 + r, dtype=np.float32)  # different shape per rank
+try:
+    hvd.allreduce(x, op=hvd.Sum, name="bad.shape")
+    raise SystemExit(f"rank {r}: expected an error for mismatched shapes")
+except RuntimeError as e:
+    assert "mismatched shape" in str(e), e
+
+# Mismatched dtypes.
+y = np.ones(4, dtype=np.float32 if r == 0 else np.float64)
+try:
+    hvd.allreduce(y, op=hvd.Sum, name="bad.dtype")
+    raise SystemExit(f"rank {r}: expected an error for mismatched dtypes")
+except RuntimeError as e:
+    assert "mismatched dtype" in str(e), e
+
+# The core must still work after errors.
+z = hvd.allreduce(np.ones(4, dtype=np.float32), op=hvd.Sum)
+assert np.allclose(z, s)
+hvd.shutdown()
+print(f"rank {r}: PASS", flush=True)

@@ -1,0 +1,40 @@
+"""Worker: process-set collectives (reference parity:
+test/parallel/test_*.py process-set coverage)."""
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+assert s >= 4, "needs 4 ranks"
+
+evens = hvd.add_process_set([i for i in range(s) if i % 2 == 0])
+odds = hvd.add_process_set([i for i in range(s) if i % 2 == 1])
+assert evens.process_set_id > 0 and odds.process_set_id > 0
+assert evens.process_set_id != odds.process_set_id
+
+mine = evens if r % 2 == 0 else odds
+members = [i for i in range(s) if i % 2 == r % 2]
+assert mine.size() == len(members)
+assert mine.rank() == members.index(r)
+
+# Allreduce within my set only.
+x = np.full(16, float(r), dtype=np.float32)
+y = hvd.allreduce(x, op=hvd.Sum, process_set=mine.process_set_id)
+assert np.allclose(y, sum(members)), (r, y[0], sum(members))
+
+# Allgather within set.
+g = hvd.allgather(np.array([r], dtype=np.int64), process_set=mine.process_set_id)
+assert g.tolist() == members, (r, g)
+
+# Broadcast within set: root is a global rank that must be a member.
+b = hvd.broadcast(np.array([float(r)]), root_rank=members[0],
+                  process_set=mine.process_set_id)
+assert b[0] == members[0]
+
+# Barrier on global set, then remove.
+hvd.barrier()
+hvd.remove_process_set(evens)
+hvd.remove_process_set(odds)
+hvd.shutdown()
+print(f"rank {r}: PASS", flush=True)
