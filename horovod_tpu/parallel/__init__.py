@@ -1,0 +1,12 @@
+"""Single-controller SPMD parallelism over a jax.sharding.Mesh — the
+ICI-fast path.
+
+The reference scales *batch* only (data parallelism with ring allreduce).
+This package provides that first-class (:mod:`data_parallel`) and, beyond
+parity, the mesh/sharding machinery that makes TP / SP / EP / pipeline
+schemes expressible the TPU way: annotate shardings, let XLA insert the
+collectives (SURVEY.md §2.4).
+"""
+
+from .mesh import create_mesh, mesh_axis_size  # noqa: F401
+from .data_parallel import make_train_step  # noqa: F401

@@ -1,0 +1,45 @@
+"""Device-mesh construction helpers.
+
+A Mesh is the TPU-native replacement for the reference's communicator
+machinery (``horovod/common/mpi/mpi_context.cc`` duplicated comms,
+``process_set.cc`` rank subsets): named axes over the physical device grid;
+collectives ride ICI along mesh axes.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def create_mesh(axis_sizes=None, devices=None):
+    """Build a Mesh from {axis_name: size}. One axis may be -1 (inferred).
+
+    Defaults to a single 'data' axis over all local devices — the pure-DP
+    layout matching the reference's one-rank-per-accelerator model.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not axis_sizes:
+        axis_sizes = {"data": n}
+    names = list(axis_sizes.keys())
+    sizes = list(axis_sizes.values())
+    n_infer = sum(1 for s in sizes if s == -1)
+    if n_infer > 1:
+        raise ValueError("at most one axis size may be -1")
+    if n_infer == 1:
+        known = int(np.prod([s for s in sizes if s != -1])) or 1
+        if n % known != 0:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes = [n // known if s == -1 else s for s in sizes]
+    total = int(np.prod(sizes))
+    if total != n:
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} needs {total} devices, "
+            f"have {n}")
+    grid = np.asarray(devices).reshape(sizes)
+    return Mesh(grid, axis_names=tuple(names))
+
+
+def mesh_axis_size(mesh, axis):
+    return mesh.shape[axis]

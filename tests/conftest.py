@@ -8,7 +8,9 @@ vars must be set before the first `import jax` anywhere in the test process.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU for tests even when the session env selects a TPU platform
+# (bench.py and __graft_entry__.py are the TPU surfaces, not the test suite).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -27,3 +27,9 @@ def test_negotiation_errors():
 
 def test_peer_death_raises_internal_error():
     run_worker_job(3, "elastic_error_worker.py")
+
+
+def test_jax_distributed_optimizer_end_to_end():
+    """SURVEY.md §7 stage 4: gradients leave JAX, ride the core, come back
+    averaged — eager and inside jit (io_callback)."""
+    run_worker_job(2, "jax_dp_worker.py", timeout=300)

@@ -1,0 +1,94 @@
+"""Worker: the minimum end-to-end slice (SURVEY.md §7 stage 4) — JAX
+gradients leave the device, ride the core's negotiation + fused TCP ring,
+and come back averaged; DistributedOptimizer + broadcast_parameters drive a
+real training loop across processes."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # workers must not grab the TPU tunnel
+
+import numpy as np
+
+import jax
+
+cpu = jax.devices("cpu")[0]
+jax.config.update("jax_default_device", cpu)
+
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+import horovod_tpu.jax as hvd  # noqa: E402
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+
+# --- eager allreduce of a jax array through the core
+x = jnp.full((8,), float(r + 1))
+y = hvd.allreduce(x, op=hvd.Sum, name="eager.x")
+assert np.allclose(np.asarray(y), sum(range(1, s + 1))), y
+
+# --- allreduce inside jit lowers to io_callback through the same core
+@jax.jit
+def jitted(v):
+    return hvd.allreduce(v * 2.0, op=hvd.Average, name="jit.x") + 1.0
+
+out = jitted(jnp.full((4,), float(r)))
+expected = 2.0 * np.mean(np.arange(s)) + 1.0
+assert np.allclose(np.asarray(out), expected), (out, expected)
+
+# --- broadcast_parameters: rank-divergent params converge to rank 0's
+params = {"w": jnp.full((3, 3), float(r)), "b": jnp.full((3,), float(r))}
+params = hvd.broadcast_parameters(params, root_rank=0)
+assert np.allclose(np.asarray(params["w"]), 0.0)
+
+# --- full DP training loop: DistributedOptimizer averages grads
+rng = np.random.default_rng(7)  # same data everywhere; shard by rank
+X = rng.normal(size=(64, 5)).astype(np.float32)
+Y = (X @ np.arange(5).astype(np.float32))[:, None]
+Xr, Yr = jnp.asarray(X[r::s]), jnp.asarray(Y[r::s])
+
+w0 = {"w": jnp.asarray(rng.normal(size=(5, 1)).astype(np.float32))}
+w0 = hvd.broadcast_parameters(w0, root_rank=0)
+tx = hvd.DistributedOptimizer(optax.sgd(0.05), name="dp.grads")
+opt_state = tx.init(w0)
+
+
+def loss_fn(p, xb, yb):
+    return jnp.mean((xb @ p["w"] - yb) ** 2)
+
+
+@jax.jit
+def step(p, o, xb, yb):
+    loss, g = jax.value_and_grad(loss_fn)(p, xb, yb)
+    updates, o = tx.update(g, o, p)
+    return optax.apply_updates(p, updates), o, loss
+
+
+p, o = w0, opt_state
+first = last = None
+for i in range(20):
+    p, o, loss = step(p, o, Xr, Yr)
+    if first is None:
+        first = float(loss)
+    last = float(loss)
+assert last < first * 0.2, (first, last)
+
+# All ranks must hold identical weights (grads were averaged identically).
+gathered = hvd.allgather(jnp.reshape(p["w"], (1, -1)), name="final.w")
+gw = np.asarray(gathered)
+assert gw.shape[0] == s
+assert np.allclose(gw, gw[0], atol=1e-6), gw
+
+# fp16 compression path (gradients cross the wire as float16)
+tx2 = hvd.DistributedOptimizer(optax.sgd(0.05), name="fp16.grads",
+                               compression=hvd.Compression.fp16)
+loss, g = jax.value_and_grad(loss_fn)(p, Xr, Yr)
+updates, _ = tx2.update(g, tx2.init(p), p)
+assert jax.tree.all(jax.tree.map(lambda u: bool(jnp.all(jnp.isfinite(u))), updates))
+assert updates["w"].dtype == jnp.float32  # decompressed back
+
+# metric averaging
+m = hvd.metric_average(float(r), name="metric.r")
+assert abs(m - np.mean(np.arange(s))) < 1e-9
+
+hvd.shutdown()
+print(f"rank {r}: JAX DP PASS", flush=True)
