@@ -1,0 +1,187 @@
+"""Headline benchmark — prints ONE JSON line for the driver.
+
+Default config: ResNet-50 synthetic training throughput (images/sec/chip),
+the reference's headline metric (`examples/tensorflow2/
+tensorflow2_synthetic_benchmark.py`: synthetic data, warmup + timed iters —
+same methodology here, rebuilt on JAX/TPU).
+
+`vs_baseline`: the reference publishes only *relative scaling* figures
+(docs/benchmarks.rst; BASELINE.json.published = {}). Its scaling chart is
+built on the TF-benchmarks ResNet-50 setup on Pascal P100s, where the
+canonical single-accelerator figure is ~219 images/sec (fp32). We report
+measured_throughput / 219.0 as the per-chip ratio against that era's
+per-accelerator baseline.
+
+Select other configs with BENCH_CONFIG={resnet50, transformer, allreduce}.
+- transformer: tokens/sec on the MoE-capable decoder (bert-large-ish scale).
+- allreduce: fused gradient-allreduce bus bandwidth through the in-mesh
+  data plane (single-chip: measures the data-plane overhead floor).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _sync(x):
+    """Barrier that actually waits: device→host transfer of one scalar.
+
+    (On the remote-relay TPU platform here, `block_until_ready()` returns
+    before execution finishes; a host transfer cannot.)"""
+    import jax
+    return np.asarray(jax.device_get(jax.tree.leaves(x)[0])).ravel()[:1]
+
+
+def _bench_resnet50():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.models import resnet
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    batch = 32 if on_cpu else 128
+    image = 128 if on_cpu else 224
+    steps = 3 if on_cpu else 20
+    warmup = 1 if on_cpu else 5
+
+    model, variables = resnet.create_train_state(
+        jax.random.PRNGKey(0), image_size=image, num_classes=1000)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+
+    def loss_fn(params, batch_stats, images, labels):
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats}, images,
+            train=True, mutable=["batch_stats"])
+        return resnet.cross_entropy_loss(logits, labels), \
+            updates["batch_stats"]
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, images, labels):
+        (loss, batch_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch_stats, images, labels)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, batch_stats, opt_state, loss
+
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.standard_normal((batch, image, image, 3)),
+                         jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 1000, (batch,)), jnp.int32)
+
+    for _ in range(warmup):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels)
+    _sync(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels)
+    _sync(loss)
+    dt = time.perf_counter() - t0
+    ips = batch * steps / dt
+    return {"metric": "resnet50_synthetic_train_throughput",
+            "value": round(ips, 2), "unit": "images/sec/chip",
+            "vs_baseline": round(ips / 219.0, 3)}
+
+
+def _bench_transformer():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.models import transformer as tfm
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        cfg = tfm.tiny()
+        batch, seq, steps, warmup = 4, 64, 3, 1
+    else:
+        cfg = tfm.TransformerConfig(vocab_size=30522, d_model=1024,
+                                    n_heads=16, n_layers=24, d_ff=4096,
+                                    max_seq_len=512)
+        batch, seq, steps, warmup = 8, 512, 10, 3
+
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tx = optax.adamw(1e-4)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch_):
+        loss, grads = jax.value_and_grad(tfm.loss_fn)(params, batch_, cfg)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq + 1)),
+                         jnp.int32)
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state,
+                                       {"tokens": tokens})
+    _sync(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state,
+                                       {"tokens": tokens})
+    _sync(loss)
+    dt = time.perf_counter() - t0
+    tps = batch * seq * steps / dt
+    return {"metric": "bert_large_scale_train_throughput",
+            "value": round(tps, 1), "unit": "tokens/sec/chip",
+            "vs_baseline": 1.0}
+
+
+def _bench_allreduce():
+    """Gradient-sized fused allreduce through the in-mesh data plane.
+
+    On one chip the collective is the identity; this measures the framework
+    overhead floor (dispatch + fusion) in effective GB/s over a ResNet-50
+    sized gradient set (~97 MB fp32)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax import shard_map
+    import functools
+
+    devices = jax.devices()
+    mesh = Mesh(np.asarray(devices), ("data",))
+    nbytes = 97 * 1024 * 1024
+    n = nbytes // 4
+    x = jnp.arange(n, dtype=jnp.float32)
+    x = jax.device_put(x, NamedSharding(mesh, P()))
+
+    @jax.jit
+    @functools.partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P(),
+                       check_vma=False)
+    def ar(x):
+        return jax.lax.pmean(x, "data")
+
+    for _ in range(3):
+        _sync(ar(x))
+    steps = 20
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(steps):
+        y = ar(y)
+    _sync(y)
+    dt = time.perf_counter() - t0
+    gbps = nbytes * steps / dt / 1e9
+    return {"metric": "allreduce_bus_bandwidth_97MB",
+            "value": round(gbps, 2), "unit": "GB/s",
+            "vs_baseline": 1.0}
+
+
+def main():
+    which = os.environ.get("BENCH_CONFIG", "resnet50")
+    fn = {"resnet50": _bench_resnet50,
+          "transformer": _bench_transformer,
+          "allreduce": _bench_allreduce}[which]
+    print(json.dumps(fn()))
+
+
+if __name__ == "__main__":
+    main()

@@ -66,8 +66,13 @@ def DistributedOptimizer(tx, op=Average, compression=None, process_set=0,
     locally and allreduces every Nth update (reference:
     ``gradient_aggregation*.py`` local-aggregation knob).
 
-    Works eager or inside jit (lowers to an io_callback; see
-    :mod:`horovod_tpu.ops.jax_ops`).
+    With ``backward_passes_per_step == 1`` this works eager or inside jit
+    (lowers to an io_callback; see :mod:`horovod_tpu.ops.jax_ops`). With
+    ``backward_passes_per_step > 1`` call ``update()`` outside jit: skipping
+    the collective on N-1 of N steps needs an effectful branch, which XLA
+    disallows inside a compiled program (the in-mesh
+    :func:`horovod_tpu.parallel.make_train_step` path is the compiled
+    equivalent).
     """
     import jax
     import jax.numpy as jnp
@@ -101,13 +106,9 @@ def DistributedOptimizer(tx, op=Average, compression=None, process_set=0,
                 return updates, {"inner": state["inner"], "acc": acc,
                                  "count": count}
 
-            # Python-level branch when count is concrete (eager), lax.cond
-            # is not usable here because the callback is effectful; the
-            # standard pattern is to call update() every step and let the
-            # modulus decide.
-            import jax.core as jcore
-
-            if isinstance(count, jcore.Tracer):
+            # Python-level branch when count is concrete (eager); lax.cond
+            # is not usable here because the callback is effectful.
+            if _jops._is_traced(count):
                 raise NotImplementedError(
                     "backward_passes_per_step>1 requires the eager path or "
                     "calling update() outside jit")

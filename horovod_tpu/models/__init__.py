@@ -1,0 +1,17 @@
+"""Model zoo for benchmarks and examples.
+
+Reference parity: the reference ships benchmark/example models under
+`examples/` (`examples/tensorflow2/tensorflow2_synthetic_benchmark.py` uses
+Keras ResNet-50; `examples/pytorch/` has BERT fine-tuning). Here the models
+are first-class package members because they are also the vehicles for the
+TPU-native parallelism demos (tensor/sequence/expert sharding) that the
+reference's pure-DP design never needed.
+
+- :mod:`.resnet` — ResNet-50 v1.5 in flax (headline images/sec benchmark).
+- :mod:`.transformer` — decoder-style Transformer with optional MoE, written
+  in pure JAX with an explicit parameter pytree and a mirrored
+  PartitionSpec pytree (dp/tp/sp/ep shardings over a Mesh).
+"""
+
+from . import resnet  # noqa: F401
+from . import transformer  # noqa: F401

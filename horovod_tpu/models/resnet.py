@@ -1,0 +1,102 @@
+"""ResNet-50 v1.5 in flax — the headline benchmark model.
+
+Reference parity: the reference's throughput story is ResNet-50 images/sec
+(`examples/tensorflow2/tensorflow2_synthetic_benchmark.py`, which pulls
+`tf.keras.applications.ResNet50`; `docs/benchmarks.rst` scaling chart).
+This is a fresh flax implementation, bfloat16 compute / float32 params —
+the TPU-native dtype split (MXU eats bf16; BN stats and the optimizer state
+stay fp32 for stability).
+
+v1.5 variant: the 3x3 conv in the bottleneck carries the stride (not the
+1x1), matching what the common benchmark numbers measure.
+"""
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), strides=(self.strides,) * 2,
+                      name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1), name="conv3")(y)
+        y = self.norm(scale_init=nn.initializers.zeros, name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1),
+                                 strides=(self.strides,) * 2,
+                                 name="proj")(residual)
+            residual = self.norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), strides=(2, 2),
+                 padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BottleneckBlock(self.num_filters * 2 ** i, strides,
+                                    conv=conv, norm=norm,
+                                    name=f"stage{i + 1}_block{j + 1}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet50(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes,
+                  dtype=dtype)
+
+
+def ResNet101(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 23, 3), num_classes=num_classes,
+                  dtype=dtype)
+
+
+def create_train_state(rng, image_size: int = 224, num_classes: int = 1000,
+                       dtype=jnp.bfloat16, model=None):
+    """Init params/batch_stats on a dummy batch. Returns (model, variables)."""
+    model = model or ResNet50(num_classes=num_classes, dtype=dtype)
+    dummy = jnp.ones((1, image_size, image_size, 3), jnp.float32)
+    variables = jax.jit(partial(model.init, train=False))(rng, dummy)
+    return model, variables
+
+
+def cross_entropy_loss(logits, labels):
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))

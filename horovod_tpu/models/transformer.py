@@ -1,0 +1,265 @@
+"""Decoder Transformer (optionally MoE) in pure JAX with explicit shardings.
+
+This is the parallelism flagship: one model that exercises every axis the
+framework supports on a `jax.sharding.Mesh`:
+
+- **dp**   — batch dim sharded over the ``data`` axis (the reference's whole
+  product: `DistributedOptimizer` ring-allreduce, SURVEY.md §2.4).
+- **tp**   — Megatron-style column/row-parallel matmuls over the ``model``
+  axis; XLA inserts the psum after row-parallel projections.
+- **sp**   — activations sequence-sharded over the ``seq`` axis between
+  blocks; attention gathers K/V (Ulysses-style alltoall is available in
+  :mod:`horovod_tpu.parallel`).
+- **ep**   — MoE expert dim sharded over the ``expert`` axis (reference
+  exposes only the `hvd.alltoall` primitive for this — BASELINE.json names
+  the MoE dispatch pattern as a graded config).
+
+Written as an explicit parameter pytree + a mirrored PartitionSpec pytree
+(`param_specs`) instead of framework metadata, so the sharding story is
+auditable in one screen. bfloat16 activations, float32 params.
+
+Reference parity anchors: `examples/pytorch` BERT fine-tune (model scale),
+`horovod/common/ops/*_operations.cc` `*Alltoall` (the EP primitive).
+"""
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32768
+    d_model: int = 1024
+    n_heads: int = 16
+    n_layers: int = 24
+    d_ff: int = 4096
+    max_seq_len: int = 2048
+    n_experts: int = 0          # 0 = dense FFN; >0 = MoE every layer
+    dtype: str = "bfloat16"
+    # mesh axis names (any may be absent from the actual mesh; specs using a
+    # missing name are invalid, so axes not in the mesh must be None'd via
+    # `filter_specs`)
+    data_axis: str = "data"
+    model_axis: str = "model"
+    seq_axis: str = "seq"
+    expert_axis: str = "expert"
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def bert_large() -> TransformerConfig:
+    """BERT-large scale (340M): the reference's second graded config."""
+    return TransformerConfig(vocab_size=30522, d_model=1024, n_heads=16,
+                             n_layers=24, d_ff=4096, max_seq_len=512)
+
+
+def tiny(n_experts: int = 0) -> TransformerConfig:
+    """Tiny config for tests and the multi-chip dry run."""
+    return TransformerConfig(vocab_size=256, d_model=64, n_heads=4,
+                             n_layers=2, d_ff=128, max_seq_len=64,
+                             n_experts=n_experts)
+
+
+# ---------------------------------------------------------------------------
+# Params
+
+def _dense_init(key, shape, fan_in):
+    return (jax.random.normal(key, shape, jnp.float32)
+            / math.sqrt(fan_in)).astype(jnp.float32)
+
+
+def init_params(key, cfg: TransformerConfig):
+    keys = jax.random.split(key, cfg.n_layers + 2)
+    D, F, H, dh = cfg.d_model, cfg.d_ff, cfg.n_heads, cfg.head_dim
+    params = {
+        "embed": jax.random.normal(keys[0], (cfg.vocab_size, D),
+                                   jnp.float32) * 0.02,
+        "pos_embed": jax.random.normal(keys[1], (cfg.max_seq_len, D),
+                                       jnp.float32) * 0.02,
+        "final_ln": {"scale": jnp.ones((D,), jnp.float32),
+                     "bias": jnp.zeros((D,), jnp.float32)},
+        "layers": [],
+    }
+    for i in range(cfg.n_layers):
+        k = jax.random.split(keys[2 + i], 8)
+        layer = {
+            "ln1": {"scale": jnp.ones((D,)), "bias": jnp.zeros((D,))},
+            "ln2": {"scale": jnp.ones((D,)), "bias": jnp.zeros((D,))},
+            # column-parallel fused QKV [D, 3, H, dh]; row-parallel out
+            "wqkv": _dense_init(k[0], (D, 3, H, dh), D),
+            "wo": _dense_init(k[1], (H, dh, D), D),
+        }
+        if cfg.n_experts > 0:
+            E = cfg.n_experts
+            layer["router"] = _dense_init(k[2], (D, E), D)
+            layer["w_in"] = _dense_init(k[3], (E, D, F), D)
+            layer["w_out"] = _dense_init(k[4], (E, F, D), F)
+        else:
+            layer["w_in"] = _dense_init(k[3], (D, F), D)
+            layer["w_out"] = _dense_init(k[4], (F, D), F)
+        params["layers"].append(layer)
+    return params
+
+
+def param_specs(cfg: TransformerConfig):
+    """PartitionSpec pytree mirroring `init_params` output.
+
+    tp: QKV/FFN-in column-parallel (shard output dim on `model`), out
+    projections row-parallel (shard input dim on `model`). ep: expert dim on
+    `expert`. Embeddings vocab-sharded on `model` (XLA all-gathers for the
+    tiny lookup, keeps the big table distributed).
+    """
+    m, e = cfg.model_axis, cfg.expert_axis
+    layer = {
+        "ln1": {"scale": P(), "bias": P()},
+        "ln2": {"scale": P(), "bias": P()},
+        "wqkv": P(None, None, m, None),   # heads sharded over model axis
+        "wo": P(m, None, None),           # row-parallel
+    }
+    if cfg.n_experts > 0:
+        layer["router"] = P()
+        layer["w_in"] = P(e, None, m)
+        layer["w_out"] = P(e, m, None)
+    else:
+        layer["w_in"] = P(None, m)
+        layer["w_out"] = P(m, None)
+    return {
+        "embed": P(m, None),
+        "pos_embed": P(),
+        "final_ln": {"scale": P(), "bias": P()},
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+def filter_specs(specs, mesh):
+    """Drop axis names not present in `mesh` from every spec (so one model
+    definition serves any mesh shape — dp-only, dp×tp, dp×tp×sp×ep...)."""
+    names = set(mesh.axis_names)
+
+    def fix(spec):
+        if not isinstance(spec, P):
+            return spec
+        return P(*[(a if (a in names) else None) for a in spec])
+
+    return jax.tree.map(fix, specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+# ---------------------------------------------------------------------------
+# Forward
+
+def _layer_norm(x, p, eps=1e-5):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    y = (x - mu) * jax.lax.rsqrt(var + eps)
+    return y * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
+
+
+def _attention(x, layer, cfg, seq_spec=None, full_spec=None):
+    """Causal multi-head attention. With specs given, activations arrive
+    seq-sharded and K/V are materialised full-sequence (XLA all-gather over
+    the seq axis); the ring-attention variant lives in
+    horovod_tpu.parallel.ring_attention. With specs None this is ordinary
+    single-device attention."""
+    def constrain(y, spec):
+        return jax.lax.with_sharding_constraint(y, spec) \
+            if spec is not None else y
+
+    dt = cfg.compute_dtype
+    qkv = jnp.einsum("bsd,dchk->cbshk", x, layer["wqkv"].astype(dt))
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    # gather sequence for attention (sp boundary)
+    k = constrain(k, full_spec)
+    v = constrain(v, full_spec)
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    logits = jnp.einsum("bshk,bthk->bhst", q, k) * scale
+    s, t = logits.shape[-2], logits.shape[-1]
+    mask = jnp.tril(jnp.ones((t, t), bool))[-s:, :]
+    logits = jnp.where(mask, logits, jnp.finfo(dt).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(dt)
+    ctx = jnp.einsum("bhst,bthk->bshk", probs, v)
+    out = jnp.einsum("bshk,hkd->bsd", ctx, layer["wo"].astype(dt))
+    return constrain(out, seq_spec)
+
+
+def _moe_ffn(x, layer, cfg):
+    """Top-1 routed MoE, dense dispatch (einsum over one-hot routing masks —
+    compilable, exact). Expert weights are ep-sharded; XLA turns the einsum
+    over the expert dim into compute local to each expert shard plus a psum.
+    The bandwidth-optimal alltoall dispatch is in
+    horovod_tpu.parallel.expert_parallel."""
+    dt = cfg.compute_dtype
+    gates = jnp.einsum("bsd,de->bse", x, layer["router"].astype(dt))
+    gate_w = jax.nn.softmax(gates.astype(jnp.float32), -1)
+    top = jnp.argmax(gate_w, -1)
+    mask = jax.nn.one_hot(top, cfg.n_experts, dtype=dt)          # [b,s,E]
+    w = jnp.sum(gate_w.astype(dt) * mask, -1, keepdims=True)     # [b,s,1]
+    h = jnp.einsum("bsd,edf->bsef", x, layer["w_in"].astype(dt))
+    h = jax.nn.gelu(h)
+    y = jnp.einsum("bsef,efd->bsed", h, layer["w_out"].astype(dt))
+    return jnp.einsum("bsed,bse->bsd", y, mask) * w
+
+
+def _ffn(x, layer, cfg):
+    dt = cfg.compute_dtype
+    h = jax.nn.gelu(jnp.einsum("bsd,df->bsf", x, layer["w_in"].astype(dt)))
+    return jnp.einsum("bsf,fd->bsd", h, layer["w_out"].astype(dt))
+
+
+def forward(params, tokens, cfg: TransformerConfig, mesh=None):
+    """tokens [B, S] int32 → logits [B, S, vocab] (compute dtype).
+
+    When `mesh` is given, activations carry dp/sp sharding constraints; with
+    mesh=None it is ordinary single-device JAX.
+    """
+    dt = cfg.compute_dtype
+    if mesh is not None:
+        names = set(mesh.axis_names)
+        d = cfg.data_axis if cfg.data_axis in names else None
+        s = cfg.seq_axis if cfg.seq_axis in names else None
+        seq_spec = jax.sharding.NamedSharding(mesh, P(d, s, None))
+        full_spec = jax.sharding.NamedSharding(mesh, P(d, None, None))
+    else:
+        seq_spec = full_spec = None
+
+    def constrain(x, spec):
+        return jax.lax.with_sharding_constraint(x, spec) if spec is not None \
+            else x
+
+    B, S = tokens.shape
+    x = params["embed"].astype(dt)[tokens]
+    x = x + params["pos_embed"].astype(dt)[:S][None]
+    x = constrain(x, seq_spec)
+    for layer in params["layers"]:
+        h = _layer_norm(x, layer["ln1"])
+        x = x + _attention(h, layer, cfg, seq_spec, full_spec)
+        h = _layer_norm(x, layer["ln2"])
+        if cfg.n_experts > 0:
+            x = x + _moe_ffn(h, layer, cfg)
+        else:
+            x = x + _ffn(h, layer, cfg)
+        x = constrain(x, seq_spec)
+    x = _layer_norm(x, params["final_ln"])
+    logits = jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(dt))
+    return logits
+
+
+def loss_fn(params, batch, cfg: TransformerConfig, mesh=None):
+    """Next-token cross-entropy. batch = {"tokens": [B, S+1] int32}."""
+    tokens = batch["tokens"]
+    logits = forward(params, tokens[:, :-1], cfg, mesh=mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+    return jnp.mean(nll)

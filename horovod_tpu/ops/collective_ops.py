@@ -161,6 +161,9 @@ def _collect_result(handle):
 
 def allreduce_async(tensor, op=Average, name=None, prescale_factor=1.0,
                     postscale_factor=1.0, process_set=0, _group=(-1, 0)):
+    # np.ascontiguousarray promotes 0-d to 1-d; hand the caller back a 0-d
+    # view of the same buffer so scalar leaves keep their shape.
+    orig_shape = np.shape(tensor)
     arr = np.ascontiguousarray(tensor)
     out = np.empty_like(arr)
     name = _auto_name("allreduce", name)
@@ -169,7 +172,8 @@ def allreduce_async(tensor, op=Average, name=None, prescale_factor=1.0,
         name.encode(), _ptr(arr), _ptr(out), shape, ndim, _dtype_code(arr),
         int(op), float(prescale_factor), float(postscale_factor),
         int(process_set), _group[0], _group[1]))
-    return _register(Handle(h, "allreduce", (arr,), out, arr.dtype, name))
+    return _register(Handle(h, "allreduce", (arr,), out.reshape(orig_shape),
+                            arr.dtype, name))
 
 
 def allreduce(tensor, op=Average, name=None, prescale_factor=1.0,
@@ -223,6 +227,7 @@ def allgather(tensor, name=None, process_set=0):
 # Broadcast
 
 def broadcast_async(tensor, root_rank, name=None, process_set=0):
+    orig_shape = np.shape(tensor)  # keep 0-d leaves 0-d (see allreduce)
     arr = np.ascontiguousarray(tensor)
     out = arr.copy()
     name = _auto_name("broadcast", name)
@@ -230,7 +235,8 @@ def broadcast_async(tensor, root_rank, name=None, process_set=0):
     h = _check_handle(_lib.hvd_broadcast_async(
         name.encode(), _ptr(arr), _ptr(out), shape, ndim, _dtype_code(arr),
         int(root_rank), int(process_set)))
-    return _register(Handle(h, "broadcast", (arr,), out, arr.dtype, name))
+    return _register(Handle(h, "broadcast", (arr,), out.reshape(orig_shape),
+                            arr.dtype, name))
 
 
 def broadcast(tensor, root_rank, name=None, process_set=0):

@@ -11,11 +11,22 @@ import sys
 # Force CPU for tests even when the session env selects a TPU platform
 # (bench.py and __graft_entry__.py are the TPU surfaces, not the test suite).
 os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if "xla_force_host_platform_device_count" not in f]
+_flags.append("--xla_force_host_platform_device_count=8")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
+
+# A site hook may have pre-imported jax and pinned jax_platforms to a TPU
+# plugin; env vars alone are then ignored. Override the live config too.
+# Best-effort: pure-core tests must still run without jax / with a stuck
+# backend (jax-dependent test modules importorskip and assert devices
+# themselves).
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 # Make the repo importable for spawned worker subprocesses too.
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

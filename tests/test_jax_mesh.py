@@ -48,6 +48,9 @@ def test_allgather(mesh):
     # Each shard gathers the full array; with out_spec P('data') the global
     # result is 8 stacked copies of rows.
     assert out.shape == (64, 2)
+    got = np.asarray(out).reshape(8, 8, 2)
+    exp = np.broadcast_to(np.arange(16.0).reshape(8, 2), (8, 8, 2))
+    assert np.allclose(got, exp)
 
 
 def test_broadcast(mesh):

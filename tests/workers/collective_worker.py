@@ -33,6 +33,11 @@ assert np.allclose(outs[1], 2 * sum(range(s)))
 # fp16 + bf16
 h = hvd.allreduce(np.full(7, 1.0, dtype=np.float16), op=hvd.Sum)
 assert np.allclose(h.astype(np.float32), s)
+# 0-d scalars keep their shape (regression: ascontiguousarray promotes to 1-d)
+sc = hvd.allreduce(np.float32(r + 1), op=hvd.Sum)
+assert np.shape(sc) == () and float(sc) == s * (s + 1) / 2, sc
+sb = hvd.broadcast(np.float64(r), root_rank=0)
+assert np.shape(sb) == () and float(sb) == 0.0, sb
 # adasum (power of 2 sizes only)
 if s & (s - 1) == 0:
     a = hvd.allreduce(np.full(9, float(r + 1), np.float32), op=hvd.Adasum)
