@@ -63,7 +63,19 @@ from .process_sets import (  # noqa: F401
     remove_process_set,
 )
 
-init = _basics.init
+def init():
+    """Initialize the core. Under an elastic job (HVD_ELASTIC=1, spawned by
+    `tpurun --min-np/...`) this first rendezvouses with the driver's KV
+    store for the current epoch's rank/size/controller assignment."""
+    import os as _os
+
+    if _os.environ.get("HVD_ELASTIC") == "1":
+        from .runner.elastic import worker as _worker
+
+        return _worker.rendezvous_init()
+    return _basics.init()
+
+
 shutdown = _basics.shutdown
 is_initialized = _basics.is_initialized
 rank = _basics.rank
@@ -92,3 +104,6 @@ def tpu_built():
         return any(d.platform.startswith(("tpu", "axon")) for d in jax.devices())
     except Exception:
         return False
+
+
+from . import elastic  # noqa: F401,E402  (hvd.elastic.run / State / ObjectState)

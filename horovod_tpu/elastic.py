@@ -1,0 +1,174 @@
+"""Elastic training: state commit/restore/sync + the retry loop.
+
+Reference parity: `horovod/common/elastic.py` (`State`, `ObjectState`,
+`run_fn`) — the framework-agnostic heart of `hvd.elastic.run`:
+
+    @hvd.elastic.run
+    def train(state):
+        for batch in ...:
+            ...
+            state.commit()
+
+    state = hvd.elastic.ObjectState(model=..., optimizer=..., batch=0)
+    train(state)
+
+Semantics (SURVEY.md §3.4):
+- `HorovodInternalError` (a peer died mid-collective) → `state.restore()`
+  to the last `commit()`, re-rendezvous, `state.sync()`, retry.
+- `HostsUpdatedInterrupt` (membership changed) → re-rendezvous and
+  `state.sync()` WITHOUT rollback (no work lost).
+- `commit()` = save to host RAM + check for pending host updates.
+
+Re-rendezvous on this build = shutdown the native core, fetch the new
+epoch's rank/size/controller assignment from the driver's KV store, and
+re-init (see `horovod_tpu.runner.elastic.worker`).
+"""
+
+import copy
+import functools
+
+from .exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from .ops import collective_ops as _core
+
+
+class State:
+    """Base elastic state. Subclasses implement save/restore/sync."""
+
+    def __init__(self):
+        self._reset_callbacks = []
+        self._host_messages_pending = False
+
+    def register_reset_callbacks(self, callbacks):
+        """Callbacks invoked after every re-rendezvous (reference: used to
+        rebuild optimizer internals for the new world size)."""
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self):
+        self._host_messages_pending = False
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def on_hosts_updated(self):
+        self._host_messages_pending = True
+
+    def commit(self):
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self):
+        if self._host_messages_pending:
+            self._host_messages_pending = False
+            raise HostsUpdatedInterrupt("hosts updated")
+
+    # Subclass surface ----------------------------------------------------
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class ObjectState(State):
+    """State holding arbitrary picklable attributes (reference:
+    `ObjectState`): save = deep-copy to host RAM; sync = broadcast from
+    rank 0; restore = reload last save."""
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._attrs = dict(kwargs)
+        self._saved = copy.deepcopy(self._attrs)
+
+    def __getattr__(self, name):
+        attrs = object.__getattribute__(self, "__dict__").get("_attrs", {})
+        if name in attrs:
+            return attrs[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name.startswith("_") or "_attrs" not in self.__dict__:
+            object.__setattr__(self, name, value)
+        else:
+            self._attrs[name] = value
+
+    def save(self):
+        self._saved = copy.deepcopy(self._attrs)
+
+    def restore(self):
+        self._attrs = copy.deepcopy(self._saved)
+
+    def sync(self):
+        self._attrs = _core.broadcast_object(self._attrs, root_rank=0,
+                                             name="elastic.object_state")
+        self.save()
+
+
+class JaxState(ObjectState):
+    """ObjectState for JAX pytrees (params / optax opt_state): leaves are
+    pulled to host numpy before the pickle broadcast (device Arrays don't
+    pickle portably) and re-placed on the default device afterwards.
+    (Reference analog: `TensorFlowKerasState` / `TorchState` — framework
+    states that know how to move tensors.)"""
+
+    def sync(self):
+        import numpy as np
+
+        import jax
+
+        def to_host(x):
+            return np.asarray(x) if isinstance(x, jax.Array) else x
+
+        host = jax.tree.map(to_host, self._attrs)
+        synced = _core.broadcast_object(host, root_rank=0,
+                                        name="elastic.jax_state")
+        self._attrs = jax.tree.map(
+            lambda x: jax.device_put(x) if isinstance(x, np.ndarray) else x,
+            synced)
+        self.save()
+
+
+def run_fn(func, reset):
+    """Build the elastic retry wrapper around `func(state, ...)`.
+
+    `reset()` performs re-rendezvous (shutdown → new assignment → init).
+    """
+    @functools.wraps(func)
+    def wrapper(state, *args, **kwargs):
+        from .runner.elastic import worker as _worker
+
+        _worker.notification_manager.init()
+        _worker.notification_manager.register_listener(state)
+
+        reset_required = False
+        try:
+            while True:
+                if reset_required:
+                    reset()
+                    state.on_reset()
+                    reset_required = False
+                state.sync()
+                try:
+                    return func(state, *args, **kwargs)
+                except HorovodInternalError:
+                    state.restore()
+                    reset_required = True
+                except HostsUpdatedInterrupt:
+                    reset_required = True
+        finally:
+            _worker.notification_manager.remove_listener(state)
+
+    return wrapper
+
+
+def run(func):
+    """`@hvd.elastic.run` decorator (reference: horovod/tensorflow/elastic
+    `run` / common run_fn)."""
+    from .runner.elastic import worker as _worker
+
+    return run_fn(func, _worker.rendezvous_reset)

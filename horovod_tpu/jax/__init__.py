@@ -45,7 +45,13 @@ from ..process_sets import (  # noqa: F401
     remove_process_set,
 )
 
-init = _basics.init
+def init():
+    """Elastic-aware init (see horovod_tpu.init)."""
+    import horovod_tpu as _pkg
+
+    return _pkg.init()
+
+
 shutdown = _basics.shutdown
 is_initialized = _basics.is_initialized
 rank = _basics.rank
@@ -145,3 +151,4 @@ def metric_average(value, name=None):
     arr = np.asarray(value, dtype=np.float64).reshape(1)
     out = _core.allreduce(arr, op=Average, name=name or "metric.avg")
     return float(out[0])
+from .. import elastic  # noqa: F401  (hvd.elastic parity)

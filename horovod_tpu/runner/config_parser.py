@@ -1,0 +1,83 @@
+"""Config plumbing: YAML file ↔ CLI args ↔ HVD_* env.
+
+Reference parity: `horovod/runner/common/util/config_parser.py` — one
+namespace, three layers. Env is the ground truth workers see; CLI overrides
+file; file overrides nothing already set on the command line.
+
+YAML schema (any subset):
+
+    params:
+      fusion-threshold-mb: 64
+      cycle-time-ms: 1.0
+      cache-capacity: 1024
+    timeline:
+      filename: /tmp/tl.json
+      mark-cycles: true
+    stall-check:
+      disable: false
+      warning-time-seconds: 60
+      shutdown-time-seconds: 0
+    autotune:
+      enable: true
+      log-file: /tmp/autotune.csv
+"""
+
+# arg attribute name → (env var, transform-to-env)
+_MB = 1024 * 1024
+ARG_TO_ENV = {
+    "fusion_threshold_mb": ("HVD_FUSION_THRESHOLD",
+                            lambda v: str(int(float(v) * _MB))),
+    "cycle_time_ms": ("HVD_CYCLE_TIME_MS", str),
+    "cache_capacity": ("HVD_CACHE_CAPACITY", str),
+    "timeline_filename": ("HVD_TIMELINE", str),
+    "timeline_mark_cycles": ("HVD_TIMELINE_MARK_CYCLES",
+                             lambda v: "1" if v else "0"),
+    "stall_check_warning_time_seconds": ("HVD_STALL_CHECK_TIME_SECONDS",
+                                         str),
+    "stall_check_shutdown_time_seconds": ("HVD_STALL_SHUTDOWN_TIME_SECONDS",
+                                          str),
+    "autotune": ("HVD_AUTOTUNE", lambda v: "1" if v else "0"),
+    "autotune_log_file": ("HVD_AUTOTUNE_LOG", str),
+    "start_timeout": ("HVD_START_TIMEOUT", str),
+    "log_level": ("HVD_LOG_LEVEL", str),
+}
+
+_FILE_SECTIONS = {
+    "params": {"fusion-threshold-mb": "fusion_threshold_mb",
+               "cycle-time-ms": "cycle_time_ms",
+               "cache-capacity": "cache_capacity"},
+    "timeline": {"filename": "timeline_filename",
+                 "mark-cycles": "timeline_mark_cycles"},
+    "stall-check": {"warning-time-seconds":
+                    "stall_check_warning_time_seconds",
+                    "shutdown-time-seconds":
+                    "stall_check_shutdown_time_seconds"},
+    "autotune": {"enable": "autotune", "log-file": "autotune_log_file"},
+}
+
+
+def apply_config_file(args, path):
+    """Fill unset attributes on `args` from a YAML config file."""
+    import yaml
+
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    for section, mapping in _FILE_SECTIONS.items():
+        for key, attr in mapping.items():
+            if section in data and key in data[section]:
+                # `is None` (not falsy): an explicit CLI 0 must beat the file
+                if getattr(args, attr, None) is None:
+                    setattr(args, attr, data[section][key])
+    if "stall-check" in data and data["stall-check"].get("disable"):
+        args.stall_check_warning_time_seconds = 0
+    return args
+
+
+def args_to_env(args):
+    """Collect the HVD_* env this argparse namespace implies."""
+    env = {}
+    for attr, (var, conv) in ARG_TO_ENV.items():
+        v = getattr(args, attr, None)
+        if v is not None and v is not False:
+            env[var] = conv(v)
+    return env

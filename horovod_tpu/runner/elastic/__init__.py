@@ -1,0 +1,8 @@
+"""Elastic launcher machinery (reference: horovod/runner/elastic/).
+
+- :mod:`.discovery` — host discovery (user script → {host: slots}).
+- :mod:`.driver` — ElasticDriver: membership monitoring, worker lifecycle,
+  blacklisting, epoch-based rendezvous over the HTTP KV store.
+- :mod:`.worker` — worker-side rendezvous client + host-update
+  notification polling.
+"""

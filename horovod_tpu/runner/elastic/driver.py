@@ -1,0 +1,303 @@
+"""ElasticDriver: membership monitoring, worker lifecycle, rendezvous epochs.
+
+Reference parity: `horovod/runner/elastic/driver.py` (`ElasticDriver`),
+`registration.py`, `rendezvous.py`. The driver owns the HTTP KV store;
+each membership change creates a new *epoch*: a fresh rank assignment +
+controller address written to the KV store. Workers poll the epoch counter
+(see `.worker`) and re-rendezvous. Hosts that keep failing are blacklisted
+for a cooldown (reference blacklists forever by default; cooldown matches
+its `--blacklist-cooldown-range` option).
+"""
+
+import json
+import os
+import time
+import uuid
+
+from .. import http_server, util
+from ..hosts import HostInfo, get_host_assignments, is_local
+from ..local import find_free_port
+from .discovery import FixedHosts, HostDiscoveryScript
+
+DISCOVERY_INTERVAL_S = 1.0
+FAILURE_WINDOW_S = 60.0
+FAILURES_TO_BLACKLIST = 3
+DEFAULT_COOLDOWN_RANGE = (10.0, 60.0)
+
+
+class _Worker:
+    def __init__(self, worker_id, hostname, slot, proc, spawn_epoch):
+        self.id = worker_id
+        self.hostname = hostname
+        self.slot = slot
+        self.proc = proc
+        self.spawn_epoch = spawn_epoch
+        self.exit_code = None
+
+    @property
+    def alive(self):
+        return self.exit_code is None and self.proc.poll() is None
+
+
+class ElasticDriver:
+    def __init__(self, command, discovery, min_np, max_np, extra_env=None,
+                 verbose=False, cooldown_range=None):
+        self.command = list(command)
+        self.discovery = discovery
+        self.min_np = min_np
+        self.max_np = max_np
+        self.extra_env = dict(extra_env or {})
+        self.verbose = verbose
+        self.cooldown_range = cooldown_range or DEFAULT_COOLDOWN_RANGE
+        self.rdv = http_server.RendezvousServer(addr="0.0.0.0")
+        self.rdv_port = self.rdv.start()
+        self.epoch = -1
+        self.workers = {}            # id -> _Worker
+        self._host_failures = {}     # host -> [timestamps]
+        self._blacklist_until = {}   # host -> ts
+        self._excluded = set()       # worker ids told to exit (not successes)
+        self._success_seen = False
+        self._wind_down_failed = False
+        self.ssh_port = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def _log(self, msg):
+        if self.verbose:
+            print(f"[elastic-driver] {msg}", flush=True)
+
+    def _spawn(self, hostname, slot):
+        wid = f"{hostname}-{slot}-{uuid.uuid4().hex[:8]}"
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env["HVD_ELASTIC"] = "1"
+        rdv_host = "127.0.0.1" if is_local(hostname) else _my_addr()
+        env["HVD_RENDEZVOUS_ADDR"] = f"{rdv_host}:{self.rdv_port}"
+        env["HVD_WORKER_ID"] = wid
+        # The first epoch that can possibly include this worker: wait for it
+        # instead of latching onto a stale current epoch whose assignment
+        # table will never contain this id.
+        env["HVD_SPAWN_EPOCH"] = str(self.epoch + 1)
+        if is_local(hostname):
+            proc = util.safe_exec(self.command, env=env)
+        else:
+            from ..launch import get_remote_command
+
+            class _S:  # SlotInfo stand-in for hostname only
+                pass
+
+            s = _S()
+            s.hostname = hostname
+            cmd = get_remote_command(s, self.command, {
+                k: v for k, v in env.items()
+                if k.startswith(("HVD_", "PYTHONPATH", "PATH"))},
+                ssh_port=self.ssh_port)
+            proc = util.safe_exec(["/bin/sh", "-c", cmd],
+                                  env=dict(os.environ))
+        w = _Worker(wid, hostname, slot, proc, self.epoch + 1)
+        self.workers[wid] = w
+        self._log(f"spawned {wid}")
+        return w
+
+    def _blacklisted(self, host, now):
+        return self._blacklist_until.get(host, 0) > now
+
+    def _record_failure(self, host):
+        now = time.time()
+        lst = [t for t in self._host_failures.get(host, [])
+               if now - t < FAILURE_WINDOW_S]
+        lst.append(now)
+        self._host_failures[host] = lst
+        if len(lst) >= FAILURES_TO_BLACKLIST:
+            lo, hi = self.cooldown_range
+            cooldown = min(hi, max(lo, lo * (2 ** (len(lst) -
+                                                   FAILURES_TO_BLACKLIST))))
+            self._blacklist_until[host] = now + cooldown
+            self._log(f"blacklisting {host} for {cooldown:.0f}s")
+
+    # -- epochs -----------------------------------------------------------
+
+    def _new_epoch(self, desired=None):
+        """Publish a new rank assignment. Workers on hosts no longer in
+        `desired` membership (scale-down / blacklist) get the "exit"
+        directive — unless dropping them would go below min_np."""
+        self.epoch += 1
+        alive = sorted((w for w in self.workers.values() if w.alive),
+                       key=lambda w: (w.spawn_epoch, w.hostname, w.slot))
+        active, extra = [], []
+        per_host = {}
+        for w in alive:
+            n = per_host.get(w.hostname, 0)
+            host_cap = desired.get(w.hostname, 0) if desired is not None \
+                else float("inf")
+            cap = self.max_np or float("inf")
+            if n < host_cap and len(active) < cap:
+                active.append(w)
+                per_host[w.hostname] = n + 1
+            else:
+                extra.append(w)
+        if len(active) < self.min_np and extra:
+            # keep excess workers rather than dropping below min_np
+            keep = extra[:self.min_np - len(active)]
+            active += keep
+            extra = extra[len(keep):]
+
+        # host-major assignment over the active workers
+        by_host = {}
+        for w in active:
+            by_host.setdefault(w.hostname, []).append(w)
+        hosts = [HostInfo(h, len(ws)) for h, ws in by_host.items()]
+        slots = get_host_assignments(hosts, len(active))
+        ordered = [w for h, ws in by_host.items() for w in ws]
+
+        rank0_host = slots[0].hostname
+        if is_local(rank0_host):
+            ctrl_host, port = "127.0.0.1", find_free_port()
+        else:
+            # Cannot probe a remote host's ports from here; pick from a
+            # high range to make collisions unlikely. The port advances
+            # every epoch, so a collision self-heals on the next failure.
+            import random
+            ctrl_host = rank0_host
+            port = random.randint(23000, 43000)
+        ctrl = f"{ctrl_host}:{port}"
+        for w, s in zip(ordered, slots):
+            a = {"rank": s.rank, "size": s.size,
+                 "local_rank": s.local_rank, "local_size": s.local_size,
+                 "cross_rank": s.cross_rank, "cross_size": s.cross_size,
+                 "controller": ctrl}
+            self.rdv.put(f"/assign-{self.epoch}/{w.id}",
+                         json.dumps(a).encode())
+        for w in extra:
+            self._excluded.add(w.id)
+            self.rdv.put(f"/assign-{self.epoch}/{w.id}", b"exit")
+        self.rdv.put("/ctl/epoch", str(self.epoch).encode())
+        self._log(f"epoch {self.epoch}: {len(active)} active "
+                  f"({[w.id for w in active]}), ctrl={ctrl}")
+
+    # -- main loop --------------------------------------------------------
+
+    def run(self):
+        """Blocks until the job finishes; returns exit code."""
+        last_discovery = 0.0
+        desired = {}
+        membership_dirty = True
+        while True:
+            now = time.time()
+            if now - last_discovery >= DISCOVERY_INTERVAL_S:
+                last_discovery = now
+                try:
+                    found = self.discovery.find_available_hosts_and_slots()
+                except Exception as e:
+                    self._log(f"discovery failed: {e}")
+                    found = desired
+                found = {h: s for h, s in found.items()
+                         if not self._blacklisted(h, now)}
+                if found != desired:
+                    desired = found
+                    membership_dirty = True
+
+            # reap exits
+            for w in list(self.workers.values()):
+                if w.exit_code is None:
+                    code = w.proc.poll()
+                    if code is not None:
+                        w.exit_code = code
+                        if code == 0:
+                            if w.id in self._excluded:
+                                self._log(f"{w.id} exited (excluded)")
+                            else:
+                                self._success_seen = True
+                                self._log(f"{w.id} finished OK")
+                        else:
+                            self._log(f"{w.id} FAILED rc={code}")
+                            self._record_failure(w.hostname)
+                            if self._success_seen:
+                                self._wind_down_failed = True
+                            membership_dirty = True
+
+            alive = [w for w in self.workers.values() if w.alive]
+
+            if self._success_seen:
+                # job is winding down: no respawns, wait for the rest
+                if not alive:
+                    return 1 if self._wind_down_failed else 0
+                time.sleep(0.1)
+                continue
+
+            # spawn to match desired membership (up to max_np)
+            if membership_dirty:
+                have = {}
+                for w in alive:
+                    have[w.hostname] = have.get(w.hostname, 0) + 1
+                total = sum(have.values())
+                cap = self.max_np or float("inf")
+                spawned = False
+                for host, slots in desired.items():
+                    for slot in range(have.get(host, 0), slots):
+                        if total >= cap:
+                            break
+                        if self._blacklisted(host, now):
+                            continue
+                        self._spawn(host, slot)
+                        total += 1
+                        spawned = True
+                alive = [w for w in self.workers.values() if w.alive]
+                if len(alive) < self.min_np:
+                    if not desired or all(
+                            self._blacklisted(h, now) for h in desired):
+                        self._log(
+                            f"only {len(alive)} alive < min_np "
+                            f"{self.min_np} and no usable hosts; failing")
+                        self.stop()
+                        return 1
+                    # wait for discovery/cooldown to supply hosts
+                    time.sleep(0.2)
+                    continue
+                self._new_epoch(desired)
+                membership_dirty = False
+
+            if not alive and not self._success_seen:
+                self._log("all workers dead; failing")
+                return 1
+            time.sleep(0.05)
+
+    def stop(self):
+        for w in self.workers.values():
+            if w.alive:
+                util.terminate(w.proc)
+        self.rdv.stop()
+
+
+def _my_addr():
+    import socket
+    return socket.getfqdn()
+
+
+def run_elastic(args):
+    """Entry from `tpurun --min-np/--max-np/--host-discovery-script`."""
+    from ..config_parser import args_to_env
+    from ..hosts import parse_hosts
+
+    if args.host_discovery_script:
+        discovery = HostDiscoveryScript(args.host_discovery_script)
+    elif args.hosts:
+        discovery = FixedHosts({h.hostname: h.slots
+                                for h in parse_hosts(args.hosts)})
+    else:
+        discovery = FixedHosts({"localhost": args.np or 1})
+    min_np = args.min_np or args.np or 1
+    max_np = args.max_np or 0
+    extra_env = args_to_env(args)
+    if args.verbose:
+        extra_env.setdefault("HVD_LOG_LEVEL", "debug")
+    driver = ElasticDriver(args.command, discovery, min_np, max_np,
+                           extra_env=extra_env, verbose=args.verbose,
+                           cooldown_range=tuple(
+                               args.blacklist_cooldown_range)
+                           if args.blacklist_cooldown_range else None)
+    driver.ssh_port = args.ssh_port
+    try:
+        return driver.run()
+    finally:
+        driver.stop()

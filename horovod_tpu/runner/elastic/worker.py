@@ -1,0 +1,152 @@
+"""Worker-side elastic runtime: epoch rendezvous + host-update polling.
+
+Reference parity: `horovod/runner/elastic/worker.py`
+(`WorkerNotificationManager/Service`) — except the reference pushes
+HostsUpdatedInterrupt to an HTTP server inside each worker; here workers
+poll the driver's KV store epoch counter (`/ctl/epoch`), which needs no
+per-worker server and survives NAT/loopback setups identically.
+
+Env contract (set by the elastic driver at spawn):
+- HVD_ELASTIC=1
+- HVD_RENDEZVOUS_ADDR=host:port  (driver KV store)
+- HVD_WORKER_ID=host/slot-uuid   (stable identity across epochs)
+"""
+
+import json
+import os
+import threading
+import time
+
+from .. import http_server
+
+POLL_INTERVAL_S = 0.5
+
+
+def is_elastic():
+    return os.environ.get("HVD_ELASTIC") == "1"
+
+
+def _rdv_addr():
+    return os.environ["HVD_RENDEZVOUS_ADDR"]
+
+
+def _worker_id():
+    return os.environ["HVD_WORKER_ID"]
+
+
+def current_epoch():
+    try:
+        return int(http_server.read_kv(_rdv_addr(), "ctl", "epoch"))
+    except Exception:
+        return -1
+
+
+def fetch_assignment(epoch, timeout=600.0):
+    """Wait for this worker's assignment in `epoch`. Returns dict or the
+    string directive "exit"."""
+    raw = http_server.read_kv(_rdv_addr(), f"assign-{epoch}", _worker_id(),
+                              wait=True, timeout=timeout)
+    val = raw.decode()
+    if val == "exit":
+        return "exit"
+    return json.loads(val)
+
+
+def apply_assignment(a):
+    os.environ["HVD_RANK"] = str(a["rank"])
+    os.environ["HVD_SIZE"] = str(a["size"])
+    os.environ["HVD_LOCAL_RANK"] = str(a["local_rank"])
+    os.environ["HVD_LOCAL_SIZE"] = str(a["local_size"])
+    os.environ["HVD_CROSS_RANK"] = str(a["cross_rank"])
+    os.environ["HVD_CROSS_SIZE"] = str(a["cross_size"])
+    os.environ["HVD_CONTROLLER_ADDR"] = a["controller"]
+
+
+def rendezvous_init():
+    """First init for an elastic worker: wait for the first epoch that can
+    include this worker (HVD_SPAWN_EPOCH, set by the driver at spawn — a
+    stale current epoch's assignment table will never contain this id),
+    then init the core. Called from hvd.init() when HVD_ELASTIC=1."""
+    from ...basics import basics
+
+    epoch = _wait_epoch_at_least(int(os.environ.get("HVD_SPAWN_EPOCH", 0)))
+    a = fetch_assignment(epoch)
+    if a == "exit":
+        raise SystemExit(0)
+    apply_assignment(a)
+    notification_manager.set_epoch(epoch)
+    basics.init()
+    return epoch
+
+
+def rendezvous_reset():
+    """Re-rendezvous after a failure/membership change: shutdown the core,
+    wait for a NEW epoch, re-init with its assignment."""
+    from ...basics import basics
+
+    if basics.is_initialized():
+        basics.shutdown()
+    epoch = _wait_epoch_at_least(notification_manager.epoch + 1)
+    a = fetch_assignment(epoch)
+    if a == "exit":
+        raise SystemExit(0)
+    apply_assignment(a)
+    notification_manager.set_epoch(epoch)
+    basics.init()
+    return epoch
+
+
+def _wait_epoch_at_least(n, timeout=600.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        e = current_epoch()
+        if e >= n:
+            return e
+        time.sleep(POLL_INTERVAL_S)
+    raise TimeoutError(f"no rendezvous epoch >= {n} within {timeout}s")
+
+
+class WorkerNotificationManager:
+    """Polls the driver's epoch counter; a bump while training means the
+    membership changed → notify registered States so the next commit()
+    raises HostsUpdatedInterrupt."""
+
+    def __init__(self):
+        self._listeners = []
+        self._lock = threading.Lock()
+        self._thread = None
+        self.epoch = -1
+
+    def init(self):
+        if not is_elastic() or self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._poll, daemon=True)
+        self._thread.start()
+
+    def set_epoch(self, e):
+        self.epoch = e
+
+    def register_listener(self, state):
+        with self._lock:
+            self._listeners.append(state)
+
+    def remove_listener(self, state):
+        with self._lock:
+            if state in self._listeners:
+                self._listeners.remove(state)
+
+    def _poll(self):
+        while True:
+            time.sleep(POLL_INTERVAL_S)
+            try:
+                e = current_epoch()
+            except Exception:
+                continue
+            if self.epoch >= 0 and e > self.epoch:
+                with self._lock:
+                    listeners = list(self._listeners)
+                for s in listeners:
+                    s.on_hosts_updated()
+
+
+notification_manager = WorkerNotificationManager()

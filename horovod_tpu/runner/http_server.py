@@ -1,0 +1,139 @@
+"""HTTP key-value rendezvous server + client.
+
+Reference parity: `horovod/runner/http/http_server.py` (`RendezvousServer`,
+`KVStoreHandler`) and `http_client.py` (`put_data_into_kvstore`,
+`read_data_from_kvstore`). The driver runs one of these; workers (and the
+elastic machinery) GET/PUT keys under scopes. Values are opaque bytes;
+requests carry an HMAC signature header when the server was given a key.
+
+GET on a missing key returns 404 and clients poll — that is the rendezvous
+barrier (same semantics the reference's Gloo context relies on).
+"""
+
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import util
+
+SIG_HEADER = "X-Hvd-Sig"
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _check_sig(self, payload=b""):
+        key = self.server.secret_key
+        if key is None:
+            return True
+        sig = self.headers.get(SIG_HEADER, "")
+        return util.check_signature(key, self.path.encode() + payload, sig)
+
+    def do_GET(self):
+        if not self._check_sig():
+            self.send_error(403)
+            return
+        with self.server.kv_lock:
+            value = self.server.kv.get(self.path)
+        if value is None:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        payload = self.rfile.read(length)
+        if not self._check_sig(payload):
+            self.send_error(403)
+            return
+        with self.server.kv_lock:
+            self.server.kv[self.path] = payload
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_DELETE(self):
+        if not self._check_sig():
+            self.send_error(403)
+            return
+        with self.server.kv_lock:
+            self.server.kv.pop(self.path, None)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class RendezvousServer:
+    """In-driver KV store. start() returns the bound port."""
+
+    def __init__(self, secret_key=None, addr="0.0.0.0"):
+        self._addr = addr
+        self._httpd = None
+        self._thread = None
+        self.secret_key = secret_key
+
+    def start(self, port=0):
+        self._httpd = ThreadingHTTPServer((self._addr, port), _KVHandler)
+        self._httpd.kv = {}
+        self._httpd.kv_lock = threading.Lock()
+        self._httpd.secret_key = self.secret_key
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    # Driver-side direct access (no HTTP round trip)
+    def get(self, path):
+        with self._httpd.kv_lock:
+            return self._httpd.kv.get(path)
+
+    def put(self, path, value: bytes):
+        with self._httpd.kv_lock:
+            self._httpd.kv[path] = value
+
+
+def _request(method, url, payload=b"", secret_key=None, timeout=10.0):
+    req = urllib.request.Request(url, data=payload or None, method=method)
+    if secret_key is not None:
+        from urllib.parse import urlparse
+        path = urlparse(url).path
+        req.add_header(SIG_HEADER,
+                       util.sign(secret_key, path.encode() + payload))
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.read()
+
+
+def put_kv(addr, scope, key, value: bytes, secret_key=None):
+    _request("PUT", f"http://{addr}/{scope}/{key}", value, secret_key)
+
+
+def read_kv(addr, scope, key, secret_key=None, wait=False, timeout=60.0):
+    """GET a key; with wait=True, poll until it exists (rendezvous)."""
+    import time
+    deadline = time.time() + timeout
+    while True:
+        try:
+            return _request("GET", f"http://{addr}/{scope}/{key}",
+                            secret_key=secret_key)
+        except urllib.error.HTTPError as e:
+            if e.code == 404 and wait and time.time() < deadline:
+                time.sleep(0.1)
+                continue
+            raise

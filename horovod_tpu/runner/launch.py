@@ -1,0 +1,199 @@
+"""`tpurun` — the launcher CLI (reference: `horovodrun`,
+`horovod/runner/launch.py` `run_commandline`/`parse_args`/`_run`).
+
+Static launch: parse hosts → assign ranks → export slot env (HVD_RANK...,
+HVD_CONTROLLER_ADDR pointing at rank 0's host) → spawn one process per slot
+(local fork or ssh), kill all on any failure. Elastic launch (min-np/max-np
++ discovery) lives in `horovod_tpu.runner.elastic` and is selected the same
+way the reference does it: presence of --min-np/--max-np/
+--host-discovery-script.
+
+Usage:
+    python -m horovod_tpu.runner.launch -np 4 python train.py
+    tpurun -np 8 -H host1:4,host2:4 --timeline-filename /tmp/tl.json \
+        python train.py
+"""
+
+import argparse
+import os
+import shlex
+import sys
+
+from . import config_parser, hosts as hosts_mod, util
+from .local import find_free_port, slot_env
+from .util import safe_exec, terminate
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="tpurun",
+        description="Launch a horovod_tpu job: one process per slot/chip.")
+    p.add_argument("-np", "--num-proc", dest="np", type=int,
+                   help="total number of processes (default: all slots)")
+    p.add_argument("-H", "--hosts", dest="hosts",
+                   help='host list, e.g. "host1:4,host2:4" (default '
+                        'localhost with -np slots)')
+    p.add_argument("--hostfile", dest="hostfile",
+                   help="file with one 'host slots=N' per line")
+    p.add_argument("--ssh-port", type=int, default=None)
+    p.add_argument("--start-timeout", type=int, default=None,
+                   help="seconds to wait for ranks to register")
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--config-file", dest="config_file")
+    p.add_argument("--disable-cache", action="store_true",
+                   help="sets HVD_CACHE_CAPACITY=0")
+    # tunables (config_parser maps these to HVD_* env)
+    p.add_argument("--fusion-threshold-mb", dest="fusion_threshold_mb",
+                   type=float, default=None)
+    p.add_argument("--cycle-time-ms", dest="cycle_time_ms", type=float,
+                   default=None)
+    p.add_argument("--cache-capacity", dest="cache_capacity", type=int,
+                   default=None)
+    p.add_argument("--timeline-filename", dest="timeline_filename")
+    p.add_argument("--timeline-mark-cycles", dest="timeline_mark_cycles",
+                   action="store_true", default=None)
+    p.add_argument("--no-stall-check", dest="no_stall_check",
+                   action="store_true")
+    p.add_argument("--stall-check-warning-time-seconds",
+                   dest="stall_check_warning_time_seconds", type=int,
+                   default=None)
+    p.add_argument("--stall-check-shutdown-time-seconds",
+                   dest="stall_check_shutdown_time_seconds", type=int,
+                   default=None)
+    p.add_argument("--autotune", action="store_true", default=None)
+    p.add_argument("--autotune-log-file", dest="autotune_log_file")
+    p.add_argument("--log-level", dest="log_level",
+                   choices=["trace", "debug", "info", "warn", "error"])
+    # elastic
+    p.add_argument("--min-np", dest="min_np", type=int, default=None)
+    p.add_argument("--max-np", dest="max_np", type=int, default=None)
+    p.add_argument("--host-discovery-script",
+                   dest="host_discovery_script", default=None)
+    p.add_argument("--blacklist-cooldown-range", nargs=2, type=float,
+                   default=None, help="elastic host blacklist cooldown "
+                   "min/max seconds")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="training command")
+    args = p.parse_args(argv)
+    if args.config_file:
+        config_parser.apply_config_file(args, args.config_file)
+    if args.no_stall_check:
+        args.stall_check_warning_time_seconds = 0
+        args.stall_check_shutdown_time_seconds = 0
+    if args.disable_cache:
+        args.cache_capacity = 0
+    if not args.command:
+        p.error("no training command given")
+    return args
+
+
+def _resolve_hosts(args):
+    if args.hosts and args.hostfile:
+        raise ValueError("use either -H or --hostfile, not both")
+    if args.hostfile:
+        hs = hosts_mod.parse_hostfile(args.hostfile)
+    elif args.hosts:
+        hs = hosts_mod.parse_hosts(args.hosts)
+    else:
+        hs = [hosts_mod.HostInfo("localhost", args.np or 1)]
+    return hs
+
+
+def get_remote_command(slot, command, env, ssh_port=None):
+    """Assemble the per-slot ssh command (reference: gloo_run.py
+    `get_remote_command` — env exported inline, command exec'd on host)."""
+    exports = " ".join(f"{k}={shlex.quote(str(v))}"
+                       for k, v in sorted(env.items()))
+    inner = f"cd {shlex.quote(os.getcwd())} > /dev/null 2>&1 ; " \
+            f"env {exports} {' '.join(shlex.quote(c) for c in command)}"
+    port = f"-p {ssh_port} " if ssh_port else ""
+    return f"ssh -o PasswordAuthentication=no -o StrictHostKeyChecking=no " \
+           f"{port}{slot.hostname} {shlex.quote(inner)}"
+
+
+def _slot_extra_env(args):
+    env = config_parser.args_to_env(args)
+    if args.verbose:
+        env.setdefault("HVD_LOG_LEVEL", "debug")
+    return env
+
+
+def _run_static(args):
+    hs = _resolve_hosts(args)
+    np_ = args.np or sum(h.slots for h in hs)
+    slots = hosts_mod.get_host_assignments(hs, np_)
+    extra = _slot_extra_env(args)
+
+    port = find_free_port()
+    rank0_host = slots[0].hostname
+    ctrl_host = "127.0.0.1" if hosts_mod.is_local(rank0_host) else rank0_host
+    ctrl = f"{ctrl_host}:{port}"
+
+    procs = []
+    try:
+        for s in slots:
+            env = slot_env(s.rank, s.size, s.local_rank, s.local_size,
+                           s.cross_rank, s.cross_size,
+                           controller_addr=ctrl, extra_env=extra)
+            if hosts_mod.is_local(s.hostname):
+                procs.append(safe_exec(list(args.command), env=env))
+            else:
+                cmd = get_remote_command(s, list(args.command), {
+                    k: v for k, v in env.items()
+                    if k.startswith(("HVD_", "PYTHONPATH", "PATH"))
+                }, args.ssh_port)
+                procs.append(safe_exec(["/bin/sh", "-c", cmd],
+                                       env=dict(os.environ)))
+        return _wait_all(procs, verbose=args.verbose)
+    finally:
+        for p in procs:
+            terminate(p)
+
+
+def _wait_all(procs, verbose=False):
+    import time
+    codes = [None] * len(procs)
+    while any(c is None for c in codes):
+        for i, p in enumerate(procs):
+            if codes[i] is None:
+                codes[i] = p.poll()
+                if codes[i] not in (None, 0):
+                    if verbose:
+                        print(f"rank process {i} exited with {codes[i]}; "
+                              f"terminating job", file=sys.stderr)
+                    for q in procs:
+                        terminate(q)
+        time.sleep(0.05)
+    bad = [c for c in codes if c != 0]
+    return 0 if not bad else (bad[0] if bad[0] > 0 else 1)
+
+
+def run_commandline(argv=None):
+    args = parse_args(argv)
+    if args.min_np is not None or args.max_np is not None \
+            or args.host_discovery_script:
+        from .elastic.driver import run_elastic
+        return run_elastic(args)
+    return _run_static(args)
+
+
+def run(fn=None, np=1, hosts=None, command=None, **kwargs):
+    """Programmatic API (reference: horovod.run()). Either a shell
+    `command` list, or via tpurun CLI args."""
+    argv = ["-np", str(np)]
+    if hosts:
+        argv += ["-H", hosts]
+    for k, v in kwargs.items():
+        argv.append("--" + k.replace("_", "-"))
+        if v is not True:
+            argv.append(str(v))
+    argv += list(command)
+    return run_commandline(argv)
+
+
+def main():
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

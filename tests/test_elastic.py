@@ -1,0 +1,99 @@
+"""Elastic integration tests (reference: test/integration/test_elastic_*.py
++ elastic_common.py BaseElasticTests): a REAL local elastic job on
+localhost — fake discovery is a script cat-ing a hosts file the test
+mutates mid-run; failure injection is a worker calling os._exit(1)."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+WORKER = os.path.join(os.path.dirname(__file__), "workers",
+                      "elastic_train_worker.py")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_elastic(tmp_path, hosts_initial, extra_env, min_np, max_np,
+                 mutate=None, timeout=120):
+    """Run tpurun elastic in-process-launched subprocess; returns (rc, log)."""
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text(hosts_initial + "\n")
+    log_file = tmp_path / "final.log"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["TEST_LOG"] = str(log_file)
+    env.update(extra_env)
+
+    cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
+           "--min-np", str(min_np), "--max-np", str(max_np),
+           "--host-discovery-script", f"cat {hosts_file}",
+           "--verbose",
+           sys.executable, WORKER]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    if mutate:
+        t = threading.Thread(target=mutate, args=(hosts_file,), daemon=True)
+        t.start()
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        raise AssertionError(f"elastic job timed out; output:\n{out}")
+    log = log_file.read_text() if log_file.exists() else ""
+    return proc.returncode, log, out
+
+
+def test_elastic_scale_up(tmp_path):
+    """Start with 2 slots, discovery adds a third mid-run; all workers
+    (including the late joiner) finish at the full iteration count."""
+    def mutate(hosts_file):
+        time.sleep(2.0)
+        hosts_file.write_text("localhost:3\n")
+
+    rc, log, out = _run_elastic(
+        tmp_path, "localhost:2",
+        {"TEST_ITERS": "14", "TEST_SLEEP": "0.25"},
+        min_np=2, max_np=4, mutate=mutate)
+    assert rc == 0, f"job failed rc={rc}\n{out}"
+    finals = [line for line in log.splitlines() if line.startswith("final")]
+    assert len(finals) == 3, f"expected 3 finishers:\n{log}\n{out}"
+    assert any("size=3" in line for line in finals), \
+        f"no worker saw size=3 (scale-up never landed):\n{log}\n{out}"
+    assert all("iter=14" in line for line in finals), log
+
+
+def test_elastic_failure_recovery(tmp_path):
+    """A worker dies mid-job; survivors restore from the last commit, the
+    driver respawns a replacement, and the job completes."""
+    marker = tmp_path / "died.marker"
+    rc, log, out = _run_elastic(
+        tmp_path, "localhost:2",
+        {"TEST_ITERS": "10", "TEST_SLEEP": "0.1",
+         "TEST_FAIL_SLOT": "1", "TEST_MARKER": str(marker)},
+        min_np=2, max_np=2)
+    assert rc == 0, f"job failed rc={rc}\n{out}"
+    assert marker.exists(), "failure was never injected"
+    finals = [line for line in log.splitlines() if line.startswith("final")]
+    assert len(finals) == 2, f"expected 2 finishers:\n{log}\n{out}"
+    assert all("iter=10" in line for line in finals), log
+
+
+def test_elastic_scale_down(tmp_path):
+    """Discovery removes a slot mid-run: the excess worker is told to exit
+    via the KV directive, the rest re-rendezvous at size=2 and finish."""
+    def mutate(hosts_file):
+        time.sleep(2.0)
+        hosts_file.write_text("localhost:2\n")
+
+    rc, log, out = _run_elastic(
+        tmp_path, "localhost:3",
+        {"TEST_ITERS": "14", "TEST_SLEEP": "0.25"},
+        min_np=2, max_np=3, mutate=mutate)
+    assert rc == 0, f"job failed rc={rc}\n{out}"
+    finals = [line for line in log.splitlines() if line.startswith("final")]
+    assert len(finals) == 2, f"expected 2 finishers:\n{log}\n{out}"
+    assert all("size=2" in line for line in finals), \
+        f"survivors should finish at size=2:\n{log}\n{out}"
+    assert all("iter=14" in line for line in finals), log

@@ -1,0 +1,181 @@
+"""Launcher unit tests (reference: test/single/test_run.py — arg parsing,
+hostfile parsing, command assembly with NOTHING actually executed, plus KV
+store round trips on localhost)."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from horovod_tpu.runner import config_parser, hosts, http_server, util
+from horovod_tpu.runner.launch import get_remote_command, parse_args
+
+
+# -- hosts ------------------------------------------------------------------
+
+def test_parse_hosts():
+    hs = hosts.parse_hosts("a:4,b:2,c")
+    assert hs == [hosts.HostInfo("a", 4), hosts.HostInfo("b", 2),
+                  hosts.HostInfo("c", 1)]
+    with pytest.raises(ValueError):
+        hosts.parse_hosts("")
+
+
+def test_parse_hostfile(tmp_path):
+    p = tmp_path / "hf"
+    p.write_text(textwrap.dedent("""\
+        # cluster
+        node1 slots=4
+        node2:2
+        node3
+    """))
+    hs = hosts.parse_hostfile(str(p))
+    assert hs == [hosts.HostInfo("node1", 4), hosts.HostInfo("node2", 2),
+                  hosts.HostInfo("node3", 1)]
+
+
+def test_host_assignments():
+    hs = [hosts.HostInfo("a", 2), hosts.HostInfo("b", 2)]
+    slots = hosts.get_host_assignments(hs, 3)
+    assert [(s.hostname, s.rank, s.local_rank, s.local_size,
+             s.cross_rank) for s in slots] == [
+        ("a", 0, 0, 2, 0), ("a", 1, 1, 2, 0), ("b", 2, 0, 1, 1)]
+    assert all(s.size == 3 for s in slots)
+    with pytest.raises(ValueError):
+        hosts.get_host_assignments(hs, 5)
+
+
+# -- args / config ----------------------------------------------------------
+
+def test_parse_args_basic():
+    a = parse_args(["-np", "4", "--fusion-threshold-mb", "32",
+                    "--timeline-filename", "/tmp/t.json",
+                    "python", "train.py", "--lr", "0.1"])
+    assert a.np == 4
+    assert a.command == ["python", "train.py", "--lr", "0.1"]
+    env = config_parser.args_to_env(a)
+    assert env["HVD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HVD_TIMELINE"] == "/tmp/t.json"
+
+
+def test_parse_args_no_stall_check():
+    a = parse_args(["-np", "2", "--no-stall-check", "x"])
+    env = config_parser.args_to_env(a)
+    assert env["HVD_STALL_CHECK_TIME_SECONDS"] == "0"
+
+
+def test_config_file(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(textwrap.dedent("""\
+        params:
+          fusion-threshold-mb: 16
+          cycle-time-ms: 2.5
+        timeline:
+          filename: /tmp/tl.json
+          mark-cycles: true
+        autotune:
+          enable: true
+    """))
+    a = parse_args(["-np", "2", "--config-file", str(cfg), "x"])
+    env = config_parser.args_to_env(a)
+    assert env["HVD_FUSION_THRESHOLD"] == str(16 * 1024 * 1024)
+    assert env["HVD_CYCLE_TIME_MS"] == "2.5"
+    assert env["HVD_TIMELINE"] == "/tmp/tl.json"
+    assert env["HVD_TIMELINE_MARK_CYCLES"] == "1"
+    assert env["HVD_AUTOTUNE"] == "1"
+
+
+def test_cli_overrides_config_file(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("params:\n  fusion-threshold-mb: 16\n")
+    a = parse_args(["-np", "2", "--fusion-threshold-mb", "8",
+                    "--config-file", str(cfg), "x"])
+    env = config_parser.args_to_env(a)
+    assert env["HVD_FUSION_THRESHOLD"] == str(8 * 1024 * 1024)
+
+
+# -- remote command assembly (nothing executed; reference mocks ssh) --------
+
+def test_get_remote_command():
+    s = hosts.SlotInfo("node7", 3, 8, 1, 2, 1, 2)
+    cmd = get_remote_command(s, ["python", "train.py"],
+                             {"HVD_RANK": "3", "HVD_SIZE": "8"},
+                             ssh_port=2222)
+    assert cmd.startswith("ssh ")
+    assert "node7" in cmd and "-p 2222" in cmd
+    assert "HVD_RANK=3" in cmd and "HVD_SIZE=8" in cmd
+    assert "python train.py" in cmd
+
+
+# -- HTTP KV rendezvous -----------------------------------------------------
+
+def test_kv_store_roundtrip():
+    key = util.make_secret_key()
+    srv = http_server.RendezvousServer(secret_key=key)
+    port = srv.start()
+    addr = f"127.0.0.1:{port}"
+    try:
+        http_server.put_kv(addr, "scope", "k1", b"hello", secret_key=key)
+        assert http_server.read_kv(addr, "scope", "k1",
+                                   secret_key=key) == b"hello"
+        # missing key → 404
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError):
+            http_server.read_kv(addr, "scope", "nope", secret_key=key)
+        # bad signature → 403
+        with pytest.raises(urllib.error.HTTPError):
+            http_server.read_kv(addr, "scope", "k1",
+                                secret_key=b"wrong-key-000")
+    finally:
+        srv.stop()
+
+
+def test_kv_store_wait_rendezvous():
+    import threading
+    import time
+
+    srv = http_server.RendezvousServer()
+    port = srv.start()
+    addr = f"127.0.0.1:{port}"
+    try:
+        def put_later():
+            time.sleep(0.3)
+            http_server.put_kv(addr, "rdv", "epoch", b"7")
+
+        t = threading.Thread(target=put_later)
+        t.start()
+        v = http_server.read_kv(addr, "rdv", "epoch", wait=True, timeout=5)
+        assert v == b"7"
+        t.join()
+    finally:
+        srv.stop()
+
+
+# -- end-to-end localhost launch -------------------------------------------
+
+def test_tpurun_localhost(tmp_path):
+    """Full CLI path: tpurun -np 2 on localhost, real collective."""
+    from horovod_tpu.runner.launch import run_commandline
+
+    script = tmp_path / "w.py"
+    script.write_text(textwrap.dedent("""\
+        import numpy as np
+        import horovod_tpu as hvd
+        hvd.init()
+        out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum)
+        assert (out == hvd.size()).all()
+        hvd.shutdown()
+    """))
+    rc = run_commandline(["-np", "2", "--no-stall-check",
+                          "python", str(script)])
+    assert rc == 0
+
+
+def test_tpurun_failure_propagates(tmp_path):
+    from horovod_tpu.runner.launch import run_commandline
+
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    rc = run_commandline(["-np", "2", "python", str(script)])
+    assert rc != 0

@@ -1,0 +1,62 @@
+"""Elastic training worker driven by `tpurun --min-np/--max-np`.
+
+Exercises the full elastic loop (reference: test/integration/data/ elastic
+driver scripts): ObjectState commit/restore/sync, scale-up via
+HostsUpdatedInterrupt, failure recovery via HorovodInternalError.
+
+Env knobs (set by the test):
+- TEST_ITERS: iterations to run
+- TEST_LOG: file to append "final rank=R size=S iter=I" on completion
+- TEST_SLEEP: per-iteration sleep seconds
+- TEST_FAIL_SLOT: slot index that dies once at iteration 3
+- TEST_MARKER: marker file recording that the death already happened
+"""
+
+import os
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+hvd.init()
+
+ITERS = int(os.environ.get("TEST_ITERS", "10"))
+SLEEP = float(os.environ.get("TEST_SLEEP", "0.1"))
+FAIL_SLOT = os.environ.get("TEST_FAIL_SLOT")
+MARKER = os.environ.get("TEST_MARKER", "")
+WID = os.environ.get("HVD_WORKER_ID", "?")
+
+state = elastic.ObjectState(iteration=0, total=np.zeros(4, np.float32))
+
+
+def _should_die(it):
+    if FAIL_SLOT is None or not MARKER:
+        return False
+    if os.path.exists(MARKER):
+        return False
+    return it == 3 and WID.startswith(f"localhost-{FAIL_SLOT}-")
+
+
+@elastic.run
+def train(state):
+    while state.iteration < ITERS:
+        if _should_die(state.iteration):
+            with open(MARKER, "w") as f:
+                f.write(WID)
+            os._exit(1)
+        out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                            name=f"it.{state.iteration}")
+        state.total = state.total + out
+        state.iteration += 1
+        state.commit()
+        time.sleep(SLEEP)
+    return hvd.rank(), hvd.size()
+
+
+rank, size = train(state)
+if os.environ.get("TEST_LOG"):
+    with open(os.environ["TEST_LOG"], "a") as f:
+        f.write(f"final rank={rank} size={size} iter={state.iteration}\n")
+hvd.shutdown()
