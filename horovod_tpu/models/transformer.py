@@ -40,6 +40,7 @@ class TransformerConfig:
     d_ff: int = 4096
     max_seq_len: int = 2048
     n_experts: int = 0          # 0 = dense FFN; >0 = MoE every layer
+    attn_impl: str = "gather"   # "gather" (K/V all-gather) | "ring"
     dtype: str = "bfloat16"
     # mesh axis names (any may be absent from the actual mesh; specs using a
     # missing name are invalid, so axes not in the mesh must be None'd via
@@ -48,6 +49,12 @@ class TransformerConfig:
     model_axis: str = "model"
     seq_axis: str = "seq"
     expert_axis: str = "expert"
+
+    def __post_init__(self):
+        if self.attn_impl not in ("gather", "ring"):
+            raise ValueError(
+                f"attn_impl must be 'gather' or 'ring', got "
+                f"{self.attn_impl!r}")
 
     @property
     def head_dim(self):
@@ -166,6 +173,37 @@ def _layer_norm(x, p, eps=1e-5):
     return y * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
 
 
+def _attention_ring(x, layer, cfg, mesh, seq_spec):
+    """Ring-attention path: K/V stay sequence-sharded and rotate on ICI
+    (horovod_tpu.parallel.ring_attention) instead of being gathered. TP
+    composes: each head group on the model axis runs its own ring."""
+    from ..parallel.ring_attention import make_ring_attention
+
+    dt = cfg.compute_dtype
+    names = set(mesh.axis_names)
+    d = cfg.data_axis if cfg.data_axis in names else None
+    s = cfg.seq_axis if cfg.seq_axis in names else None
+    m = cfg.model_axis if cfg.model_axis in names else None
+    S = x.shape[1]
+    seq_size = mesh.shape[s] if s else 1
+    head_size = mesh.shape[m] if m else 1
+    if S % seq_size != 0:
+        raise ValueError(
+            f"attn_impl='ring' needs seq len {S} divisible by the "
+            f"'{s}' axis size {seq_size}")
+    if cfg.n_heads % head_size != 0:
+        raise ValueError(
+            f"attn_impl='ring' needs n_heads {cfg.n_heads} divisible by "
+            f"the '{m}' axis size {head_size}")
+    qkv = jnp.einsum("bsd,dchk->cbshk", x, layer["wqkv"].astype(dt))
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    fn = make_ring_attention(mesh, axis=s, causal=True, batch_axis=d,
+                             head_axis=m, jit=False)
+    ctx = fn(q, k, v)
+    out = jnp.einsum("bshk,hkd->bsd", ctx, layer["wo"].astype(dt))
+    return jax.lax.with_sharding_constraint(out, seq_spec)
+
+
 def _attention(x, layer, cfg, seq_spec=None, full_spec=None):
     """Causal multi-head attention. With specs given, activations arrive
     seq-sharded and K/V are materialised full-sequence (XLA all-gather over
@@ -243,7 +281,11 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None):
     x = constrain(x, seq_spec)
     for layer in params["layers"]:
         h = _layer_norm(x, layer["ln1"])
-        x = x + _attention(h, layer, cfg, seq_spec, full_spec)
+        if (cfg.attn_impl == "ring" and mesh is not None
+                and cfg.seq_axis in mesh.axis_names):
+            x = x + _attention_ring(h, layer, cfg, mesh, seq_spec)
+        else:
+            x = x + _attention(h, layer, cfg, seq_spec, full_spec)
         h = _layer_norm(x, layer["ln2"])
         if cfg.n_experts > 0:
             x = x + _moe_ffn(h, layer, cfg)

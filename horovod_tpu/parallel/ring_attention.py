@@ -1,0 +1,122 @@
+"""Ring attention — context parallelism over a mesh axis.
+
+Long-context training shards the *sequence* across devices; attention then
+needs every query block to see every key/value block. Ring attention
+(Liu et al., blockwise parallel transformers) keeps K/V sharded and rotates
+each shard around the ring with `lax.ppermute` while accumulating the
+attention output with an online (streaming) softmax — O(S/P) memory per
+device and the rotation overlaps with the block matmuls on ICI.
+
+The reference has no sequence parallelism at all (SURVEY.md §2.4: "scales
+batch, never sequence"); its closest primitive is `hvd.alltoall`
+(see :mod:`.ulysses`). This module is the beyond-parity TPU-native answer.
+
+Use inside `shard_map` with q/k/v sequence-sharded over `axis`, or wrap
+with :func:`make_ring_attention`.
+
+Implementation notes:
+- block 0 (the local block) is computed before the loop, so only p-1
+  rotations are issued — no K/V block is sent and then discarded;
+- under `causal=True`, blocks that are fully masked (source shard index
+  greater than ours) skip their matmuls via `lax.cond` — the rotation
+  still happens, but no FLOPs are burned. (Work remains skewed toward
+  high-index shards; striped/zig-zag sequence layout is the known fix and
+  can be layered on by permuting the sequence before sharding.)
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ring_attention(q, k, v, axis, causal=True, scale=None):
+    """Blockwise ring attention over mesh axis `axis`.
+
+    q, k, v: [B, S_blk, H, D] — the local sequence block of each shard.
+    Returns [B, S_blk, H, D] (dtype of q); softmax statistics in fp32.
+
+    With `causal=True`, global causality is enforced across blocks: shard i
+    holds global positions [i*S_blk, (i+1)*S_blk).
+    """
+    p = lax.psum(1, axis)
+    my = lax.axis_index(axis)
+    B, S, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    dt = q.dtype
+
+    q32 = q.astype(jnp.float32)
+    perm = [(i, (i + 1) % p) for i in range(p)]  # ring: pass K/V to right
+
+    def accumulate(acc, k_blk, v_blk, src):
+        """Online-softmax update of (o, m, l) with one K/V block."""
+        o, m, l = acc
+        s = jnp.einsum("bqhd,bkhd->bhqk", q32,
+                       k_blk.astype(jnp.float32)) * scale
+        if causal:
+            q_pos = my * S + jnp.arange(S)
+            k_pos = src * S + jnp.arange(S)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # all-masked rows keep m=-inf; guard the exp against inf-inf
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        corr = jnp.exp(jnp.where(jnp.isneginf(m), m, m - m_safe))
+        w = jnp.exp(s - m_safe[..., None])
+        if causal:
+            w = jnp.where(mask[None, None], w, 0.0)
+        l = l * corr + w.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", w, v_blk.astype(jnp.float32))
+        o = o * corr.transpose(0, 2, 1)[..., None] + pv
+        return o, m_new, l
+
+    acc = (jnp.zeros((B, S, H, D), jnp.float32),          # o
+           jnp.full((B, H, S), -jnp.inf, jnp.float32),    # m
+           jnp.zeros((B, H, S), jnp.float32))             # l
+    # local block first: only p-1 rotations needed
+    acc = accumulate(acc, k, v, my)
+
+    def body(carry, i):
+        acc, k_blk, v_blk = carry
+        k_blk = lax.ppermute(k_blk, axis, perm)
+        v_blk = lax.ppermute(v_blk, axis, perm)
+        src = (my - i) % p  # whose block we now hold
+        if causal:
+            # src > my → every position is masked: skip the matmuls
+            acc = lax.cond(src > my,
+                           lambda a, kb, vb, s_: a,
+                           accumulate,
+                           acc, k_blk, v_blk, src)
+        else:
+            acc = accumulate(acc, k_blk, v_blk, src)
+        return (acc, k_blk, v_blk), None
+
+    # scan (not fori_loop): reverse-mode AD must flow through the ring for
+    # training; fori_loop is not differentiable.
+    (acc, _, _), _ = lax.scan(body, (acc, k, v), jnp.arange(1, p))
+    o, m, l = acc
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → zeros, not NaN
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(dt)
+
+
+def make_ring_attention(mesh, axis="seq", causal=True, batch_axis=None,
+                        head_axis=None, jit=True):
+    """Wrap ring_attention in shard_map over `mesh`: takes/returns global
+    [B, S, H, D] arrays sequence-sharded on `axis`, optionally
+    batch-sharded on `batch_axis` and head-sharded on `head_axis` (tensor
+    parallelism composes: each head group runs its own ring)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(batch_axis, axis, head_axis, None)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    def fn(q, k, v):
+        return ring_attention(q, k, v, axis=axis, causal=causal)
+
+    return jax.jit(fn) if jit else fn

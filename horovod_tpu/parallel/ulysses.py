@@ -1,0 +1,67 @@
+"""Ulysses-style sequence parallelism: alltoall head scatter.
+
+DeepSpeed-Ulysses pattern on the reference's own primitive (`hvd.alltoall`,
+`horovod/common/ops/*_operations.cc` `*Alltoall` — SURVEY.md §2.4 names it
+as the path to sequence parallelism): activations arrive sequence-sharded
+[B, S/P, H, D]; one alltoall re-shards them head-wise [B, S, H/P, D] so
+every device runs FULL-sequence attention on a slice of heads; a second
+alltoall restores sequence sharding. Two alltoalls per attention instead of
+a ring — better when H >= P and ICI alltoall bandwidth is plentiful.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def seq_to_heads(x, axis):
+    """[B, S_blk, H, D] seq-sharded → [B, S, H_blk, D] head-sharded."""
+    return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+
+def heads_to_seq(x, axis):
+    """Inverse of seq_to_heads."""
+    return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def _full_attention(q, k, v, causal, scale):
+    q32, k32, v32 = (t.astype(jnp.float32) for t in (q, k, v))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q32, k32) * scale
+    if causal:
+        S = s.shape[-1]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v32).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis, causal=True, scale=None):
+    """Attention over a sequence-sharded mesh axis via alltoall head
+    scatter. q/k/v: [B, S_blk, H, D]; H must be divisible by the axis size.
+    Returns [B, S_blk, H, D]."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    qh = seq_to_heads(q, axis)
+    kh = seq_to_heads(k, axis)
+    vh = seq_to_heads(v, axis)
+    oh = _full_attention(qh, kh, vh, causal, scale)
+    return heads_to_seq(oh, axis)
+
+
+def make_ulysses_attention(mesh, axis="seq", causal=True, batch_axis=None):
+    """shard_map wrapper: global [B, S, H, D] arrays seq-sharded on `axis`."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(batch_axis, axis, None, None)
+
+    @jax.jit
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    def fn(q, k, v):
+        return ulysses_attention(q, k, v, axis=axis, causal=causal)
+
+    return fn
