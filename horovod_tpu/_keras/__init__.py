@@ -1,0 +1,1 @@
+"""Shared Keras support (reference: horovod/_keras/__init__.py)."""

@@ -1,0 +1,185 @@
+"""Keras callbacks (reference: horovod/_keras/callbacks.py):
+BroadcastGlobalVariablesCallback, MetricAverageCallback,
+LearningRateWarmupCallback, LearningRateScheduleCallback, and the elastic
+Commit/UpdateBatch/UpdateEpoch state callbacks."""
+
+import numpy as np
+
+
+def _keras():
+    import tensorflow as tf
+
+    return tf.keras
+
+
+class BroadcastGlobalVariablesCallback:
+    """Broadcast all model/optimizer variables from root at train start
+    so every rank begins identical."""
+
+    def __new__(cls, root_rank=0):
+        keras = _keras()
+
+        from .. import tensorflow as hvd_tf
+
+        class _CB(keras.callbacks.Callback):
+            def __init__(self):
+                super().__init__()
+                self._done = False
+
+            def on_train_begin(self, logs=None):
+                if self._done:
+                    return
+                hvd_tf.broadcast_variables(self.model.variables,
+                                           root_rank=root_rank)
+                self._done = True
+
+        return _CB()
+
+
+class MetricAverageCallback:
+    """Average epoch metrics over ranks at epoch end (reference:
+    MetricAverageCallback)."""
+
+    def __new__(cls):
+        keras = _keras()
+
+        from .. import tensorflow as hvd_tf
+
+        class _CB(keras.callbacks.Callback):
+            def on_epoch_end(self, epoch, logs=None):
+                if logs:
+                    for k, v in list(logs.items()):
+                        try:
+                            logs[k] = hvd_tf.metric_average(
+                                float(v), name=f"metric.{k}")
+                        except (TypeError, ValueError):
+                            pass
+
+        return _CB()
+
+
+class LearningRateWarmupCallback:
+    """Linear LR warmup over the first `warmup_epochs` from lr/size to lr
+    (reference: LearningRateWarmupCallback; scaling rule from the
+    Facebook 1-hour-ImageNet recipe the reference cites).
+
+    `momentum_correction=True` rescales SGD momentum accumulators by
+    new_lr/old_lr on every LR change (the reference's behavior), keeping
+    the effective update magnitude continuous through warmup. Optimizer
+    momentum variables are located by name; optimizers without any are
+    unaffected."""
+
+    def __new__(cls, initial_lr, warmup_epochs=5, momentum_correction=True,
+                steps_per_epoch=None, verbose=0):
+        keras = _keras()
+
+        from .. import tensorflow as hvd_tf
+
+        class _CB(keras.callbacks.Callback):
+            def __init__(self):
+                super().__init__()
+                self.steps = 0
+
+            def _set_lr(self, lr):
+                opt = self.model.optimizer
+                old = float(opt.learning_rate.numpy()) \
+                    if hasattr(opt.learning_rate, "numpy") \
+                    else float(opt.learning_rate)
+                try:
+                    opt.learning_rate.assign(lr)
+                except AttributeError:
+                    opt.learning_rate = lr
+                if momentum_correction and old > 0 and lr != old:
+                    for v in getattr(opt, "variables", []):
+                        path = getattr(v, "path", getattr(v, "name", ""))
+                        if "momentum" in path:
+                            v.assign(v * (lr / old))
+                if verbose:
+                    print(f"LearningRateWarmup: lr={lr:g}")
+
+            def on_train_batch_begin(self, batch, logs=None):
+                if steps_per_epoch is None:
+                    return
+                total = warmup_epochs * steps_per_epoch
+                if self.steps < total:
+                    frac = (self.steps + 1) / total
+                    size = hvd_tf.size()
+                    lr = initial_lr * (1.0 / size + frac * (1 - 1.0 / size))
+                    self._set_lr(lr)
+                self.steps += 1
+
+            def on_epoch_begin(self, epoch, logs=None):
+                if steps_per_epoch is not None:
+                    return
+                if epoch < warmup_epochs:
+                    size = hvd_tf.size()
+                    frac = (epoch + 1) / warmup_epochs
+                    self._set_lr(initial_lr *
+                                 (1.0 / size + frac * (1 - 1.0 / size)))
+                elif epoch == warmup_epochs:
+                    self._set_lr(initial_lr)
+
+        return _CB()
+
+
+class LearningRateScheduleCallback:
+    """Multiply LR by `multiplier` within [start_epoch, end_epoch)
+    (reference: LearningRateScheduleCallback)."""
+
+    def __new__(cls, initial_lr, multiplier, start_epoch=0, end_epoch=None,
+                staircase=True):
+        keras = _keras()
+
+        class _CB(keras.callbacks.Callback):
+            def on_epoch_begin(self, epoch, logs=None):
+                if epoch < start_epoch:
+                    return
+                if end_epoch is not None and epoch >= end_epoch:
+                    return
+                m = multiplier(epoch) if callable(multiplier) else multiplier
+                lr = initial_lr * m
+                opt = self.model.optimizer
+                try:
+                    opt.learning_rate.assign(lr)
+                except AttributeError:
+                    opt.learning_rate = lr
+
+        return _CB()
+
+
+# -- elastic callbacks (reference: CommitStateCallback etc.) ----------------
+
+class CommitStateCallback:
+    """state.commit() every `batches_per_commit` batches."""
+
+    def __new__(cls, state, batches_per_commit=1):
+        keras = _keras()
+
+        class _CB(keras.callbacks.Callback):
+            def on_train_batch_end(self, batch, logs=None):
+                if (batch + 1) % batches_per_commit == 0:
+                    state.commit()
+
+        return _CB()
+
+
+class UpdateBatchStateCallback:
+    def __new__(cls, state):
+        keras = _keras()
+
+        class _CB(keras.callbacks.Callback):
+            def on_train_batch_end(self, batch, logs=None):
+                state.batch = batch
+
+        return _CB()
+
+
+class UpdateEpochStateCallback:
+    def __new__(cls, state):
+        keras = _keras()
+
+        class _CB(keras.callbacks.Callback):
+            def on_epoch_end(self, epoch, logs=None):
+                state.epoch = epoch
+
+        return _CB()

@@ -1,0 +1,39 @@
+"""horovod_tpu.keras — Keras front door (reference: horovod/keras +
+horovod/tensorflow/keras): re-exports the TF binding plus callbacks."""
+
+from ..tensorflow import (  # noqa: F401
+    Adasum,
+    Average,
+    Compression,
+    DistributedOptimizer,
+    Max,
+    Min,
+    Product,
+    Sum,
+    allgather,
+    allreduce,
+    alltoall,
+    broadcast,
+    broadcast_object,
+    broadcast_variables,
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    metric_average,
+    rank,
+    shutdown,
+    size,
+)
+from .._keras import callbacks  # noqa: F401
+from .._keras.callbacks import (  # noqa: F401
+    BroadcastGlobalVariablesCallback,
+    CommitStateCallback,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+    UpdateBatchStateCallback,
+    UpdateEpochStateCallback,
+)

@@ -78,8 +78,9 @@ def make_moe_layer(mesh, axis, w_in, w_out, capacity_factor=1.25):
     """Convenience: build a jitted MoE FFN over `mesh`.
 
     w_in: [E, D, F], w_out: [E, F, D] — sharded on dim0 over `axis`.
-    Returns fn(x [B, T, D], logits [B, T, E]) -> [B, T, D] with batch
-    flattened into tokens per shard.
+    Returns fn(x [T, D], logits [T, E]) -> [T, D] where T is the global
+    token count (flatten any batch/sequence dims into T first; T must be
+    divisible by the axis size).
     """
     import functools
 

@@ -1,0 +1,322 @@
+"""horovod_tpu.tensorflow — the TensorFlow (TF2) framework binding.
+
+Reference parity: `horovod/tensorflow/__init__.py` + `mpi_ops.py` —
+collectives on tf.Tensors, `DistributedGradientTape` wrapping
+`tape.gradient`, `DistributedOptimizer` wrapping Keras optimizers,
+`broadcast_variables`. The reference registers custom C++ ops
+(`tensorflow/mpi_ops.cc`); here eager tensors bridge to the same native
+core via numpy, and graph/`tf.function` contexts lower through
+`tf.py_function` (the analog of the reference's AsyncOpKernel enqueue —
+the collective still executes on the core's background thread).
+"""
+
+import numpy as np
+
+from ..basics import basics as _basics
+from ..compression import Compression  # noqa: F401
+from ..exceptions import (  # noqa: F401
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from ..ops import collective_ops as _core
+from ..ops.collective_ops import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    Sum,
+    barrier,
+    join,
+)
+from ..process_sets import (  # noqa: F401
+    ProcessSet,
+    add_process_set,
+    global_process_set,
+    remove_process_set,
+)
+
+
+def init():
+    import horovod_tpu as _pkg
+
+    return _pkg.init()
+
+
+shutdown = _basics.shutdown
+is_initialized = _basics.is_initialized
+rank = _basics.rank
+size = _basics.size
+local_rank = _basics.local_rank
+local_size = _basics.local_size
+cross_rank = _basics.cross_rank
+cross_size = _basics.cross_size
+
+
+def _tf():
+    import tensorflow as tf
+
+    return tf
+
+
+def _run_op(np_fn, x, out_dtype=None):
+    """Run a core collective on a tf value: eager → direct numpy path;
+    traced (tf.function) → tf.py_function."""
+    tf = _tf()
+    t = tf.convert_to_tensor(x)
+    if tf.executing_eagerly():
+        return tf.convert_to_tensor(np_fn(t.numpy()))
+    return tf.py_function(lambda a: np_fn(a.numpy()), [t],
+                          out_dtype or t.dtype)
+
+
+def allreduce(tensor, op=Average, name=None, process_set=0,
+              prescale_factor=1.0, postscale_factor=1.0, compression=None):
+    def fn(a):
+        ctx = None
+        if compression is not None:
+            a, ctx = compression.compress(a)
+        out = _core.allreduce(a, op=op, name=name,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor,
+                              process_set=process_set)
+        if compression is not None:
+            out = compression.decompress(out, ctx)
+        return out
+
+    return _run_op(fn, tensor)
+
+
+def grouped_allreduce(tensors, op=Average, name=None, process_set=0):
+    tf = _tf()
+    arrs = [tf.convert_to_tensor(t) for t in tensors]
+    if tf.executing_eagerly():
+        outs = _core.grouped_allreduce([a.numpy() for a in arrs], op=op,
+                                       name=name, process_set=process_set)
+        return [tf.convert_to_tensor(o) for o in outs]
+
+    def fn(*as_):
+        return _core.grouped_allreduce([a.numpy() for a in as_], op=op,
+                                       name=name, process_set=process_set)
+
+    return tf.py_function(fn, arrs, [a.dtype for a in arrs])
+
+
+def allgather(tensor, name=None, process_set=0):
+    return _run_op(lambda a: _core.allgather(a, name=name,
+                                             process_set=process_set),
+                   tensor)
+
+
+def broadcast(tensor, root_rank=0, name=None, process_set=0):
+    return _run_op(lambda a: _core.broadcast(a, root_rank=root_rank,
+                                             name=name,
+                                             process_set=process_set),
+                   tensor)
+
+
+def alltoall(tensor, splits=None, name=None, process_set=0):
+    tf = _tf()
+    t = tf.convert_to_tensor(tensor)
+
+    def np_fn(a):
+        out = _core.alltoall(a, splits=splits, name=name,
+                             process_set=process_set)
+        if isinstance(out, tuple):
+            data, rs = out
+            return data, (np.asarray(rs, np.int64) if rs is not None
+                          else np.zeros(0, np.int64))
+        return out, np.zeros(0, np.int64)
+
+    if tf.executing_eagerly():
+        data, rs = np_fn(t.numpy())
+    else:
+        data, rs = tf.py_function(lambda a: np_fn(a.numpy()), [t],
+                                  [t.dtype, tf.int64])
+    if splits is not None:
+        return tf.convert_to_tensor(data), tf.convert_to_tensor(rs)
+    return tf.convert_to_tensor(data)
+
+
+def reducescatter(tensor, op=Average, name=None, process_set=0):
+    return _run_op(lambda a: _core.reducescatter(a, op=op, name=name,
+                                                 process_set=process_set),
+                   tensor)
+
+
+def broadcast_object(obj, root_rank=0, name=None, process_set=0):
+    return _core.broadcast_object(obj, root_rank=root_rank, name=name,
+                                  process_set=process_set)
+
+
+def broadcast_variables(variables, root_rank=0):
+    """Assign every variable its root-rank value (reference:
+    `broadcast_variables` / `broadcast_global_variables`). One fused
+    negotiation round via async broadcasts."""
+    variables = list(variables)
+    handles = [
+        _core.broadcast_async(v.numpy(), root_rank=root_rank,
+                              name=f"bcast.var.{i}")
+        for i, v in enumerate(variables)
+    ]
+    for v, h in zip(variables, handles):
+        v.assign(_core.synchronize(h))
+
+
+def DistributedGradientTape(tape, op=Average, compression=None,
+                            process_set=0, sparse_as_dense=False,
+                            num_groups=0):
+    """Wrap tf.GradientTape so gradient() allreduces the results in one
+    fused group (reference: `_DistributedGradientTape`)."""
+    tf = _tf()
+
+    class _Wrapped:
+        def __init__(self, tape):
+            self._tape = tape
+
+        def __getattr__(self, item):
+            return getattr(self._tape, item)
+
+        def gradient(self, target, sources, output_gradients=None):
+            grads = self._tape.gradient(target, sources, output_gradients)
+            flat = tf.nest.flatten(grads)
+            idx = [i for i, g in enumerate(flat) if g is not None]
+            if not idx:
+                return grads
+            dense = []
+            for i in idx:
+                g = flat[i]
+                if isinstance(g, tf.IndexedSlices):
+                    g = tf.convert_to_tensor(g)  # sparse_as_dense default
+                dense.append(g)
+            outs = _grouped_np(dense, op=op, name="tape.grads",
+                               process_set=process_set,
+                               compression=compression)
+            for j, i in enumerate(idx):
+                flat[i] = outs[j]
+            return tf.nest.pack_sequence_as(grads, flat)
+
+    return _Wrapped(tape)
+
+
+def _grouped_np(tensors, op, name, process_set, compression):
+    """Fused grouped allreduce of dense tf tensors; eager direct, traced
+    via tf.py_function (the collective still runs on the core's background
+    thread — the analog of the reference's AsyncOpKernel enqueue)."""
+    tf = _tf()
+
+    def np_fn(*arrs):
+        arrs = [a.numpy() if hasattr(a, "numpy") else np.asarray(a)
+                for a in arrs]
+        ctxs = []
+        if compression is not None:
+            pairs = [compression.compress(a) for a in arrs]
+            arrs = [p[0] for p in pairs]
+            ctxs = [p[1] for p in pairs]
+        outs = _core.grouped_allreduce(arrs, op=op, name=name,
+                                       process_set=process_set)
+        if compression is not None:
+            outs = [compression.decompress(o, c)
+                    for o, c in zip(outs, ctxs)]
+        return outs
+
+    if tf.executing_eagerly():
+        return [tf.convert_to_tensor(o) for o in np_fn(*tensors)]
+    outs = tf.py_function(np_fn, tensors, [t.dtype for t in tensors])
+    # py_function loses static shapes; restore them for downstream ops
+    for o, t in zip(outs, tensors):
+        o.set_shape(t.shape)
+    return outs
+
+
+def DistributedOptimizer(optimizer, op=Average, compression=None,
+                         process_set=0, backward_passes_per_step=1,
+                         name=None):
+    """Wrap a Keras optimizer: apply_gradients allreduces first
+    (reference: hvd.DistributedOptimizer for tf.keras)."""
+    tf = _tf()
+
+    class _DistOpt(optimizer.__class__):
+        _hvd_wrapped = True
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            gv = list(grads_and_vars)
+            grads = [g for g, _ in gv]
+            idx = [i for i, g in enumerate(grads) if g is not None]
+            dense = [tf.convert_to_tensor(grads[i]) for i in idx]
+            outs = _grouped_np(dense, op=op, name="opt.grads",
+                               process_set=process_set,
+                               compression=compression)
+            grads = list(grads)
+            for j, i in enumerate(idx):
+                grads[i] = outs[j]
+            out = list(zip(grads, [v for _, v in gv]))
+            return super().apply_gradients(out, *args, **kwargs)
+
+    obj = _DistOpt.from_config(optimizer.get_config())
+    return obj
+
+
+# -- elastic ----------------------------------------------------------------
+
+def _make_keras_state():
+    from .. import elastic as _elastic
+
+    class TensorFlowKerasState(_elastic.State):
+        """Elastic state for a Keras model+optimizer (reference:
+        horovod/tensorflow/elastic.py `TensorFlowKerasState`)."""
+
+        def __init__(self, model, optimizer=None, **kwargs):
+            super().__init__()
+            self.model = model
+            self.optimizer = optimizer
+            self._extras = dict(kwargs)
+            self._saved = None
+            self.save()
+
+        def __getattr__(self, name):
+            ex = object.__getattribute__(self, "__dict__").get(
+                "_extras", {})
+            if name in ex:
+                return ex[name]
+            raise AttributeError(name)
+
+        def __setattr__(self, name, value):
+            if name.startswith("_") or name in ("model", "optimizer"):
+                object.__setattr__(self, name, value)
+            elif "_extras" in self.__dict__ and name in self._extras:
+                self._extras[name] = value
+            else:
+                object.__setattr__(self, name, value)
+
+        def save(self):
+            self._saved = {
+                "weights": [w.copy() for w in self.model.get_weights()],
+                "extras": dict(self._extras),
+            }
+
+        def restore(self):
+            if self._saved is None:
+                return
+            self.model.set_weights(self._saved["weights"])
+            self._extras = dict(self._saved["extras"])
+
+        def sync(self):
+            broadcast_variables(self.model.variables, root_rank=0)
+            self._extras = broadcast_object(
+                self._extras, root_rank=0, name="keras_state.extras")
+            self.save()
+
+    return TensorFlowKerasState
+
+
+def __getattr__(name):
+    if name == "TensorFlowKerasState":
+        return _make_keras_state()
+    raise AttributeError(name)
+
+
+def metric_average(value, name=None):
+    arr = np.asarray(float(value), np.float64).reshape(1)
+    return float(_core.allreduce(arr, op=Average,
+                                 name=name or "tf.metric")[0])

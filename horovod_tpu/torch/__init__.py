@@ -1,0 +1,458 @@
+"""horovod_tpu.torch — the PyTorch framework binding.
+
+Reference parity: `horovod/torch/__init__.py` + `mpi_ops.py` +
+`mpi_ops_v2.cc` — async collectives returning integer handles,
+hook-based `DistributedOptimizer` overlapping gradient allreduce with the
+backward pass, `broadcast_parameters` / `broadcast_optimizer_state`,
+`SyncBatchNorm`. The reference needs a C++ torch extension because its
+tensors live on CUDA streams; here torch tensors are host memory (TPU
+compute goes through JAX), so the binding adapts `torch.Tensor` ↔ the same
+native core the other frontends use (zero-copy via numpy views).
+"""
+
+import numpy as np
+import torch
+
+from ..basics import basics as _basics
+from ..compression import Compression  # noqa: F401
+from ..exceptions import (  # noqa: F401
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from ..ops import collective_ops as _core
+from ..ops.collective_ops import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    Sum,
+    barrier,
+    join,
+)
+from ..process_sets import (  # noqa: F401
+    ProcessSet,
+    add_process_set,
+    global_process_set,
+    remove_process_set,
+)
+
+
+def init():
+    import horovod_tpu as _pkg
+
+    return _pkg.init()
+
+
+shutdown = _basics.shutdown
+is_initialized = _basics.is_initialized
+rank = _basics.rank
+size = _basics.size
+local_rank = _basics.local_rank
+local_size = _basics.local_size
+cross_rank = _basics.cross_rank
+cross_size = _basics.cross_size
+
+
+def _to_numpy(t):
+    return t.detach().cpu().numpy()
+
+
+def _from_numpy(a, like):
+    return torch.from_numpy(np.ascontiguousarray(a)).to(like.dtype)
+
+
+# -- sync collectives -------------------------------------------------------
+
+def allreduce(tensor, op=Average, name=None, process_set=0,
+              prescale_factor=1.0, postscale_factor=1.0, compression=None):
+    a = _to_numpy(tensor)
+    ctx = None
+    if compression is not None:
+        a, ctx = compression.compress(a)
+    out = _core.allreduce(a, op=op, name=name,
+                          prescale_factor=prescale_factor,
+                          postscale_factor=postscale_factor,
+                          process_set=process_set)
+    if compression is not None:
+        out = compression.decompress(out, ctx)
+    return _from_numpy(out, tensor)
+
+
+def allreduce_(tensor, **kw):
+    tensor.copy_(allreduce(tensor, **kw))
+    return tensor
+
+
+def allgather(tensor, name=None, process_set=0):
+    return torch.from_numpy(np.ascontiguousarray(
+        _core.allgather(_to_numpy(tensor), name=name,
+                        process_set=process_set)))
+
+
+def broadcast(tensor, root_rank, name=None, process_set=0):
+    return _from_numpy(
+        _core.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name,
+                        process_set=process_set), tensor)
+
+
+def broadcast_(tensor, root_rank, **kw):
+    tensor.copy_(broadcast(tensor, root_rank, **kw))
+    return tensor
+
+
+def alltoall(tensor, splits=None, name=None, process_set=0):
+    out = _core.alltoall(_to_numpy(tensor), splits=splits, name=name,
+                         process_set=process_set)
+    if isinstance(out, tuple):
+        data, recv_splits = out
+        return (torch.from_numpy(np.ascontiguousarray(data)),
+                torch.from_numpy(np.asarray(recv_splits))
+                if recv_splits is not None else None)
+    return torch.from_numpy(np.ascontiguousarray(out))
+
+
+def reducescatter(tensor, op=Average, name=None, process_set=0):
+    return torch.from_numpy(np.ascontiguousarray(
+        _core.reducescatter(_to_numpy(tensor), op=op, name=name,
+                            process_set=process_set)))
+
+
+def broadcast_object(obj, root_rank=0, name=None, process_set=0):
+    return _core.broadcast_object(obj, root_rank=root_rank, name=name,
+                                  process_set=process_set)
+
+
+# -- async + handles --------------------------------------------------------
+
+class TorchHandle:
+    """Core handle + optional in-place target tensor (reference:
+    handle_manager.cc handles are ints; the in-place variants remember the
+    destination)."""
+
+    __slots__ = ("core", "target")
+
+    def __init__(self, core_handle, target=None):
+        self.core = core_handle
+        self.target = target
+
+
+def allreduce_async(tensor, op=Average, name=None, process_set=0):
+    return TorchHandle(_core.allreduce_async(
+        _to_numpy(tensor), op=op, name=name, process_set=process_set))
+
+
+def allreduce_async_(tensor, op=Average, name=None, process_set=0):
+    """Async in-place allreduce; synchronize() copies the result back."""
+    return TorchHandle(_core.allreduce_async(
+        _to_numpy(tensor), op=op, name=name, process_set=process_set),
+        target=tensor)
+
+
+def broadcast_async_(tensor, root_rank, name=None, process_set=0):
+    return TorchHandle(_core.broadcast_async(
+        _to_numpy(tensor), root_rank=root_rank, name=name,
+        process_set=process_set), target=tensor)
+
+
+def poll(handle):
+    return _core.poll(handle.core if isinstance(handle, TorchHandle)
+                      else handle)
+
+
+def synchronize(handle):
+    target = None
+    if isinstance(handle, TorchHandle):
+        target = handle.target
+        handle = handle.core
+    out = _core.synchronize(handle)
+    if target is not None:
+        target.copy_(_from_numpy(out, target))
+        return target
+    if isinstance(out, tuple):
+        return tuple(torch.from_numpy(np.ascontiguousarray(o))
+                     if isinstance(o, np.ndarray) else o for o in out)
+    return torch.from_numpy(np.ascontiguousarray(out))
+
+
+# -- model/optimizer sync ---------------------------------------------------
+
+def broadcast_parameters(params, root_rank=0):
+    """In-place broadcast of a state_dict or named_parameters iterable
+    (reference: horovod/torch `broadcast_parameters`)."""
+    if hasattr(params, "items"):
+        items = list(params.items())
+    else:
+        items = list(params)
+    handles = [broadcast_async_(p.data if hasattr(p, "data") else p,
+                                root_rank, name=f"bcast.param.{n}")
+               for n, p in items if torch.is_tensor(
+                   p.data if hasattr(p, "data") else p)]
+    for h in handles:
+        synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer, root_rank=0):
+    """Broadcast optimizer state dict from root (reference:
+    `broadcast_optimizer_state`)."""
+    state = broadcast_object(optimizer.state_dict(), root_rank=root_rank,
+                             name="bcast.opt_state")
+    optimizer.load_state_dict(state)
+
+
+class _DistributedOptimizerMixin:
+    """Mixed into a dynamic subclass of the user's optimizer class (the
+    reference's own construction in horovod/torch/__init__.py), so the
+    wrapper IS a full torch Optimizer — defaults, param_groups,
+    add_param_group, LR schedulers all behave."""
+
+    def _hvd_init(self, named_parameters, op, compression,
+                  backward_passes_per_step, process_set):
+        self._hvd_op = op
+        self._hvd_compression = compression
+        self._hvd_bpps = backward_passes_per_step
+        self._hvd_process_set = process_set
+        self._hvd_step_count = 0
+        self._hvd_handles = {}
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = [(f"param.{i}.{j}", p)
+                     for i, g in enumerate(self.param_groups)
+                     for j, p in enumerate(g["params"])]
+        self._hvd_names = {p: n for n, p in named}
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    p.register_post_accumulate_grad_hook(self._hvd_hook)
+
+    def _hvd_hook(self, p):
+        if (self._hvd_step_count + 1) % self._hvd_bpps != 0:
+            return
+        if p in self._hvd_handles:
+            return
+        a = p.grad.detach().cpu().numpy()
+        ctx = None
+        if self._hvd_compression is not None:
+            a, ctx = self._hvd_compression.compress(a)
+        if self._hvd_bpps > 1:
+            a = a / self._hvd_bpps
+        h = _core.allreduce_async(
+            a, op=self._hvd_op,
+            name=f"allreduce.{self._hvd_names.get(p, id(p))}",
+            process_set=self._hvd_process_set)
+        self._hvd_handles[p] = (h, ctx)
+
+    def synchronize(self):
+        for p, (h, ctx) in list(self._hvd_handles.items()):
+            out = _core.synchronize(h)
+            if self._hvd_compression is not None:
+                out = self._hvd_compression.decompress(out, ctx)
+            p.grad.copy_(torch.from_numpy(
+                np.ascontiguousarray(out)).to(p.grad.dtype))
+        self._hvd_handles.clear()
+
+    def step(self, closure=None):
+        self._hvd_step_count += 1
+        if self._hvd_step_count % self._hvd_bpps != 0:
+            # accumulate locally (reference: backward_passes_per_step);
+            # caller must zero_grad only after the applying step
+            return None
+        self.synchronize()
+        return super().step(closure)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None, op=Average,
+                         compression=None, backward_passes_per_step=1,
+                         process_set=0):
+    """Wrap a torch optimizer: backward hooks launch async allreduces per
+    gradient (overlapped with the rest of backward); step() synchronizes
+    then applies (reference: horovod/torch DistributedOptimizer)."""
+    cls = type("DistributedOptimizer",
+               (_DistributedOptimizerMixin, optimizer.__class__), {})
+    dist = cls.__new__(cls)
+    dist.__dict__.update(optimizer.__dict__)
+    dist._hvd_init(named_parameters, op, compression,
+                   backward_passes_per_step, process_set)
+    return dist
+
+
+class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
+    """Cross-rank synchronized BatchNorm (reference:
+    horovod/torch/sync_batch_norm.py): mean/var are averaged over all ranks
+    before normalization."""
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError(f"expected >=2D input, got {input.dim()}D")
+
+    def forward(self, input):
+        if not self.training or size() == 1:
+            return torch.nn.functional.batch_norm(
+                input, self.running_mean, self.running_var, self.weight,
+                self.bias, self.training, self.momentum, self.eps)
+        y, mean, var = _SyncBNFunction.apply(input, self.eps)
+        if self.track_running_stats:
+            with torch.no_grad():
+                m = self.momentum if self.momentum is not None else 0.1
+                self.running_mean.mul_(1 - m).add_(mean * m)
+                self.running_var.mul_(1 - m).add_(var * m)
+        shape = [1, -1] + [1] * (input.dim() - 2)
+        w = self.weight.view(shape) if self.weight is not None else 1.0
+        b = self.bias.view(shape) if self.bias is not None else 0.0
+        return y * w + b
+
+
+class _SyncBNFunction(torch.autograd.Function):
+    """Normalization over the GLOBAL batch with exact gradients: the
+    backward allreduces sum(dL/dy) and sum(dL/dy * y) so every rank's
+    input gradient carries the cross-rank terms flowing through the shared
+    mean/var (reference: the backward collective in
+    horovod/torch/sync_batch_norm.py)."""
+
+    @staticmethod
+    def forward(ctx, input, eps):
+        dims = [0] + list(range(2, input.dim()))
+        n_local = input.numel() // input.shape[1]
+        local = torch.cat([input.sum(dims), (input * input).sum(dims)])
+        n_total = float(_core.allreduce(np.array([n_local], np.float64),
+                                        op=Sum, name="syncbn.n")[0])
+        gsum = torch.from_numpy(np.ascontiguousarray(_core.allreduce(
+            local.detach().cpu().numpy(), op=Sum,
+            name="syncbn.stats"))).to(input.dtype)
+        C = input.shape[1]
+        mean = gsum[:C] / n_total
+        var = gsum[C:] / n_total - mean * mean
+        shape = [1, -1] + [1] * (input.dim() - 2)
+        invstd = torch.rsqrt(var + eps)
+        y = (input - mean.view(shape)) * invstd.view(shape)
+        ctx.save_for_backward(y, invstd)
+        ctx.n_total = n_total
+        ctx.dims = dims
+        return y, mean, var
+
+    @staticmethod
+    def backward(ctx, gy, _gmean, _gvar):
+        y, invstd = ctx.saved_tensors
+        dims = ctx.dims
+        local = torch.cat([gy.sum(dims), (gy * y).sum(dims)])
+        gsum = torch.from_numpy(np.ascontiguousarray(_core.allreduce(
+            local.detach().cpu().numpy(), op=Sum,
+            name="syncbn.grad"))).to(gy.dtype)
+        C = gy.shape[1]
+        shape = [1, -1] + [1] * (gy.dim() - 2)
+        mean_gy = (gsum[:C] / ctx.n_total).view(shape)
+        mean_gy_y = (gsum[C:] / ctx.n_total).view(shape)
+        gx = invstd.view(shape) * (gy - mean_gy - y * mean_gy_y)
+        return gx, None
+
+
+# -- elastic ----------------------------------------------------------------
+
+class TorchState:
+    """Elastic state for torch model+optimizer (reference:
+    horovod/torch/elastic TorchState), built on ObjectState semantics."""
+
+    def __new__(cls, model=None, optimizer=None, **kwargs):
+        from .. import elastic as _elastic
+
+        class _TorchState(_elastic.State):
+            def __init__(self, model, optimizer, extras):
+                super().__init__()
+                self.model = model
+                self.optimizer = optimizer
+                self._extras = dict(extras)
+                self._saved = None
+                self.save()
+
+            def __getattr__(self, name):
+                ex = object.__getattribute__(self, "__dict__").get(
+                    "_extras", {})
+                if name in ex:
+                    return ex[name]
+                raise AttributeError(name)
+
+            def __setattr__(self, name, value):
+                if name.startswith("_") or name in ("model", "optimizer"):
+                    object.__setattr__(self, name, value)
+                elif "_extras" in self.__dict__ and name in self._extras:
+                    self._extras[name] = value
+                else:
+                    object.__setattr__(self, name, value)
+
+            def save(self):
+                import copy
+                self._saved = {
+                    "model": copy.deepcopy(self.model.state_dict())
+                    if self.model is not None else None,
+                    "opt": copy.deepcopy(self.optimizer.state_dict())
+                    if self.optimizer is not None else None,
+                    "extras": copy.deepcopy(self._extras),
+                }
+
+            def restore(self):
+                if self._saved is None:
+                    return
+                if self.model is not None:
+                    self.model.load_state_dict(self._saved["model"])
+                if self.optimizer is not None:
+                    self.optimizer.load_state_dict(self._saved["opt"])
+                self._extras = dict(self._saved["extras"])
+
+            def sync(self):
+                if self.model is not None:
+                    broadcast_parameters(self.model.state_dict(),
+                                         root_rank=0)
+                if self.optimizer is not None:
+                    broadcast_optimizer_state(self.optimizer, root_rank=0)
+                self._extras = broadcast_object(self._extras, root_rank=0,
+                                                name="torch_state.extras")
+                self.save()
+
+        return _TorchState(model, optimizer, kwargs)
+
+
+class ElasticSampler(torch.utils.data.Sampler):
+    """Shard-aware resumable sampler (reference:
+    horovod/torch/elastic/sampler.py): shards indices by rank/size,
+    reshards on reset, skips already-processed indices after restore."""
+
+    def __init__(self, dataset, shuffle=True, seed=0):
+        self.dataset = dataset
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_indices = set()
+        self.reset()
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+        self.processed_indices = set()
+        self.reset()
+
+    def record_batch(self, batch_idx, batch_size):
+        start = batch_idx * batch_size
+        self.processed_indices.update(
+            self.indices[start:start + batch_size])
+
+    def reset(self):
+        self.rank = rank() if is_initialized() else 0
+        self.world = size() if is_initialized() else 1
+        idx = list(range(len(self.dataset)))
+        if self.shuffle:
+            import random
+            random.Random(self.seed + self.epoch).shuffle(idx)
+        idx = [i for i in idx if i not in self.processed_indices]
+        self.indices = idx[self.rank::self.world]
+
+    def __iter__(self):
+        return iter(self.indices)
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def metric_average(value, name=None):
+    arr = np.asarray(float(value), np.float64).reshape(1)
+    return float(_core.allreduce(arr, op=Average,
+                                 name=name or "torch.metric")[0])

@@ -1,0 +1,22 @@
+"""Framework binding tests: torch and TF workers under the real 2-process
+launcher (reference: test/parallel/test_torch.py, test_tensorflow.py run
+via `horovodrun -np 2 pytest ...`)."""
+
+import pytest
+
+from .util import run_worker_job
+
+
+def test_torch_binding_2proc():
+    pytest.importorskip("torch")
+    run_worker_job(2, "torch_worker.py", timeout=240)
+
+
+def test_torch_binding_4proc():
+    pytest.importorskip("torch")
+    run_worker_job(4, "torch_worker.py", timeout=240)
+
+
+def test_tf_binding_2proc():
+    pytest.importorskip("tensorflow")
+    run_worker_job(2, "tf_worker.py", timeout=300)

@@ -1,0 +1,121 @@
+"""TF binding worker: collectives, DistributedGradientTape,
+broadcast_variables, Keras callbacks. (Reference coverage model:
+test/parallel/test_tensorflow.py.)"""
+import os
+
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+import numpy as np  # noqa: E402
+import tensorflow as tf  # noqa: E402
+
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+
+# collectives (eager)
+out = hvd.allreduce(tf.fill([8], float(r + 1)), op=hvd.Sum)
+assert np.allclose(out.numpy(), s * (s + 1) / 2.0)
+g = hvd.allgather(tf.fill([2, 3], r))
+assert g.shape == (2 * s, 3)
+b = hvd.broadcast(tf.range(4, dtype=tf.float32) * float(r + 1),
+                  root_rank=0)
+assert np.allclose(b.numpy(), np.arange(4))
+
+# grouped allreduce
+outs = hvd.grouped_allreduce([tf.fill([4], float(r)),
+                              tf.fill([6], 2.0 * r)], op=hvd.Sum)
+assert np.allclose(outs[0].numpy(), sum(range(s)))
+assert np.allclose(outs[1].numpy(), 2.0 * sum(range(s)))
+
+# inside tf.function (the graph path)
+@tf.function
+def reduced(x):
+    return hvd.allreduce(x, op=hvd.Average, name="infn")
+
+out = reduced(tf.fill([5], float(r)))
+assert np.allclose(out.numpy(), (s - 1) / 2.0), out.numpy()
+
+# DistributedGradientTape on a small model; different data per rank
+tf.random.set_seed(100 + r)
+model = tf.keras.Sequential([
+    tf.keras.layers.Dense(8, activation="relu"),
+    tf.keras.layers.Dense(1),
+])
+model.build((None, 4))
+hvd.broadcast_variables(model.variables, root_rank=0)
+opt = tf.keras.optimizers.SGD(0.05)
+x = tf.random.normal((16, 4))
+y = tf.random.normal((16, 1))
+for _ in range(3):
+    with tf.GradientTape() as tape:
+        tape = hvd.DistributedGradientTape(tape)
+        loss = tf.reduce_mean((model(x) - y) ** 2)
+    grads = tape.gradient(loss, model.trainable_variables)
+    opt.apply_gradients(zip(grads, model.trainable_variables))
+
+for i, v in enumerate(model.variables):
+    ref = hvd.broadcast(tf.identity(v), root_rank=0)
+    assert np.allclose(v.numpy(), ref.numpy(), atol=1e-6), \
+        f"var {i} diverged"
+
+# metric average
+assert abs(hvd.metric_average(float(r)) - (s - 1) / 2.0) < 1e-9
+
+# DistributedOptimizer inside compiled model.fit (the graph path:
+# apply_gradients runs under tf.function and lowers via tf.py_function)
+tf.random.set_seed(200 + r)
+fit_model = tf.keras.Sequential([tf.keras.layers.Dense(1)])
+fit_model.build((None, 3))
+hvd.broadcast_variables(fit_model.variables, root_rank=0)
+dopt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.05))
+fit_model.compile(optimizer=dopt, loss="mse")  # run_eagerly NOT set
+fx = tf.random.normal((8, 3))
+fy = tf.random.normal((8, 1))
+fit_model.fit(fx, fy, epochs=1, batch_size=4, verbose=0)
+for i, v in enumerate(fit_model.variables):
+    ref = hvd.broadcast(tf.identity(v), root_rank=0)
+    assert np.allclose(v.numpy(), ref.numpy(), atol=1e-6), \
+        f"fit var {i} diverged"
+
+# Keras callbacks (reference: horovod/_keras/callbacks.py)
+import horovod_tpu.keras as hvd_keras  # noqa: E402
+
+cb_model = tf.keras.Sequential([tf.keras.layers.Dense(2)])
+cb_model.build((None, 3))
+cb_model.optimizer = tf.keras.optimizers.SGD(0.4)
+# desync weights, then BroadcastGlobalVariablesCallback resyncs
+for v in cb_model.variables:
+    v.assign(v + float(r))
+bcast_cb = hvd_keras.BroadcastGlobalVariablesCallback(root_rank=0)
+bcast_cb.set_model(cb_model)
+bcast_cb.on_train_begin()
+for v in cb_model.variables:
+    ref = hvd.broadcast(tf.identity(v), root_rank=0)
+    assert np.allclose(v.numpy(), ref.numpy())
+
+avg_cb = hvd_keras.MetricAverageCallback()
+avg_cb.set_model(cb_model)
+logs = {"loss": float(r)}
+avg_cb.on_epoch_end(0, logs)
+assert abs(logs["loss"] - (s - 1) / 2.0) < 1e-9, logs
+
+warm_cb = hvd_keras.LearningRateWarmupCallback(initial_lr=0.4,
+                                               warmup_epochs=2)
+warm_cb.set_model(cb_model)
+warm_cb.on_epoch_begin(0)
+lr0 = float(cb_model.optimizer.learning_rate.numpy())
+assert lr0 < 0.4 or s == 1, lr0
+warm_cb.on_epoch_begin(2)
+assert abs(float(cb_model.optimizer.learning_rate.numpy()) - 0.4) < 1e-6
+
+sched_cb = hvd_keras.LearningRateScheduleCallback(initial_lr=0.4,
+                                                  multiplier=0.1,
+                                                  start_epoch=5)
+sched_cb.set_model(cb_model)
+sched_cb.on_epoch_begin(5)
+assert abs(float(cb_model.optimizer.learning_rate.numpy()) - 0.04) < 1e-6
+
+print(f"rank {r}: TF PASS", flush=True)
+hvd.shutdown()

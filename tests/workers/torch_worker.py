@@ -1,0 +1,93 @@
+"""Torch binding worker: collectives, DistributedOptimizer training-step
+convergence across ranks, broadcast_parameters/optimizer_state, SyncBN.
+(Reference coverage model: test/parallel/test_torch.py.)"""
+import os
+
+import numpy as np
+import torch
+
+import horovod_tpu.torch as hvd
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+torch.manual_seed(1234 + r)  # intentionally different per rank
+
+# collectives
+t = torch.full((10,), float(r + 1))
+out = hvd.allreduce(t, op=hvd.Sum)
+assert torch.allclose(out, torch.full((10,), s * (s + 1) / 2.0)), out
+g = hvd.allgather(torch.full((2, 2), float(r)))
+assert g.shape == (2 * s, 2)
+b = hvd.broadcast(torch.arange(4, dtype=torch.float32) * (r + 1),
+                  root_rank=0)
+assert torch.allclose(b, torch.arange(4, dtype=torch.float32))
+
+# model sync + hook-based DistributedOptimizer
+model = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.ReLU(),
+                            torch.nn.Linear(8, 1))
+hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+w0 = [p.detach().clone() for p in model.parameters()]
+opt = torch.optim.SGD(model.parameters(), lr=0.05)
+opt = hvd.DistributedOptimizer(
+    opt, named_parameters=model.named_parameters())
+hvd.broadcast_optimizer_state(opt, root_rank=0)
+
+xs = torch.randn(16, 4)  # different data per rank (different seed)
+ys = torch.randn(16, 1)
+for step in range(3):
+    opt.zero_grad()
+    loss = torch.nn.functional.mse_loss(model(xs), ys)
+    loss.backward()
+    opt.step()
+
+# after synced init + averaged grads, params must be identical across ranks
+for i, p in enumerate(model.parameters()):
+    arr = p.detach().numpy()
+    ref = hvd.broadcast(p.detach(), root_rank=0).numpy()
+    assert np.allclose(arr, ref, atol=1e-6), f"param {i} diverged"
+    assert not torch.allclose(p, w0[i]), f"param {i} did not train"
+
+# sync batch norm: stats averaged over ALL ranks' samples. Rank r feeds a
+# constant r, so global mean = mean(r) and var = E[r^2]-mean^2; each rank's
+# normalized output must use the GLOBAL stats, not its local (zero) var.
+bn = hvd.SyncBatchNorm(3)
+bn.train()
+x = torch.full((4, 3, 2), float(r))
+y = bn(x)
+gmean = sum(range(s)) / s
+gvar = sum(i * i for i in range(s)) / s - gmean ** 2
+expect = (r - gmean) / np.sqrt(gvar + bn.eps)
+assert torch.allclose(y, torch.full_like(y, expect), atol=1e-4), \
+    (y.flatten()[0].item(), expect)
+assert np.allclose(bn.running_mean.numpy(), 0.9 * 0 + 0.1 * gmean,
+                   atol=1e-5)
+
+# the wrapper must be a full torch Optimizer (defaults, add_param_group)
+extra_param = torch.nn.Parameter(torch.zeros(2))
+opt.add_param_group({"params": [extra_param]})
+assert isinstance(opt, torch.optim.Optimizer)
+assert "lr" in opt.defaults
+
+# SyncBN backward: grads must match full-batch BatchNorm (stats are
+# differentiated through the local contribution)
+full = torch.arange(2 * s * 3 * 2, dtype=torch.float32).reshape(2 * s, 3, 2)
+full = full / full.numel()
+local = full[2 * r:2 * (r + 1)].clone().requires_grad_(True)
+bn_sync = hvd.SyncBatchNorm(3, affine=False)
+bn_sync.train()
+(bn_sync(local) ** 3).sum().backward()
+ref_in = full.clone().requires_grad_(True)
+bn_ref = torch.nn.BatchNorm1d(3, affine=False)
+bn_ref.train()
+(bn_ref(ref_in) ** 3).sum().backward()
+assert np.allclose(local.grad.numpy(),
+                   ref_in.grad[2 * r:2 * (r + 1)].numpy(), atol=1e-4), \
+    np.abs(local.grad.numpy()
+           - ref_in.grad[2 * r:2 * (r + 1)].numpy()).max()
+
+# metric average
+m = hvd.metric_average(float(r), name="m")
+assert abs(m - (s - 1) / 2.0) < 1e-9
+
+print(f"rank {r}: TORCH PASS", flush=True)
+hvd.shutdown()
