@@ -63,20 +63,57 @@ from .process_sets import (  # noqa: F401
     remove_process_set,
 )
 
+def _maybe_init_jax_mesh():
+    """Join the job-wide jax.distributed mesh when tpurun provisioned one.
+
+    Gated so non-JAX users (torch/TF workers) never pay a jax import: we
+    initialize only when the launcher exported HVD_JAX_COORD_ADDR AND this
+    process already imported jax (or forced via HVD_JAX_DISTRIBUTED=1).
+    Elastic jobs skip it (see horovod_tpu/jax/distributed.py docstring).
+    """
+    import os as _os
+    import sys as _sys
+
+    gate = _os.environ.get("HVD_JAX_DISTRIBUTED")
+    if gate == "0" or not _os.environ.get("HVD_JAX_COORD_ADDR"):
+        return
+    if _os.environ.get("HVD_ELASTIC") == "1" and gate != "1":
+        return
+    if "jax" not in _sys.modules and gate != "1":
+        return
+    from .jax import distributed as _jd
+
+    _jd.initialize_from_env()
+
+
 def init():
     """Initialize the core. Under an elastic job (HVD_ELASTIC=1, spawned by
     `tpurun --min-np/...`) this first rendezvouses with the driver's KV
-    store for the current epoch's rank/size/controller assignment."""
+    store for the current epoch's rank/size/controller assignment. When the
+    launcher provisioned a jax.distributed coordinator (static multi-process
+    jobs), all processes also join ONE global device mesh so in-jit
+    collectives cross process boundaries over ICI."""
     import os as _os
 
     if _os.environ.get("HVD_ELASTIC") == "1":
         from .runner.elastic import worker as _worker
 
-        return _worker.rendezvous_init()
-    return _basics.init()
+        rc = _worker.rendezvous_init()
+        _maybe_init_jax_mesh()
+        return rc
+    rc = _basics.init()
+    _maybe_init_jax_mesh()
+    return rc
 
 
-shutdown = _basics.shutdown
+def shutdown():
+    import sys as _sys
+
+    if "horovod_tpu.jax.distributed" in _sys.modules:
+        from .jax import distributed as _jd
+
+        _jd.shutdown()
+    return _basics.shutdown()
 is_initialized = _basics.is_initialized
 rank = _basics.rank
 size = _basics.size

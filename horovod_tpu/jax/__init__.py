@@ -38,6 +38,13 @@ from ..ops.jax_ops import (  # noqa: F401
     hvd_broadcast_pytree as broadcast_parameters,
 )
 from ..ops.collective_ops import join, barrier, poll, synchronize  # noqa: F401
+from .distributed import (  # noqa: F401  (multi-process ICI mesh)
+    global_mesh,
+    initialize_from_env as init_distributed,
+    is_multiprocess,
+    process_allgather,
+    shard_local_batch,
+)
 from ..process_sets import (  # noqa: F401
     ProcessSet,
     add_process_set,
@@ -52,7 +59,12 @@ def init():
     return _pkg.init()
 
 
-shutdown = _basics.shutdown
+def shutdown():
+    """Symmetric with init(): tears down the jax.distributed mesh (when one
+    was formed) and the native core."""
+    import horovod_tpu as _pkg
+
+    return _pkg.shutdown()
 is_initialized = _basics.is_initialized
 rank = _basics.rank
 size = _basics.size

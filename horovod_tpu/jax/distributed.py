@@ -1,0 +1,173 @@
+"""Multi-process global device mesh — the cross-process ICI data plane.
+
+Reference parity: ``horovod/common/ops/nccl_operations.cc`` (``NCCLAllreduce``
+and the communicator cache) — in the reference, one process per GPU joins a
+NCCL communicator and device collectives ride NVLink/IB while MPI/Gloo carry
+the control plane. The TPU-native equivalent built here: each
+``tpurun``-launched process binds its TPU chip(s), joins the
+``jax.distributed`` coordination service (rendezvous address allocated by the
+launcher next to the TCP controller — ``HVD_JAX_COORD_ADDR``), and
+``jax.devices()`` becomes the GLOBAL device list spanning every process.
+Collectives inside ``jit`` over a global :class:`jax.sharding.Mesh`
+(``psum`` / ``all_gather`` / ``ppermute`` / ...) then execute over **ICI
+across process boundaries** — no host round-trip — while the native TCP core
+(``csrc/``) remains the control / elastic / DCN plane (SURVEY.md §5
+"Distributed communication backend").
+
+Elastic note: jobs launched with ``--min-np``/``--max-np`` intentionally do
+NOT form a jax.distributed mesh — resizing one requires a full PJRT backend
+teardown per rendezvous epoch (SURVEY.md §7 hard part (c)); elastic jobs use
+the core-bridged data plane instead. Force with ``HVD_JAX_DISTRIBUTED=1``.
+"""
+
+import os
+import warnings
+
+_initialized_here = False
+
+
+def is_multiprocess():
+    """True when this process is part of a jax.distributed job.
+
+    Reads the coordination-service state only — never initializes an XLA
+    backend (calling this before hvd.init() must not poison
+    ``initialize_from_env``, which requires an uninitialized backend).
+    """
+    if _initialized_here:
+        return True
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None \
+            and (_dist.global_state.num_processes or 1) > 1
+    except Exception:
+        return False
+
+
+def _backends_live():
+    try:
+        import jax._src.xla_bridge as _xb
+
+        return _xb.backends_are_initialized()
+    except Exception:
+        return False
+
+
+def initialize_from_env(timeout=None):
+    """Join the job-wide jax.distributed coordination service.
+
+    Reads the slot environment exported by ``tpurun`` (``HVD_RANK``,
+    ``HVD_SIZE``, ``HVD_JAX_COORD_ADDR``). Rank 0 serves the coordination
+    service on the advertised address. Idempotent; returns True when a
+    multi-process mesh is (now) live.
+
+    If this process already initialized an XLA backend (the user ran a jax
+    computation before ``hvd.init()``), forming the mesh is impossible —
+    we warn and fall back to the core-bridged data plane instead of
+    crashing. Since every rank runs the same script, the skip is symmetric.
+    """
+    global _initialized_here
+    addr = os.environ.get("HVD_JAX_COORD_ADDR")
+    size = int(os.environ.get("HVD_SIZE", "1"))
+    if not addr or size < 2:
+        return False
+    import jax
+
+    if _initialized_here:
+        return True
+    if _backends_live():
+        warnings.warn(
+            "horovod_tpu: an XLA backend was initialized before hvd.init(); "
+            "cannot form the multi-process device mesh (collectives will use "
+            "the core-bridged plane). Call hvd.init() before any JAX "
+            "computation to get the ICI in-mesh data plane.",
+            RuntimeWarning, stacklevel=3)
+        return False
+    rank = int(os.environ.get("HVD_RANK", "0"))
+    timeout = timeout or int(os.environ.get("HVD_JAX_COORD_TIMEOUT", "120"))
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=size,
+        process_id=rank,
+        initialization_timeout=timeout,
+    )
+    _initialized_here = True
+    return True
+
+
+def shutdown():
+    """Leave the coordination service (called from hvd.shutdown)."""
+    global _initialized_here
+    if not _initialized_here:
+        return
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    finally:
+        _initialized_here = False
+
+
+def force_cpu_platform(n_local_devices=None):
+    """Test/simulation helper: pin this process to the CPU platform with
+    ``n_local_devices`` virtual devices, overriding any site hook that
+    pre-registered a TPU plugin. Must run before ``initialize_from_env``.
+
+    This is the "fake pod" of SURVEY.md §4: N processes × M virtual CPU
+    devices on localhost stand in for an N-host TPU slice.
+    """
+    if n_local_devices:
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(
+            f"--xla_force_host_platform_device_count={n_local_devices}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if jax.config.jax_platforms != "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        import jax.extend as jex
+
+        jex.backend.clear_backends()
+
+
+def global_mesh(axis_sizes=None):
+    """Build a Mesh over the GLOBAL device list (all processes' chips).
+
+    With ``axis_sizes=None`` this is the pure-DP layout — one ``data`` axis
+    over every chip in the job, the exact analog of the reference's
+    one-rank-per-GPU NCCL ring. Multi-axis layouts (dp×tp×sp×ep) work the
+    same way; collectives ride ICI along each axis.
+    """
+    import jax
+
+    from ..parallel.mesh import create_mesh
+
+    return create_mesh(axis_sizes, devices=jax.devices())
+
+
+def shard_local_batch(batch, mesh, data_axis="data"):
+    """Assemble a global array from each process's LOCAL batch shard.
+
+    Each process feeds only the data for its own chips (dim0 =
+    global_batch / process_count); the result is one global array sharded
+    over ``data_axis``. This is the multi-controller input pipeline — the
+    analog of each Horovod rank reading its own shard of the dataset.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(data_axis))
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x), batch)
+
+
+def process_allgather(x):
+    """Gather a per-process host value to every process (small metadata
+    sync outside jit; reference analog: the control plane's allgather)."""
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x))

@@ -128,13 +128,22 @@ def _run_static(args):
     rank0_host = slots[0].hostname
     ctrl_host = "127.0.0.1" if hosts_mod.is_local(rank0_host) else rank0_host
     ctrl = f"{ctrl_host}:{port}"
+    # jax.distributed coordinator (served by rank 0) — the cross-process
+    # ICI mesh rendezvous; see horovod_tpu/jax/distributed.py.
+    # NOTE: like the ctrl port above, the port is probed free on the
+    # LAUNCHER host; when rank 0 is remote it may collide there. The
+    # driver/task services negotiate real ports on each host (reference:
+    # runner/driver/driver_service.py) — both allocations route through
+    # that once a remote host is involved.
+    jax_coord = f"{ctrl_host}:{find_free_port()}"
 
     procs = []
     try:
         for s in slots:
             env = slot_env(s.rank, s.size, s.local_rank, s.local_size,
                            s.cross_rank, s.cross_size,
-                           controller_addr=ctrl, extra_env=extra)
+                           controller_addr=ctrl, jax_coord_addr=jax_coord,
+                           extra_env=extra)
             if hosts_mod.is_local(s.hostname):
                 procs.append(safe_exec(list(args.command), env=env))
             else:

@@ -24,8 +24,14 @@ def find_free_port():
 
 
 def slot_env(rank, size, local_rank=None, local_size=None, cross_rank=None,
-             cross_size=None, controller_addr=None, extra_env=None):
-    """Environment for one rank (reference: the HOROVOD_RANK/... slot env)."""
+             cross_size=None, controller_addr=None, jax_coord_addr=None,
+             extra_env=None):
+    """Environment for one rank (reference: the HOROVOD_RANK/... slot env).
+
+    ``jax_coord_addr`` provisions the jax.distributed coordination service
+    (rank 0 serves it) so all ranks form one global device mesh — the ICI
+    data plane across processes (see horovod_tpu/jax/distributed.py).
+    """
     env = dict(os.environ)
     env["HVD_RANK"] = str(rank)
     env["HVD_SIZE"] = str(size)
@@ -35,26 +41,32 @@ def slot_env(rank, size, local_rank=None, local_size=None, cross_rank=None,
     env["HVD_CROSS_SIZE"] = str(cross_size if cross_size is not None else 1)
     if controller_addr:
         env["HVD_CONTROLLER_ADDR"] = controller_addr
+    if jax_coord_addr:
+        env["HVD_JAX_COORD_ADDR"] = jax_coord_addr
     if extra_env:
         env.update({k: str(v) for k, v in extra_env.items()})
     return env
 
 
 def run_local(np_, command, env=None, timeout=None, stdout=None,
-              controller_port=None, bind_tpu_chips=False):
+              controller_port=None, bind_tpu_chips=False, jax_coord=False):
     """Run `command` (list) as np_ local ranks; returns list of exit codes.
 
-    Kills the entire job as soon as any rank exits non-zero.
+    Kills the entire job as soon as any rank exits non-zero. With
+    ``jax_coord=True`` a jax.distributed coordinator address is provisioned
+    so the ranks form one global device mesh.
     """
     port = controller_port or find_free_port()
     addr = f"127.0.0.1:{port}"
+    jax_addr = f"127.0.0.1:{find_free_port()}" if jax_coord else None
     procs = []
     try:
         for r in range(np_):
             extra = dict(env or {})
             if bind_tpu_chips:
                 extra.setdefault("TPU_VISIBLE_CHIPS", str(r))
-            e = slot_env(r, np_, controller_addr=addr, extra_env=extra)
+            e = slot_env(r, np_, controller_addr=addr,
+                         jax_coord_addr=jax_addr, extra_env=extra)
             procs.append(
                 subprocess.Popen(command, env=e, stdout=stdout, stderr=stdout)
             )

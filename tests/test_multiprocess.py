@@ -1,0 +1,18 @@
+"""Multi-process ICI-plane tests: tpurun-launched processes form ONE global
+jax device mesh (jax.distributed multi-controller), so in-jit collectives
+cross process boundaries on device — the composition of the launcher, the
+native core control plane, and the XLA data plane (SURVEY.md §7 stage 5;
+VERDICT r1 item #1).
+
+The fake pod is 2 processes × 2 virtual CPU devices on localhost (SURVEY §4).
+"""
+
+import pytest
+
+pytest.importorskip("jax")
+
+from .util import run_worker_job  # noqa: E402
+
+
+def test_two_process_global_mesh():
+    run_worker_job(2, "jax_multiproc_worker.py", timeout=300, jax_coord=True)

@@ -9,8 +9,13 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKERS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "workers")
 
 
-def run_worker_job(np_, worker_file, extra_env=None, timeout=120):
-    """Launch `worker_file` as an np_-rank job; assert every rank exits 0."""
+def run_worker_job(np_, worker_file, extra_env=None, timeout=120,
+                   jax_coord=False):
+    """Launch `worker_file` as an np_-rank job; assert every rank exits 0.
+
+    ``jax_coord=True`` provisions a jax.distributed coordinator so the ranks
+    form one global device mesh (the multi-process ICI-plane tests).
+    """
     from horovod_tpu.runner.local import run_local
 
     env = {"PYTHONPATH": _REPO}
@@ -20,7 +25,7 @@ def run_worker_job(np_, worker_file, extra_env=None, timeout=120):
         env.update(extra_env)
     codes = run_local(
         np_, [sys.executable, os.path.join(WORKERS, worker_file)],
-        env=env, timeout=timeout,
+        env=env, timeout=timeout, jax_coord=jax_coord,
     )
     assert codes == [0] * np_, f"worker exit codes: {codes}"
 
