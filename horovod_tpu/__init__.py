@@ -123,6 +123,7 @@ cross_rank = _basics.cross_rank
 cross_size = _basics.cross_size
 mpi_threads_supported = _basics.mpi_threads_supported
 nccl_built = _basics.nccl_built
+cache_stats = _basics.cache_stats
 
 
 def mpi_built():

@@ -111,6 +111,8 @@ _lib.hvd_process_set_rank.restype = c_int
 _lib.hvd_process_set_rank.argtypes = [c_int]
 _lib.hvd_process_set_members.restype = c_int
 _lib.hvd_process_set_members.argtypes = [c_int, P_int64]
+_lib.hvd_cache_stats.restype = c_int
+_lib.hvd_cache_stats.argtypes = [P_int64, P_int64, P_int64]
 
 
 def last_error():
@@ -153,6 +155,19 @@ class HorovodBasics:
 
     def cross_size(self):
         return _check_init(_lib.hvd_cross_size())
+
+    def cache_stats(self):
+        """(hits, misses, entries) of the response cache (reference:
+        HOROVOD_CACHE_CAPACITY / response_cache.cc). Hits are tensors whose
+        negotiation crossed the wire as a bit position only."""
+        hits = c_int64(0)
+        misses = c_int64(0)
+        entries = c_int64(0)
+        rc = _lib.hvd_cache_stats(ctypes.byref(hits), ctypes.byref(misses),
+                                  ctypes.byref(entries))
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        return hits.value, misses.value, entries.value
 
     def mpi_threads_supported(self):
         return bool(_lib.hvd_mpi_threads_supported())

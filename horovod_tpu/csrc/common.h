@@ -126,6 +126,10 @@ class Writer {
     u32((uint32_t)v.size());
     for (auto x : v) i64(x);
   }
+  void u32vec(const std::vector<uint32_t>& v) {
+    u32((uint32_t)v.size());
+    for (auto x : v) u32(x);
+  }
  private:
   void append(const void* p, size_t n) {
     const uint8_t* b = (const uint8_t*)p;
@@ -151,6 +155,12 @@ class Reader {
     uint32_t n = u32();
     std::vector<int64_t> v(n);
     for (uint32_t i = 0; i < n; i++) v[i] = i64();
+    return v;
+  }
+  std::vector<uint32_t> u32vec() {
+    uint32_t n = u32();
+    std::vector<uint32_t> v(n);
+    for (uint32_t i = 0; i < n; i++) v[i] = u32();
     return v;
   }
  private:
@@ -218,14 +228,22 @@ struct Request {
 };
 
 // A RequestList is what each rank sends the coordinator every cycle.
+// cache_bits: positions of locally-ready tensors found in the response
+// cache (steady state: ONLY these cross the wire — reference:
+// response_cache.cc bit-vector coordination). invalid_bits: positions whose
+// signature changed on this rank (full request re-sent alongside).
 struct RequestList {
   std::vector<Request> requests;
+  std::vector<uint32_t> cache_bits;
+  std::vector<uint32_t> invalid_bits;
   bool shutdown = false;
 
   void serialize(Writer& w) const {
     w.u8(shutdown ? 1 : 0);
     w.u32((uint32_t)requests.size());
     for (auto& q : requests) q.serialize(w);
+    w.u32vec(cache_bits);
+    w.u32vec(invalid_bits);
   }
   static RequestList deserialize(Reader& r) {
     RequestList l;
@@ -233,6 +251,8 @@ struct RequestList {
     uint32_t n = r.u32();
     l.requests.reserve(n);
     for (uint32_t i = 0; i < n; i++) l.requests.push_back(Request::deserialize(r));
+    l.cache_bits = r.u32vec();
+    l.invalid_bits = r.u32vec();
     return l;
   }
 };
@@ -297,14 +317,22 @@ struct Response {
   }
 };
 
+// cache_hits: positions (ascending) agreed ready by every member of each
+// entry's process set — ranks expand them from their local cache copy, so
+// no Response bytes cross the wire for them. evict_bits: positions every
+// rank must evict this cycle (signature change reported by some rank).
 struct ResponseList {
   std::vector<Response> responses;
+  std::vector<uint32_t> cache_hits;
+  std::vector<uint32_t> evict_bits;
   bool shutdown = false;
 
   void serialize(Writer& w) const {
     w.u8(shutdown ? 1 : 0);
     w.u32((uint32_t)responses.size());
     for (auto& s : responses) s.serialize(w);
+    w.u32vec(cache_hits);
+    w.u32vec(evict_bits);
   }
   static ResponseList deserialize(Reader& r) {
     ResponseList l;
@@ -313,6 +341,8 @@ struct ResponseList {
     l.responses.reserve(n);
     for (uint32_t i = 0; i < n; i++)
       l.responses.push_back(Response::deserialize(r));
+    l.cache_hits = r.u32vec();
+    l.evict_bits = r.u32vec();
     return l;
   }
 };

@@ -208,7 +208,8 @@ Response Coordinator::BuildResponse(const std::string& name,
   return resp;
 }
 
-void Coordinator::Fuse(std::vector<Response>& ready, ResponseList& out) {
+void FuseResponses(std::vector<Response>& ready, int64_t threshold,
+                   ResponseList& out) {
   // Groups must be emitted atomically; grouped tensors were already held back
   // until complete, and arrive here adjacent. Fuse consecutive compatible
   // allreduces under the threshold (reference: FuseResponses).
@@ -231,7 +232,7 @@ void Coordinator::Fuse(std::vector<Response>& ready, ResponseList& out) {
           n.postscale != r.postscale)
         break;
       int64_t nbytes = NumElements(n.shapes[0]) * esz;
-      if (bytes + nbytes > fusion_threshold_) break;
+      if (bytes + nbytes > threshold) break;
       bytes += nbytes;
       r.names.push_back(n.names[0]);
       r.shapes.push_back(n.shapes[0]);
@@ -242,8 +243,39 @@ void Coordinator::Fuse(std::vector<Response>& ready, ResponseList& out) {
   }
 }
 
+void Coordinator::Fuse(std::vector<Response>& ready, ResponseList& out) {
+  FuseResponses(ready, fusion_threshold_, out);
+}
+
 ResponseList Coordinator::Update(std::vector<RequestList>& lists,
                                  bool* all_shutdown) {
+  // --- Response-cache coordination (reference: CoordinateCacheAndState).
+  // Evictions: union of every rank's invalid reports — broadcast so all
+  // replicas evict together. Hits: positions reported ready by EVERY member
+  // of the entry's process set, resolved against the rank-0 cache replica
+  // (identical on all ranks). Hits are computed against the cycle-start
+  // cache state; inserts/evictions apply when the broadcast list is
+  // processed, keeping replicas in lockstep.
+  std::set<uint32_t> evict;
+  std::map<uint32_t, std::set<int32_t>> bit_ranks;
+  for (size_t r = 0; r < lists.size(); r++) {
+    for (uint32_t b : lists[r].invalid_bits) evict.insert(b);
+    for (uint32_t b : lists[r].cache_bits) bit_ranks[b].insert((int32_t)r);
+  }
+  std::vector<uint32_t> hits;
+  if (cache_ != nullptr) {
+    for (auto& kv : bit_ranks) {
+      uint32_t b = kv.first;
+      if (evict.count(b) || !cache_->Valid(b)) continue;
+      int ps = cache_->Get(b).process_set;
+      if (!process_sets_->Contains(ps)) continue;
+      bool all = true;
+      for (int32_t m : process_sets_->Members(ps))
+        if (!kv.second.count(m)) { all = false; break; }
+      if (all) hits.push_back(b);  // map iteration => ascending order
+    }
+  }
+
   // Negotiation is keyed by (process set, name): the same tensor name may be
   // legitimately in flight in disjoint process sets at once (the reference
   // keeps per-process-set controller state for the same reason).
@@ -331,6 +363,8 @@ ResponseList Coordinator::Update(std::vector<RequestList>& lists,
 
   ResponseList out;
   Fuse(ready, out);
+  out.cache_hits = std::move(hits);
+  out.evict_bits.assign(evict.begin(), evict.end());
   *all_shutdown = (int)shutdown_ranks_.size() >= size_;
   out.shutdown = *all_shutdown;
   return out;

@@ -21,8 +21,15 @@
 #include <vector>
 
 #include "common.h"
+#include "response_cache.h"
 
 namespace hvd {
+
+// Pack consecutive compatible allreduce responses under the fusion
+// threshold (reference: FuseResponses in controller.cc). Shared by the
+// coordinator (new responses) and by every rank's cache-hit expansion.
+void FuseResponses(std::vector<Response>& ready, int64_t threshold,
+                   ResponseList& out);
 
 // Process sets: id -> sorted global ranks. Id 0 is the global set. Kept in
 // sync on every rank by applying coordinator responses in order. Mutated by
@@ -108,11 +115,16 @@ class Coordinator {
   // readiness counts and writes newly-created sets; every rank (including 0)
   // additionally applies set changes when executing the response, which is
   // idempotent on rank 0.
+  // `cache` is the rank-0 replica of the response cache (identical on all
+  // ranks); the coordinator reads it to resolve a bit position to its
+  // process set when ANDing readiness across members.
   void Init(int size, int64_t fusion_threshold_bytes,
-            ProcessSetTable* process_sets) {
+            ProcessSetTable* process_sets,
+            const ResponseCache* cache = nullptr) {
     size_ = size;
     fusion_threshold_ = fusion_threshold_bytes;
     process_sets_ = process_sets;
+    cache_ = cache;
   }
 
   StallInspector& stall() { return stall_; }
@@ -130,6 +142,7 @@ class Coordinator {
 
   int size_ = 1;
   int64_t fusion_threshold_ = 64 * 1024 * 1024;
+  const ResponseCache* cache_ = nullptr;
   // name -> (global rank -> request)
   std::unordered_map<std::string, std::map<int32_t, Request>> message_table_;
   // FIFO of names in arrival order (determinism of response ordering).

@@ -28,6 +28,7 @@
 #include "collectives.h"
 #include "common.h"
 #include "controller.h"
+#include "response_cache.h"
 #include "tcp.h"
 #include "tensor_queue.h"
 #include "timeline.h"
@@ -81,6 +82,15 @@ struct Global {
   ProcessSetTable process_sets;
   Coordinator coordinator;  // used on rank 0 only
   Timeline timeline;
+
+  // Response cache (reference: response_cache.cc). One identical replica
+  // per rank; `local_bits` maps a cache position this rank is currently
+  // bit-signaling to its (process set, name) so the entry can fall back to
+  // the full-request path if the position is evicted mid-negotiation.
+  ResponseCache cache;
+  std::map<uint32_t, std::pair<int32_t, std::string>> local_bits;
+  std::atomic<int64_t> cache_hits_total{0};
+  std::atomic<int64_t> cache_misses_total{0};
 
   // Control plane.
   Socket to_coordinator;           // rank != 0
@@ -400,6 +410,95 @@ void PerformOperation(const Response& resp) {
 }
 
 // ---------------------------------------------------------------------------
+// Response-cache plumbing (reference: response_cache.cc +
+// CoordinateCacheAndState in controller.cc)
+
+bool CacheableOp(OpType t) {
+  switch (t) {
+    case OpType::kAllreduce:
+    case OpType::kAllgather:
+    case OpType::kBroadcast:
+    case OpType::kAlltoall:
+    case OpType::kReducescatter:
+      return true;
+    default:
+      return false;
+  }
+}
+
+// Replace cache-known requests with bit positions before uplink. Called on
+// every rank (including 0, whose list feeds the coordinator directly).
+void CacheFilterRequests(RequestList& mine) {
+  if (!g->cache.enabled()) return;
+  std::vector<Request> keep;
+  for (auto& q : mine.requests) {
+    uint32_t pos = 0;
+    if (!CacheableOp(q.op_type)) {
+      keep.push_back(std::move(q));
+      continue;
+    }
+    auto lr = g->cache.Lookup(q, &pos);
+    if (lr == ResponseCache::LookupResult::kHit) {
+      g->local_bits[pos] = {q.process_set, q.name};
+    } else {
+      if (lr == ResponseCache::LookupResult::kInvalid)
+        mine.invalid_bits.push_back(pos);
+      g->cache_misses_total++;
+      keep.push_back(std::move(q));
+    }
+  }
+  mine.requests = std::move(keep);
+  for (auto& kv : g->local_bits) mine.cache_bits.push_back(kv.first);
+}
+
+// A position this rank was bit-signaling got evicted: re-announce the
+// still-pending tensor as a full request next cycle.
+void RepostIfSignaling(uint32_t pos) {
+  auto it = g->local_bits.find(pos);
+  if (it == g->local_bits.end()) return;
+  g->queue.Repost(it->second.second, it->second.first);
+  g->local_bits.erase(it);
+}
+
+// Apply one cycle's broadcast ResponseList to the local cache replica and
+// execute: agreed cache hits first (expanded + fused locally — zero
+// response bytes crossed the wire for them), then the newly negotiated
+// responses (inserted into the cache as they execute). Identical order on
+// every rank keeps the replicas in lockstep.
+void ProcessResponseList(ResponseList& rl) {
+  if (g->cache.enabled()) {
+    for (uint32_t b : rl.evict_bits) {
+      RepostIfSignaling(b);
+      g->cache.Evict(b);
+    }
+    std::vector<Response> hit_resps;
+    for (uint32_t b : rl.cache_hits) {
+      if (!g->cache.Valid(b)) continue;  // defensive; replicas are lockstep
+      g->cache.Touch(b);
+      hit_resps.push_back(g->cache.Get(b));
+      g->local_bits.erase(b);
+      g->cache_hits_total++;
+    }
+    ResponseList fused;
+    FuseResponses(hit_resps, g->fusion_threshold, fused);
+    for (auto& resp : fused.responses) PerformOperation(resp);
+  }
+  for (auto& resp : rl.responses) {
+    if (g->cache.enabled() && CacheableOp(resp.op_type) &&
+        resp.error.empty()) {
+      for (size_t i = 0; i < resp.names.size(); i++) {
+        Response sub = SubResponse(resp, i);
+        Request sig;
+        bool mine = g->queue.Peek(sub.names[0], sub.process_set, &sig);
+        int64_t evicted = g->cache.Insert(sub, mine ? &sig : nullptr);
+        if (evicted >= 0) RepostIfSignaling((uint32_t)evicted);
+      }
+    }
+    PerformOperation(resp);
+  }
+}
+
+// ---------------------------------------------------------------------------
 // Background thread (reference: BackgroundThreadLoop / RunLoopOnce)
 
 void FailAllPending(const std::string& why) {
@@ -418,6 +517,7 @@ void BackgroundLoop() {
       RequestList mine;
       mine.requests = g->queue.PopRequests();
       mine.shutdown = g->shutdown_requested.load();
+      CacheFilterRequests(mine);
 
       ResponseList rl;
       if (g->size == 1) {
@@ -448,7 +548,7 @@ void BackgroundLoop() {
         rl = ResponseList::deserialize(rd);
       }
 
-      for (auto& resp : rl.responses) PerformOperation(resp);
+      ProcessResponseList(rl);
       if (rl.shutdown) break;
     }
     FailAllPending("horovod_tpu shutdown");
@@ -638,7 +738,9 @@ int hvd_init() {
         EnvInt("HVD_FUSION_THRESHOLD", 64 * 1024 * 1024);
     g->cycle_time_ms = EnvDouble("HVD_CYCLE_TIME_MS", 1.0);
     g->process_sets.InitGlobal(g->size);
-    g->coordinator.Init(g->size, g->fusion_threshold, &g->process_sets);
+    g->cache.Configure(EnvInt("HVD_CACHE_CAPACITY", 1024));
+    g->coordinator.Init(g->size, g->fusion_threshold, &g->process_sets,
+                        &g->cache);
     g->coordinator.stall().Configure(
         EnvDouble("HVD_STALL_CHECK_TIME_SECONDS", 60.0),
         EnvDouble("HVD_STALL_SHUTDOWN_TIME_SECONDS", -1.0));
@@ -835,6 +937,17 @@ int hvd_process_set_members(int id, int64_t* out) {
   const auto& m = g->process_sets.Members(id);
   for (size_t i = 0; i < m.size(); i++) out[i] = m[i];
   return (int)m.size();
+}
+
+// Response-cache observability: hits = tensors executed via the bit-vector
+// fast path, misses = cacheable tensors that crossed the wire with full
+// metadata, entries = current live cache entries on this rank.
+int hvd_cache_stats(int64_t* hits, int64_t* misses, int64_t* entries) {
+  if (!g || !g->initialized) return -1;
+  if (hits) *hits = g->cache_hits_total.load();
+  if (misses) *misses = g->cache_misses_total.load();
+  if (entries) *entries = g->cache.ValidCount();
+  return 0;
 }
 
 int hvd_mpi_threads_supported() { return 0; }

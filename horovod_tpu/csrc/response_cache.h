@@ -1,0 +1,179 @@
+// response_cache.h — skip full renegotiation for previously-seen tensors.
+//
+// TPU-native redesign of the reference's ResponseCache
+// (horovod/common/response_cache.cc, HOROVOD_CACHE_CAPACITY default 1024):
+// every rank keeps an IDENTICAL position-indexed cache of per-tensor
+// Responses. Steady-state cycles exchange only small bit-position lists —
+// each rank uplinks the positions of its locally-ready cached tensors; the
+// coordinator ANDs them across the tensor's process-set members and
+// downlinks the agreed hit positions; every rank expands the positions from
+// its own cache copy, fuses, and executes. Full Request metadata crosses the
+// wire only on the first sight of a tensor or after invalidation (shape /
+// dtype / attribute change).
+//
+// Coherence argument: the cache mutates ONLY while processing the broadcast
+// ResponseList (insert new cacheable responses in list order; apply
+// broadcast evictions; LRU-touch executed hits), and every rank processes
+// the identical list in the identical order — so all replicas stay
+// bit-for-bit identical without any extra coordination, exactly the
+// reference's bit-vector scheme.
+#pragma once
+
+#include <cstdint>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+
+namespace hvd {
+
+class ResponseCache {
+ public:
+  enum class LookupResult { kMiss, kHit, kInvalid };
+
+  void Configure(int64_t capacity) {
+    // Bound the table so a misconfigured env can't eat unbounded memory.
+    if (capacity > (1 << 20)) capacity = 1 << 20;
+    capacity_ = capacity;
+  }
+  bool enabled() const { return capacity_ > 0; }
+  int64_t capacity() const { return capacity_; }
+
+  static bool Cacheable(const Response& r) {
+    if (!r.error.empty()) return false;
+    switch (r.op_type) {
+      case OpType::kAllreduce:
+      case OpType::kAllgather:
+      case OpType::kBroadcast:
+      case OpType::kAlltoall:
+      case OpType::kReducescatter:
+        return true;
+      default:
+        return false;
+    }
+  }
+
+  // Frontend-cycle lookup against this rank's request. kHit: wire only the
+  // position. kInvalid: the tensor's signature changed — wire the full
+  // request plus an eviction notice. kMiss: unknown — wire the full request.
+  LookupResult Lookup(const Request& req, uint32_t* pos) const {
+    auto it = index_.find(Key(req.process_set, req.name));
+    if (it == index_.end()) return LookupResult::kMiss;
+    *pos = it->second;
+    const Entry& e = entries_[it->second];
+    if (!e.has_sig || !SigMatch(e.sig, req)) return LookupResult::kInvalid;
+    return LookupResult::kHit;
+  }
+
+  // Insert one tensor of a (possibly fused) new response, with this rank's
+  // request signature when it participated. Deterministic: same call
+  // sequence on every rank. Returns the position evicted to make room, or
+  // -1 if none.
+  int64_t Insert(const Response& sub, const Request* my_req) {
+    if (!enabled()) return -1;
+    std::string key = Key(sub.process_set, sub.names[0]);
+    int64_t evicted = -1;
+    auto it = index_.find(key);
+    uint32_t pos;
+    if (it != index_.end()) {
+      pos = it->second;  // re-insert after invalidation raced: overwrite
+    } else if (!free_.empty()) {
+      pos = *free_.begin();
+      free_.erase(free_.begin());
+    } else if ((int64_t)entries_.size() < capacity_) {
+      pos = (uint32_t)entries_.size();
+      entries_.emplace_back();
+    } else {
+      pos = LruVictim();
+      evicted = pos;
+      index_.erase(Key(entries_[pos].resp.process_set,
+                       entries_[pos].resp.names[0]));
+    }
+    Entry& e = entries_[pos];
+    e.valid = true;
+    e.resp = sub;
+    e.has_sig = my_req != nullptr;
+    if (my_req) e.sig = *my_req;
+    e.last_use = ++clock_;
+    index_[key] = pos;
+    return evicted;
+  }
+
+  void Evict(uint32_t pos) {
+    if (pos >= entries_.size() || !entries_[pos].valid) return;
+    index_.erase(Key(entries_[pos].resp.process_set,
+                     entries_[pos].resp.names[0]));
+    entries_[pos] = Entry{};
+    free_.insert(pos);
+  }
+
+  bool Valid(uint32_t pos) const {
+    return pos < entries_.size() && entries_[pos].valid;
+  }
+  const Response& Get(uint32_t pos) const { return entries_[pos].resp; }
+  void Touch(uint32_t pos) {
+    if (Valid(pos)) entries_[pos].last_use = ++clock_;
+  }
+  int64_t ValidCount() const {
+    return (int64_t)entries_.size() - (int64_t)free_.size();
+  }
+
+ private:
+  struct Entry {
+    bool valid = false;
+    bool has_sig = false;  // false on ranks outside the tensor's process set
+    Response resp;         // single-tensor response (names.size() == 1)
+    Request sig;           // this rank's request at insert time
+    uint64_t last_use = 0;
+  };
+
+  static std::string Key(int32_t ps, const std::string& name) {
+    return std::to_string(ps) + "\x01" + name;
+  }
+
+  static bool SigMatch(const Request& a, const Request& b) {
+    return a.op_type == b.op_type && a.dtype == b.dtype &&
+           a.red_op == b.red_op && a.root == b.root &&
+           a.process_set == b.process_set && a.prescale == b.prescale &&
+           a.postscale == b.postscale && a.shape == b.shape &&
+           a.splits == b.splits;
+  }
+
+  uint32_t LruVictim() const {
+    uint32_t victim = 0;
+    uint64_t best = UINT64_MAX;
+    for (uint32_t i = 0; i < entries_.size(); i++) {
+      if (entries_[i].valid && entries_[i].last_use < best) {
+        best = entries_[i].last_use;
+        victim = i;
+      }
+    }
+    return victim;
+  }
+
+  int64_t capacity_ = 0;
+  uint64_t clock_ = 0;
+  std::vector<Entry> entries_;
+  std::unordered_map<std::string, uint32_t> index_;
+  std::set<uint32_t> free_;  // ordered so slot reuse is deterministic
+};
+
+// Split tensor i out of a (possibly fused) response for caching.
+inline Response SubResponse(const Response& r, size_t i) {
+  Response s;
+  s.op_type = r.op_type;
+  s.names = {r.names[i]};
+  s.dtype = r.dtype;
+  s.red_op = r.red_op;
+  s.root = r.root;
+  s.process_set = r.process_set;
+  s.prescale = r.prescale;
+  s.postscale = r.postscale;
+  if (i < r.shapes.size()) s.shapes = {r.shapes[i]};
+  if (i < r.per_rank_meta.size()) s.per_rank_meta = {r.per_rank_meta[i]};
+  return s;
+}
+
+}  // namespace hvd

@@ -64,6 +64,27 @@ class TensorQueue {
     return true;
   }
 
+  // Copy a pending entry's request without claiming it (the response cache
+  // records this rank's signature when a new response is inserted).
+  bool Peek(const std::string& name, int32_t process_set, Request* out) {
+    std::lock_guard<std::mutex> l(mu_);
+    auto it = table_.find(Key(process_set, name));
+    if (it == table_.end()) return false;
+    *out = it->second.req;
+    return true;
+  }
+
+  // Re-announce a still-pending entry as a full request (used when its
+  // response-cache entry is evicted mid-negotiation: the tensor falls back
+  // to the full metadata path next cycle).
+  bool Repost(const std::string& name, int32_t process_set) {
+    std::lock_guard<std::mutex> l(mu_);
+    auto it = table_.find(Key(process_set, name));
+    if (it == table_.end()) return false;
+    pending_.push_back(it->second.req);
+    return true;
+  }
+
   // Fail everything still pending (shutdown / internal error path).
   std::vector<TensorTableEntry> DrainAll() {
     std::lock_guard<std::mutex> l(mu_);

@@ -33,3 +33,20 @@ def test_jax_distributed_optimizer_end_to_end():
     """SURVEY.md §7 stage 4: gradients leave JAX, ride the core, come back
     averaged — eager and inside jit (io_callback)."""
     run_worker_job(2, "jax_dp_worker.py", timeout=300)
+
+
+def test_response_cache():
+    """Steady-state negotiation rides the bit-vector cache path (reference:
+    response_cache.cc): hits recorded, invalidation on shape/dtype change,
+    grouped + all cacheable op types correct through the cache."""
+    run_worker_job(2, "cache_worker.py")
+
+
+def test_response_cache_capacity_lru():
+    run_worker_job(2, "cache_capacity_worker.py",
+                   extra_env={"HVD_CACHE_CAPACITY": "2"})
+
+
+def test_response_cache_disabled():
+    run_worker_job(2, "cache_capacity_worker.py",
+                   extra_env={"HVD_CACHE_CAPACITY": "0"})

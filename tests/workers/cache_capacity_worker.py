@@ -1,0 +1,34 @@
+"""Worker: response-cache capacity eviction (run with HVD_CACHE_CAPACITY=2).
+
+Three tensors round-robin through a 2-entry cache: every cycle evicts the
+LRU entry deterministically on all ranks; results stay correct and the live
+entry count never exceeds capacity. Also: HVD_CACHE_CAPACITY=0 disables the
+cache entirely (hits stay 0)."""
+import os
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+cap = int(os.environ.get("HVD_CACHE_CAPACITY", "1024"))
+
+for i in range(9):
+    name = f"t{i % 3}"
+    out = hvd.allreduce(np.full((8,), float(r + 1), np.float32),
+                        op=hvd.Sum, name=name)
+    assert np.allclose(out, sum(range(1, s + 1))), (name, out[0])
+
+hits, misses, entries = hvd.cache_stats()
+assert entries <= max(cap, 0), (entries, cap)
+if cap == 0:
+    assert hits == 0, hits
+elif cap >= 3:
+    assert hits > 0, (hits, misses)
+# cap==2 with strict round-robin: every access evicts the LRU -> all misses
+# is acceptable; correctness (asserted above) is the contract.
+
+hvd.shutdown()
+print(f"rank {r}: capacity({cap}) PASS hits={hits} misses={misses} "
+      f"entries={entries}", flush=True)

@@ -1,0 +1,75 @@
+"""Worker: response-cache behavior across 2 ranks (reference:
+horovod/common/response_cache.cc — bit-vector coordination, capacity,
+invalidation on signature change).
+
+Covers: steady-state hits (repeated same-name collectives negotiate as bit
+positions), invalidation (shape change forces full renegotiation, then
+re-caches), capacity-LRU eviction, and correctness of every cached result.
+"""
+import os
+import sys
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+
+# --- steady-state hits: same tensor name, many iterations
+for i in range(12):
+    x = np.full((16,), float(r + 1 + i), np.float32)
+    out = hvd.allreduce(x, op=hvd.Sum, name="cached.grad")
+    expect = sum(range(1 + i, s + 1 + i))
+    assert np.allclose(out, expect), (i, out[0], expect)
+
+hits, misses, entries = hvd.cache_stats()
+# First iteration is a miss; the rest should ride the bit-vector path.
+assert hits >= 8, (hits, misses, entries)
+assert entries >= 1, entries
+
+# --- grouped allreduce (the DistributedOptimizer hot path) also caches
+for i in range(6):
+    tensors = [np.full((4,), float(r + i), np.float32),
+               np.full((8,), float(r + 2 * i), np.float32)]
+    outs = hvd.grouped_allreduce(tensors, op=hvd.Average, name="cached.group")
+    assert np.allclose(outs[0], np.mean(np.arange(s)) + i)
+    assert np.allclose(outs[1], np.mean(np.arange(s)) + 2 * i)
+
+h2, _, _ = hvd.cache_stats()
+assert h2 > hits, (h2, hits)
+
+# --- invalidation: same name, new shape -> full renegotiation, right answer
+for shape in [(16,), (32,), (32,), (8, 2)]:
+    x = np.full(shape, float(r + 1), np.float32)
+    out = hvd.allreduce(x, op=hvd.Sum, name="cached.grad")
+    assert out.shape == shape
+    assert np.allclose(out, sum(range(1, s + 1))), out
+
+# dtype change invalidates too
+out = hvd.allreduce(np.full((16,), float(r + 1), np.float64),
+                    op=hvd.Sum, name="cached.grad")
+assert out.dtype == np.float64
+assert np.allclose(out, sum(range(1, s + 1)))
+
+# --- other cacheable op types keep working through the cache
+for i in range(3):
+    g = hvd.allgather(np.full((r + 1, 2), float(r), np.float32),
+                      name="cached.gather")
+    assert g.shape[0] == sum(range(1, s + 1))
+    b = hvd.broadcast(np.full((4,), float(r), np.float32), root_rank=0,
+                      name="cached.bcast")
+    assert np.allclose(b, 0.0)
+    rs = hvd.reducescatter(np.arange(s * 2, dtype=np.float32),
+                           op=hvd.Sum, name="cached.rs")
+    assert np.allclose(rs, np.arange(r * 2, r * 2 + 2) * s)
+
+final_hits, final_misses, final_entries = hvd.cache_stats()
+assert final_hits > h2
+cap = int(os.environ.get("HVD_CACHE_CAPACITY", "1024"))
+assert final_entries <= cap, (final_entries, cap)
+
+hvd.shutdown()
+print(f"rank {r}: cache PASS hits={final_hits} misses={final_misses} "
+      f"entries={final_entries}", flush=True)
+sys.exit(0)
