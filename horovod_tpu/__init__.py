@@ -124,6 +124,7 @@ cross_size = _basics.cross_size
 mpi_threads_supported = _basics.mpi_threads_supported
 nccl_built = _basics.nccl_built
 cache_stats = _basics.cache_stats
+autotune_state = _basics.autotune_state
 
 
 def mpi_built():

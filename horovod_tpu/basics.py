@@ -113,6 +113,8 @@ _lib.hvd_process_set_members.restype = c_int
 _lib.hvd_process_set_members.argtypes = [c_int, P_int64]
 _lib.hvd_cache_stats.restype = c_int
 _lib.hvd_cache_stats.argtypes = [P_int64, P_int64, P_int64]
+_lib.hvd_autotune_state.restype = c_int
+_lib.hvd_autotune_state.argtypes = [P_int64, ctypes.POINTER(c_double)]
 
 
 def last_error():
@@ -168,6 +170,18 @@ class HorovodBasics:
         if rc < 0:
             raise ValueError("horovod_tpu has not been initialized")
         return hits.value, misses.value, entries.value
+
+    def autotune_state(self):
+        """(status, fusion_threshold_bytes, cycle_time_ms) where status is
+        'off' | 'searching' | 'locked' (reference: HOROVOD_AUTOTUNE /
+        parameter_manager.cc)."""
+        fusion = c_int64(0)
+        cycle = c_double(0.0)
+        rc = _lib.hvd_autotune_state(ctypes.byref(fusion), ctypes.byref(cycle))
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        status = {0: "off", 1: "searching", 2: "locked"}[rc]
+        return status, fusion.value, cycle.value
 
     def mpi_threads_supported(self):
         return bool(_lib.hvd_mpi_threads_supported())

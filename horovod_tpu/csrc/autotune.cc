@@ -1,0 +1,210 @@
+// autotune.cc — GP + expected-improvement parameter search (see autotune.h).
+#include "autotune.h"
+
+#include <algorithm>
+#include <array>
+#include <cmath>
+#include <cstring>
+
+namespace hvd {
+namespace {
+
+// RBF kernel on [0,1]^2. Length scale wide enough that ~30 samples shape a
+// useful posterior (reference uses a squared-exponential GP too).
+constexpr double kLen = 0.25;
+constexpr double kNoise = 1e-3;
+
+double Kern(const double* a, const double* b) {
+  double d0 = a[0] - b[0], d1 = a[1] - b[1];
+  return exp(-(d0 * d0 + d1 * d1) / (2.0 * kLen * kLen));
+}
+
+double NormCdf(double z) { return 0.5 * erfc(-z / sqrt(2.0)); }
+double NormPdf(double z) { return exp(-0.5 * z * z) / sqrt(2.0 * M_PI); }
+
+// Warmup grid: corners + center + edge midpoints of the log-space square,
+// visited before the GP takes over (reference: categorical warmup passes).
+const double kWarmup[][2] = {
+    {0.5, 0.5}, {0.15, 0.15}, {0.85, 0.15}, {0.15, 0.85},
+    {0.85, 0.85}, {0.5, 0.15}, {0.5, 0.85},
+};
+constexpr int kNumWarmup = sizeof(kWarmup) / sizeof(kWarmup[0]);
+
+}  // namespace
+
+void ParameterManager::Configure(bool enabled, const std::string& log_path,
+                                 int64_t init_fusion, double init_cycle_ms,
+                                 int64_t cycles_per_sample,
+                                 int64_t max_samples) {
+  enabled_ = enabled;
+  if (!enabled_) return;
+  cycles_per_sample_ = cycles_per_sample;
+  max_samples_ = max_samples;
+  best_fusion_ = init_fusion;
+  best_cycle_ms_ = init_cycle_ms;
+  if (!log_path.empty()) {
+    log_ = fopen(log_path.c_str(), "w");
+    if (log_)
+      fprintf(log_, "sample,fusion_kb,cycle_ms,score_mbps\n");
+  }
+  // First sample point = warmup[0]; adopted on the first Record proposal.
+  memcpy(cur_x_, kWarmup[0], sizeof(cur_x_));
+}
+
+void ParameterManager::ToParams(const double x[2], int64_t* fusion,
+                                double* cycle_ms) const {
+  double lf = log(kFusionMinMB) +
+              x[0] * (log(kFusionMaxMB) - log(kFusionMinMB));
+  double lc = log(kCycleMinMs) + x[1] * (log(kCycleMaxMs) - log(kCycleMinMs));
+  *fusion = (int64_t)(exp(lf) * 1024.0 * 1024.0);
+  *cycle_ms = exp(lc);
+}
+
+void ParameterManager::GpFit() const {
+  size_t n = xs_.size();
+  // Normalize observations.
+  y_mean_ = 0.0;
+  for (double y : ys_) y_mean_ += y;
+  y_mean_ /= (double)n;
+  double var = 0.0;
+  for (double y : ys_) var += (y - y_mean_) * (y - y_mean_);
+  y_std_ = sqrt(var / (double)n);
+  if (y_std_ < 1e-12) y_std_ = 1.0;
+
+  // K + noise*I, Cholesky, alpha = K^-1 y (standard GP regression).
+  std::vector<double> K(n * n);
+  for (size_t i = 0; i < n; i++)
+    for (size_t j = 0; j < n; j++) {
+      K[i * n + j] = Kern(xs_[i].data(), xs_[j].data());
+      if (i == j) K[i * n + j] += kNoise;
+    }
+  chol_.assign(n * n, 0.0);
+  for (size_t i = 0; i < n; i++) {
+    for (size_t j = 0; j <= i; j++) {
+      double s = K[i * n + j];
+      for (size_t k = 0; k < j; k++) s -= chol_[i * n + k] * chol_[j * n + k];
+      if (i == j)
+        chol_[i * n + i] = sqrt(std::max(s, 1e-12));
+      else
+        chol_[i * n + j] = s / chol_[j * n + j];
+    }
+  }
+  // Solve L L^T alpha = y_norm.
+  std::vector<double> tmp(n);
+  for (size_t i = 0; i < n; i++) {
+    double s = (ys_[i] - y_mean_) / y_std_;
+    for (size_t k = 0; k < i; k++) s -= chol_[i * n + k] * tmp[k];
+    tmp[i] = s / chol_[i * n + i];
+  }
+  alpha_.assign(n, 0.0);
+  for (size_t ii = n; ii-- > 0;) {
+    double s = tmp[ii];
+    for (size_t k = ii + 1; k < n; k++) s -= chol_[k * n + ii] * alpha_[k];
+    alpha_[ii] = s / chol_[ii * n + ii];
+  }
+}
+
+double ParameterManager::EI(const double x[2], double best_y) const {
+  size_t n = xs_.size();
+  std::vector<double> kstar(n);
+  for (size_t i = 0; i < n; i++) kstar[i] = Kern(x, xs_[i].data());
+  double mu = 0.0;
+  for (size_t i = 0; i < n; i++) mu += kstar[i] * alpha_[i];
+  // var = k(x,x) - v^T v with L v = k*.
+  std::vector<double> v(n);
+  for (size_t i = 0; i < n; i++) {
+    double s = kstar[i];
+    for (size_t k = 0; k < i; k++) s -= chol_[i * n + k] * v[k];
+    v[i] = s / chol_[i * n + i];
+  }
+  double var = 1.0 + kNoise;
+  for (size_t i = 0; i < n; i++) var -= v[i] * v[i];
+  double sd = sqrt(std::max(var, 1e-12));
+  double best_norm = (best_y - y_mean_) / y_std_;
+  double z = (mu - best_norm - 0.01) / sd;
+  return (mu - best_norm - 0.01) * NormCdf(z) + sd * NormPdf(z);
+}
+
+void ParameterManager::Propose(double out[2]) {
+  if (warmup_idx_ < kNumWarmup) {
+    memcpy(out, kWarmup[warmup_idx_], 2 * sizeof(double));
+    warmup_idx_++;
+    return;
+  }
+  GpFit();
+  double best_y = *std::max_element(ys_.begin(), ys_.end());
+  double best_ei = -1.0;
+  for (int c = 0; c < 512; c++) {
+    // xorshift64* candidates — deterministic, no libc rand state.
+    rng_ ^= rng_ >> 12;
+    rng_ ^= rng_ << 25;
+    rng_ ^= rng_ >> 27;
+    uint64_t r = rng_ * 0x2545f4914f6cdd1dull;
+    double cand[2] = {(double)(r & 0xffffffff) / 4294967296.0,
+                      (double)(r >> 32) / 4294967296.0};
+    double ei = EI(cand, best_y);
+    if (ei > best_ei) {
+      best_ei = ei;
+      memcpy(out, cand, 2 * sizeof(double));
+    }
+  }
+}
+
+bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
+                              double* cycle_ms) {
+  if (!active()) return false;
+  if (bytes <= 0 && acc_cycles_ == 0) return false;  // idle before window
+  if (window_start_us_ < 0) {
+    window_start_us_ = now_us;
+    // Adopt the first sample point right away.
+    ToParams(cur_x_, fusion, cycle_ms);
+    warmup_idx_ = 1;
+    return true;
+  }
+  // Only data-moving cycles advance the sample; the score still divides by
+  // wall time, so idle gaps correctly depress a point's throughput.
+  if (bytes > 0) {
+    acc_bytes_ += bytes;
+    acc_cycles_++;
+  }
+  if (acc_cycles_ < cycles_per_sample_) return false;
+
+  double secs = (now_us - window_start_us_) / 1e6;
+  double score = secs > 0 ? (double)acc_bytes_ / secs : 0.0;
+  xs_.push_back({cur_x_[0], cur_x_[1]});
+  ys_.push_back(score);
+  if (score > best_score_) {
+    best_score_ = score;
+    ToParams(cur_x_, &best_fusion_, &best_cycle_ms_);
+  }
+  if (log_) {
+    int64_t f;
+    double c;
+    ToParams(cur_x_, &f, &c);
+    fprintf(log_, "%zu,%.1f,%.3f,%.3f\n", xs_.size(), f / 1024.0, c,
+            score / 1e6);
+    fflush(log_);
+  }
+
+  acc_bytes_ = 0;
+  acc_cycles_ = 0;
+  window_start_us_ = now_us;
+
+  if ((int64_t)xs_.size() >= max_samples_) {
+    // Search done: lock in the best observed point.
+    done_ = true;
+    *fusion = best_fusion_;
+    *cycle_ms = best_cycle_ms_;
+    if (log_) {
+      fprintf(log_, "# final,%.1f,%.3f,%.3f\n", best_fusion_ / 1024.0,
+              best_cycle_ms_, best_score_ / 1e6);
+      fflush(log_);
+    }
+    return true;
+  }
+  Propose(cur_x_);
+  ToParams(cur_x_, fusion, cycle_ms);
+  return true;
+}
+
+}  // namespace hvd

@@ -1,0 +1,94 @@
+// autotune.h — online Bayesian autotuning of fusion threshold + cycle time.
+//
+// TPU-native redesign of the reference's ParameterManager
+// (horovod/common/parameter_manager.cc) with the GP + expected-improvement
+// optimizer of horovod/common/optim/bayesian_optimization.cc /
+// gaussian_process.cc, rebuilt without Eigen/L-BFGS: the GP posterior uses a
+// hand-rolled Cholesky on the (tiny) sample matrix and EI is maximized over
+// random candidates instead of gradient ascent.
+//
+// Runs on the coordinator only. Each sample window accumulates negotiated
+// payload bytes over wall time at the current (fusion_threshold,
+// cycle_time) point; the score is bytes/sec. After warmup grid points, new
+// points are proposed by EI. Proposals ride the broadcast ResponseList so
+// every rank switches parameters on the same cycle. HVD_AUTOTUNE=1 enables;
+// HVD_AUTOTUNE_LOG writes a CSV of (sample, fusion_kb, cycle_ms, score).
+#pragma once
+
+#include <array>
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+class ParameterManager {
+ public:
+  ~ParameterManager() {
+    if (log_) fclose(log_);
+  }
+
+  void Configure(bool enabled, const std::string& log_path,
+                 int64_t init_fusion, double init_cycle_ms,
+                 int64_t cycles_per_sample, int64_t max_samples);
+  bool active() const { return enabled_ && !done_; }
+  bool enabled() const { return enabled_; }
+  // Non-coordinator ranks mirror the coordinator's search-finished state
+  // from the broadcast ResponseList.
+  void SetDone() { done_ = true; }
+
+  // Called by the coordinator every negotiation cycle with the payload
+  // bytes this cycle's ResponseList moves (0 for idle cycles). Returns true
+  // when a new parameter point is proposed; *fusion / *cycle_ms then carry
+  // the values every rank must adopt.
+  bool Record(int64_t bytes, int64_t now_us, int64_t* fusion,
+              double* cycle_ms);
+
+  int64_t best_fusion() const { return best_fusion_; }
+  double best_cycle_ms() const { return best_cycle_ms_; }
+  int64_t samples() const { return (int64_t)xs_.size(); }
+
+ private:
+  // Parameter space: x in [0,1]^2 -> (fusion bytes log-scaled between
+  // kFusionMin..kFusionMax, cycle ms log-scaled kCycleMin..kCycleMax).
+  static constexpr double kFusionMinMB = 0.0625;  // 64 KB
+  static constexpr double kFusionMaxMB = 128.0;
+  static constexpr double kCycleMinMs = 0.2;
+  static constexpr double kCycleMaxMs = 25.0;
+
+  void ToParams(const double x[2], int64_t* fusion, double* cycle_ms) const;
+  void Propose(double out[2]);
+  double EI(const double x[2], double best_y) const;
+  void GpFit() const;  // builds chol_ / alpha_ lazily over xs_/ys_
+
+  bool enabled_ = false;
+  bool done_ = false;
+  FILE* log_ = nullptr;
+
+  int64_t cycles_per_sample_ = 20;
+  int64_t max_samples_ = 30;
+
+  // Current sample accumulation.
+  double cur_x_[2] = {0.5, 0.5};
+  int64_t acc_bytes_ = 0;
+  int64_t acc_cycles_ = 0;
+  int64_t window_start_us_ = -1;
+
+  // Observations (normalized inputs, raw scores).
+  std::vector<std::array<double, 2>> xs_;
+  std::vector<double> ys_;
+
+  int64_t best_fusion_ = 64 << 20;
+  double best_cycle_ms_ = 1.0;
+  double best_score_ = -1.0;
+  int warmup_idx_ = 0;
+  uint64_t rng_ = 0x9e3779b97f4a7c15ull;
+
+  // GP state (rebuilt per proposal; tiny matrices).
+  mutable std::vector<double> chol_;   // lower-triangular N x N
+  mutable std::vector<double> alpha_;  // K^-1 y
+  mutable double y_mean_ = 0.0, y_std_ = 1.0;
+};
+
+}  // namespace hvd

@@ -326,6 +326,13 @@ struct ResponseList {
   std::vector<uint32_t> cache_hits;
   std::vector<uint32_t> evict_bits;
   bool shutdown = false;
+  // Autotune proposals (coordinator -> all ranks; -1 = unchanged). Every
+  // rank adopts them while processing this list, so parameter switches are
+  // cycle-synchronized (reference: ParameterManager values ride the
+  // coordinator broadcast).
+  int64_t tuned_fusion = -1;
+  double tuned_cycle_ms = -1.0;
+  bool tuned_locked = false;  // coordinator's search finished
 
   void serialize(Writer& w) const {
     w.u8(shutdown ? 1 : 0);
@@ -333,6 +340,9 @@ struct ResponseList {
     for (auto& s : responses) s.serialize(w);
     w.u32vec(cache_hits);
     w.u32vec(evict_bits);
+    w.i64(tuned_fusion);
+    w.f64(tuned_cycle_ms);
+    w.u8(tuned_locked ? 1 : 0);
   }
   static ResponseList deserialize(Reader& r) {
     ResponseList l;
@@ -343,6 +353,9 @@ struct ResponseList {
       l.responses.push_back(Response::deserialize(r));
     l.cache_hits = r.u32vec();
     l.evict_bits = r.u32vec();
+    l.tuned_fusion = r.i64();
+    l.tuned_cycle_ms = r.f64();
+    l.tuned_locked = r.u8() != 0;
     return l;
   }
 };

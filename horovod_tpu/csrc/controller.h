@@ -129,6 +129,9 @@ class Coordinator {
 
   StallInspector& stall() { return stall_; }
 
+  // Autotune proposals change the fusion packing limit mid-run.
+  void set_fusion_threshold(int64_t t) { fusion_threshold_ = t; }
+
   // Ingest one cycle's worth of RequestLists (index = global rank; rank 0's
   // own list included). Returns the ordered, fused ResponseList every rank
   // must execute, and sets *all_shutdown when every rank has requested

@@ -25,6 +25,7 @@
 #include <vector>
 
 #include "adasum.h"
+#include "autotune.h"
 #include "collectives.h"
 #include "common.h"
 #include "controller.h"
@@ -91,6 +92,10 @@ struct Global {
   std::map<uint32_t, std::pair<int32_t, std::string>> local_bits;
   std::atomic<int64_t> cache_hits_total{0};
   std::atomic<int64_t> cache_misses_total{0};
+
+  // Autotune (reference: parameter_manager.cc). Coordinator-only state;
+  // proposals reach other ranks via ResponseList.tuned_*.
+  ParameterManager autotune;
 
   // Control plane.
   Socket to_coordinator;           // rank != 0
@@ -465,7 +470,48 @@ void RepostIfSignaling(uint32_t pos) {
 // response bytes crossed the wire for them), then the newly negotiated
 // responses (inserted into the cache as they execute). Identical order on
 // every rank keeps the replicas in lockstep.
+// Payload bytes a ResponseList moves (responses + cache-hit expansions) —
+// the autotune score numerator. Must run BEFORE ProcessResponseList (which
+// may evict the hit entries it reads).
+int64_t PayloadBytes(const ResponseList& rl) {
+  int64_t total = 0;
+  for (auto& r : rl.responses) {
+    int64_t esz = (int64_t)DataTypeSize(r.dtype);
+    for (auto& s : r.shapes) total += NumElements(s) * esz;
+  }
+  for (uint32_t b : rl.cache_hits) {
+    if (!g->cache.Valid(b)) continue;
+    const Response& r = g->cache.Get(b);
+    int64_t esz = (int64_t)DataTypeSize(r.dtype);
+    for (auto& s : r.shapes) total += NumElements(s) * esz;
+  }
+  return total;
+}
+
+// Coordinator-side: score the cycle and stamp parameter proposals onto the
+// outgoing list.
+void AutotuneCycle(ResponseList& rl) {
+  if (!g->autotune.enabled()) return;
+  if (g->autotune.active()) {
+    int64_t fusion;
+    double cycle_ms;
+    if (g->autotune.Record(PayloadBytes(rl), NowUs(), &fusion, &cycle_ms)) {
+      rl.tuned_fusion = fusion;
+      rl.tuned_cycle_ms = cycle_ms;
+    }
+  }
+  rl.tuned_locked = !g->autotune.active();
+}
+
 void ProcessResponseList(ResponseList& rl) {
+  // Adopt autotune proposals first so this cycle's cache-hit fusion and the
+  // next cycle's pacing already use them — same cycle on every rank.
+  if (rl.tuned_fusion >= 0) {
+    g->fusion_threshold = rl.tuned_fusion;
+    g->coordinator.set_fusion_threshold(rl.tuned_fusion);
+  }
+  if (rl.tuned_cycle_ms > 0) g->cycle_time_ms = rl.tuned_cycle_ms;
+  if (rl.tuned_locked && g->autotune.enabled()) g->autotune.SetDone();
   if (g->cache.enabled()) {
     for (uint32_t b : rl.evict_bits) {
       RepostIfSignaling(b);
@@ -526,6 +572,7 @@ void BackgroundLoop() {
         lists[0] = std::move(mine);
         bool all_shutdown = false;
         rl = g->coordinator.Update(lists, &all_shutdown);
+        AutotuneCycle(rl);
       } else if (g->rank == 0) {
         std::vector<RequestList> lists(g->size);
         lists[0] = std::move(mine);
@@ -536,6 +583,7 @@ void BackgroundLoop() {
         }
         bool all_shutdown = false;
         rl = g->coordinator.Update(lists, &all_shutdown);
+        AutotuneCycle(rl);
         Writer w;
         rl.serialize(w);
         for (int r = 1; r < g->size; r++) g->workers[r].SendFrame(w.buf);
@@ -741,6 +789,12 @@ int hvd_init() {
     g->cache.Configure(EnvInt("HVD_CACHE_CAPACITY", 1024));
     g->coordinator.Init(g->size, g->fusion_threshold, &g->process_sets,
                         &g->cache);
+    g->autotune.Configure(
+        EnvInt("HVD_AUTOTUNE", 0) != 0,
+        g->rank == 0 ? EnvStr("HVD_AUTOTUNE_LOG", "") : "",
+        g->fusion_threshold, g->cycle_time_ms,
+        EnvInt("HVD_AUTOTUNE_CYCLES_PER_SAMPLE", 20),
+        EnvInt("HVD_AUTOTUNE_MAX_SAMPLES", 30));
     g->coordinator.stall().Configure(
         EnvDouble("HVD_STALL_CHECK_TIME_SECONDS", 60.0),
         EnvDouble("HVD_STALL_SHUTDOWN_TIME_SECONDS", -1.0));
@@ -937,6 +991,17 @@ int hvd_process_set_members(int id, int64_t* out) {
   const auto& m = g->process_sets.Members(id);
   for (size_t i = 0; i < m.size(); i++) out[i] = m[i];
   return (int)m.size();
+}
+
+// Autotune observability: current live parameters + whether the search is
+// still running. Returns -1 uninitialized, 0 autotune off, 1 searching,
+// 2 converged/locked.
+int hvd_autotune_state(int64_t* fusion_threshold, double* cycle_time_ms) {
+  if (!g || !g->initialized) return -1;
+  if (fusion_threshold) *fusion_threshold = g->fusion_threshold;
+  if (cycle_time_ms) *cycle_time_ms = g->cycle_time_ms;
+  if (!g->autotune.enabled()) return 0;
+  return g->autotune.active() ? 1 : 2;
 }
 
 // Response-cache observability: hits = tensors executed via the bit-vector

@@ -50,3 +50,16 @@ def test_response_cache_capacity_lru():
 def test_response_cache_disabled():
     run_worker_job(2, "cache_capacity_worker.py",
                    extra_env={"HVD_CACHE_CAPACITY": "0"})
+
+
+def test_autotune(tmp_path):
+    """--autotune is live: GP+EI search moves fusion/cycle params on a
+    synthetic stream, locks, and logs a CSV (reference:
+    parameter_manager.cc + optim/bayesian_optimization.cc)."""
+    log = tmp_path / "autotune.csv"
+    run_worker_job(2, "autotune_worker.py", extra_env={
+        "HVD_AUTOTUNE": "1",
+        "HVD_AUTOTUNE_LOG": str(log),
+        "HVD_AUTOTUNE_CYCLES_PER_SAMPLE": "4",
+        "HVD_AUTOTUNE_MAX_SAMPLES": "10",
+    }, timeout=180)

@@ -1,0 +1,52 @@
+"""Worker: autotune drives fusion threshold + cycle time on a synthetic
+gradient stream (reference: parameter_manager.cc GP+EI, HOROVOD_AUTOTUNE,
+HOROVOD_AUTOTUNE_LOG). Run with HVD_AUTOTUNE=1 and fast sampling knobs.
+
+Asserts: parameters measurably change from their defaults, the search
+eventually locks, the CSV log on rank 0 records one row per sample, and
+every collective result stays correct while parameters move underneath.
+"""
+import os
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+
+status0, fusion0, cycle0 = hvd.autotune_state()
+assert status0 == "searching", status0
+default_fusion = 64 * 1024 * 1024
+
+saw_change = False
+max_samples = int(os.environ.get("HVD_AUTOTUNE_MAX_SAMPLES", "30"))
+# Fixed iteration count on every rank: collectives must stay symmetric, so
+# no data-dependent early exit (a rank breaking first would strand peers).
+for i in range(30 * max_samples):
+    out = hvd.allreduce(np.full((256,), float(r + 1), np.float32),
+                        op=hvd.Sum, name=f"g{i % 4}")
+    assert np.allclose(out, sum(range(1, s + 1))), out[0]
+    status, fusion, cycle = hvd.autotune_state()
+    if fusion != default_fusion or cycle != 1.0:
+        saw_change = True
+
+status, fusion, cycle = hvd.autotune_state()
+assert saw_change, "autotune never changed the live parameters"
+assert status == "locked", (status, fusion, cycle)
+
+log_path = os.environ.get("HVD_AUTOTUNE_LOG", "")
+if r == 0 and log_path:
+    with open(log_path) as f:
+        lines = [l for l in f.read().splitlines() if l]
+    assert lines[0] == "sample,fusion_kb,cycle_ms,score_mbps", lines[:1]
+    rows = [l for l in lines[1:] if not l.startswith("#")]
+    assert len(rows) == max_samples, (len(rows), max_samples)
+    assert any(l.startswith("# final") for l in lines), lines[-2:]
+    # More than one distinct parameter point was actually explored.
+    points = {tuple(l.split(",")[1:3]) for l in rows}
+    assert len(points) >= 3, points
+
+hvd.shutdown()
+print(f"rank {r}: autotune PASS fusion={fusion} cycle={cycle:.3f}",
+      flush=True)
