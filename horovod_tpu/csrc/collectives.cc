@@ -67,12 +67,16 @@ void DataPlane::FullDuplex(Socket& to, const void* sbuf, size_t sn,
         if (sent < sn) fds[nfds++] = {to.fd(), POLLOUT, 0};
         if (recvd < rn) fds[nfds++] = {from.fd(), POLLIN, 0};
       }
-      int rc = ::poll(fds, nfds, 30000);
+      int rc = ::poll(fds, nfds, poll_timeout_ms_);
       if (rc < 0) {
         if (errno == EINTR) continue;
         throw std::runtime_error("poll failed");
       }
-      if (rc == 0) throw std::runtime_error("data-plane poll timeout (30s)");
+      if (rc == 0)
+        throw std::runtime_error(
+            "data-plane poll timeout (" +
+            std::to_string(poll_timeout_ms_ / 1000) +
+            "s with no bytes moved; HVD_DATA_TIMEOUT_SECONDS to tune)");
       for (int i = 0; i < nfds; i++) {
         if ((fds[i].revents & (POLLERR | POLLHUP | POLLNVAL)) &&
             !(fds[i].revents & (POLLIN | POLLOUT)))

@@ -32,6 +32,12 @@ class DataPlane {
   int size() const { return size_; }
   Socket& peer(int r) { return peers_[r]; }
 
+  // Data-plane inactivity timeout (HVD_DATA_TIMEOUT_SECONDS; default 300 s).
+  // A slow link stalls a transfer without failing it as long as SOME bytes
+  // move within each window; only a fully quiet window trips the timeout.
+  void set_timeout_ms(int ms) { poll_timeout_ms_ = ms; }
+  int timeout_ms() const { return poll_timeout_ms_; }
+
   // In-place ring allreduce over `members` (sorted global ranks incl. self).
   // buf holds nelem elements of dtype; op applied elementwise.
   void RingAllreduce(void* buf, int64_t nelem, DataType dtype, ReduceOp op,
@@ -72,6 +78,7 @@ class DataPlane {
  private:
   int rank_ = 0;
   int size_ = 1;
+  int poll_timeout_ms_ = 300000;
   std::vector<Socket> peers_;
 };
 

@@ -2,6 +2,7 @@
 
 #include <algorithm>
 
+#include "logging.h"
 #include "timeline.h"
 
 namespace hvd {
@@ -12,6 +13,10 @@ namespace hvd {
 bool StallInspector::Check(
     const std::unordered_map<std::string, std::map<int32_t, Request>>& table,
     const ProcessSetTable& process_sets, int64_t now_us) {
+  // warn_sec <= 0 disables the inspector entirely (--no-stall-check /
+  // HVD_STALL_CHECK_TIME_SECONDS=0); the reference uses a separate disable
+  // env, here zero-means-off keeps one knob.
+  if (warn_sec_ <= 0) return false;
   bool shutdown = false;
   for (auto& kv : table) {
     const std::string& key = kv.first;
@@ -36,12 +41,11 @@ bool StallInspector::Check(
               missing += std::to_string(r) + " ";
           }
         }
-        fprintf(stderr,
-                "[horovod_tpu] WARNING: potential stall: tensor '%s' was "
-                "submitted by ranks [ %s] but NOT by ranks [ %s] for %.0f s. "
-                "Collectives must be submitted by every rank of the process "
-                "set in the same order.\n",
-                name.c_str(), present.c_str(), missing.c_str(), age);
+        LogF(LogLevel::kWarn,
+             "potential stall: tensor '%s' was submitted by ranks [ %s] but "
+             "NOT by ranks [ %s] for %.0f s. Collectives must be submitted "
+             "by every rank of the process set in the same order.",
+             name.c_str(), present.c_str(), missing.c_str(), age);
       }
     }
     if (shutdown_sec_ > 0 && age > shutdown_sec_) shutdown = true;

@@ -29,6 +29,7 @@
 #include "collectives.h"
 #include "common.h"
 #include "controller.h"
+#include "logging.h"
 #include "response_cache.h"
 #include "tcp.h"
 #include "tensor_queue.h"
@@ -370,8 +371,24 @@ void PerformOperation(const Response& resp) {
     FailEntries(entries, resp.error);
     return;
   }
-  for (auto& e : entries)
-    g->timeline.Record(e.req.name, "QUEUE", e.enqueue_us, NowUs());
+  // Timeline: QUEUE = local submit -> first announce to the coordinator;
+  // NEGOTIATE_<OP> = announce -> globally ready (the reference's most
+  // diagnostic phase: how long ranks wait on each other).
+  if (g->timeline.enabled()) {
+    static const char* kNegotiate[] = {
+        "NEGOTIATE_ALLREDUCE",     "NEGOTIATE_ALLGATHER",
+        "NEGOTIATE_BROADCAST",     "NEGOTIATE_ALLTOALL",
+        "NEGOTIATE_REDUCESCATTER", "NEGOTIATE_JOIN",
+        "NEGOTIATE_BARRIER",       "NEGOTIATE_ADD_PROCESS_SET",
+        "NEGOTIATE_REMOVE_PROCESS_SET"};
+    int64_t now = NowUs();
+    for (auto& e : entries) {
+      int64_t announce = e.popped_us > 0 ? e.popped_us : e.enqueue_us;
+      g->timeline.Record(e.req.name, "QUEUE", e.enqueue_us, announce);
+      g->timeline.Record(e.req.name, kNegotiate[(int)resp.op_type], announce,
+                         now);
+    }
+  }
 
   const auto& members = g->process_sets.Contains(resp.process_set)
                             ? g->process_sets.Members(resp.process_set)
@@ -561,7 +578,7 @@ void BackgroundLoop() {
       if (mark_cycles) g->timeline.Mark("CYCLE_START");
 
       RequestList mine;
-      mine.requests = g->queue.PopRequests();
+      mine.requests = g->queue.PopRequests(NowUs());
       mine.shutdown = g->shutdown_requested.load();
       CacheFilterRequests(mine);
 
@@ -603,6 +620,7 @@ void BackgroundLoop() {
   } catch (const std::exception& ex) {
     // Control- or data-plane failure: the elastic path. Every pending and
     // future operation fails with HorovodInternalError in Python.
+    LogF(LogLevel::kError, "background loop failed: %s", ex.what());
     {
       std::lock_guard<std::mutex> l(g->error_mu);
       g->last_error = ex.what();
@@ -778,6 +796,7 @@ int hvd_init() {
     g = new Global();
     g->rank = (int)EnvInt("HVD_RANK", 0);
     g->size = (int)EnvInt("HVD_SIZE", 1);
+    InitLoggingFromEnv(g->rank);
     g->local_rank = (int)EnvInt("HVD_LOCAL_RANK", g->rank);
     g->local_size = (int)EnvInt("HVD_LOCAL_SIZE", g->size);
     g->cross_rank = (int)EnvInt("HVD_CROSS_RANK", 0);
@@ -799,6 +818,12 @@ int hvd_init() {
         EnvDouble("HVD_STALL_CHECK_TIME_SECONDS", 60.0),
         EnvDouble("HVD_STALL_SHUTDOWN_TIME_SECONDS", -1.0));
     if (g->size > 1) EstablishMesh();
+    g->data.set_timeout_ms(
+        (int)(EnvDouble("HVD_DATA_TIMEOUT_SECONDS", 300.0) * 1000.0));
+    LogF(LogLevel::kInfo,
+         "init: size=%d fusion=%lldB cycle=%.2fms cache=%lld autotune=%d",
+         g->size, (long long)g->fusion_threshold, g->cycle_time_ms,
+         (long long)g->cache.capacity(), g->autotune.enabled() ? 1 : 0);
     // One timeline file per job at the given path (rank 0, like the
     // reference); other ranks append a .rankN suffix so every process can
     // still be traced without clobbering.
@@ -822,8 +847,32 @@ int hvd_init() {
 int hvd_shutdown() {
   if (!g || !g->initialized) return 0;
   g->shutdown_requested = true;
-  if (g->background.joinable()) g->background.join();
+  if (g->background.joinable()) {
+    // Cooperative path: the loop exits once EVERY rank requested shutdown.
+    // If peers keep training (single-rank shutdown), don't hang forever:
+    // after HVD_SHUTDOWN_TIMEOUT, interrupt the control+data sockets so the
+    // blocked background thread unblocks and exits via its error path
+    // (peers then see a closed connection -> HorovodInternalError, the
+    // elastic signal).
+    double tmo = EnvDouble("HVD_SHUTDOWN_TIMEOUT", 30.0);
+    int64_t deadline = NowUs() + (int64_t)(tmo * 1e6);
+    while (!g->dead.load() && NowUs() < deadline)
+      std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    if (!g->dead.load()) {
+      LogF(LogLevel::kWarn,
+           "shutdown: peers still active after %.0fs; interrupting "
+           "control plane (peers will see HorovodInternalError)",
+           tmo);
+      g->to_coordinator.Interrupt();
+      for (auto& w : g->workers) w.Interrupt();
+      if (g->size > 1)
+        for (int i = 0; i < g->size; i++)
+          if (i != g->rank) g->data.peer(i).Interrupt();
+    }
+    g->background.join();
+  }
   g->timeline.Shutdown();
+  LogF(LogLevel::kInfo, "shutdown complete");
   delete g;
   g = nullptr;
   return 1;

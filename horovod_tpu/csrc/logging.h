@@ -1,0 +1,68 @@
+// logging.h — leveled stderr logging for the native core.
+//
+// Equivalent of the reference's horovod/common/logging.cc (LOG(level),
+// HOROVOD_LOG_LEVEL, HOROVOD_LOG_TIMESTAMP): HVD_LOG_LEVEL selects
+// trace|debug|info|warn|error (default warn); HVD_LOG_TIMESTAMP=1 prefixes
+// wall-clock microseconds. Header-only; state is C++17 inline.
+#pragma once
+
+#include <cstdarg>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+
+namespace hvd {
+
+enum class LogLevel : int {
+  kTrace = 0,
+  kDebug = 1,
+  kInfo = 2,
+  kWarn = 3,
+  kError = 4,
+};
+
+inline LogLevel g_log_level = LogLevel::kWarn;
+inline bool g_log_timestamp = false;
+inline int g_log_rank = -1;
+
+inline void InitLoggingFromEnv(int rank) {
+  g_log_rank = rank;
+  const char* ts = getenv("HVD_LOG_TIMESTAMP");
+  g_log_timestamp = ts && *ts && strcmp(ts, "0") != 0;
+  const char* lv = getenv("HVD_LOG_LEVEL");
+  if (!lv) return;
+  if (!strcmp(lv, "trace"))
+    g_log_level = LogLevel::kTrace;
+  else if (!strcmp(lv, "debug"))
+    g_log_level = LogLevel::kDebug;
+  else if (!strcmp(lv, "info"))
+    g_log_level = LogLevel::kInfo;
+  else if (!strcmp(lv, "warn") || !strcmp(lv, "warning"))
+    g_log_level = LogLevel::kWarn;
+  else if (!strcmp(lv, "error"))
+    g_log_level = LogLevel::kError;
+}
+
+inline bool LogEnabled(LogLevel lvl) { return (int)lvl >= (int)g_log_level; }
+
+inline void LogF(LogLevel lvl, const char* fmt, ...) {
+  if (!LogEnabled(lvl)) return;
+  static const char* names[] = {"trace", "debug", "info", "warn", "error"};
+  char msg[1024];
+  va_list ap;
+  va_start(ap, fmt);
+  vsnprintf(msg, sizeof(msg), fmt, ap);
+  va_end(ap);
+  if (g_log_timestamp) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    fprintf(stderr, "[hvd %s] %lld.%06ld rank %d: %s\n", names[(int)lvl],
+            (long long)ts.tv_sec, ts.tv_nsec / 1000, g_log_rank, msg);
+  } else {
+    fprintf(stderr, "[hvd %s] rank %d: %s\n", names[(int)lvl], g_log_rank,
+            msg);
+  }
+}
+
+}  // namespace hvd

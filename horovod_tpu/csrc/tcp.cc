@@ -78,9 +78,20 @@ void Socket::SendFrame(const std::vector<uint8_t>& payload) {
 std::vector<uint8_t> Socket::RecvFrame() {
   uint32_t len = 0;
   RecvAll(&len, 4);
+  // Sanity cap: negotiation frames are small; a corrupt/hostile peer must
+  // not be able to make us allocate arbitrary memory from a length prefix.
+  if (len > kMaxFrameBytes)
+    throw std::runtime_error("frame length " + std::to_string(len) +
+                             " exceeds sanity cap — corrupt peer?");
   std::vector<uint8_t> payload(len);
   if (len) RecvAll(payload.data(), len);
   return payload;
+}
+
+void Socket::Interrupt() {
+  // Unblock a thread stuck in recv/send on this socket WITHOUT releasing
+  // the fd (the owner still closes it); used by the bounded-shutdown path.
+  if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
 }
 
 void Listener::Listen(int port) {

@@ -34,6 +34,13 @@ class Socket {
   void SendFrame(const std::vector<uint8_t>& payload);
   std::vector<uint8_t> RecvFrame();
 
+  // Unblock any thread blocked in IO on this socket (shutdown(2) without
+  // close); safe to call from another thread than the IO owner.
+  void Interrupt();
+
+  // Negotiation-frame sanity cap (1 GiB) — see RecvFrame.
+  static constexpr uint32_t kMaxFrameBytes = 1u << 30;
+
   void SetNoDelay();
 
  private:

@@ -20,7 +20,8 @@ struct TensorTableEntry {
   const void* input = nullptr;  // user buffer, valid until handle completes
   void* output = nullptr;       // user output buffer (may equal input) or null
   int handle = -1;
-  int64_t enqueue_us = 0;  // for timeline QUEUE phase
+  int64_t enqueue_us = 0;  // timeline QUEUE phase start
+  int64_t popped_us = 0;   // announce time: QUEUE -> NEGOTIATE_* boundary
 };
 
 class TensorQueue {
@@ -44,11 +45,17 @@ class TensorQueue {
     return true;
   }
 
-  // Drain requests not yet sent to the coordinator (called once per cycle).
-  std::vector<Request> PopRequests() {
+  // Drain requests not yet sent to the coordinator (called once per cycle);
+  // stamps each drained entry's announce time for the timeline's
+  // QUEUE -> NEGOTIATE_* phase boundary.
+  std::vector<Request> PopRequests(int64_t now_us = 0) {
     std::lock_guard<std::mutex> l(mu_);
     std::vector<Request> out;
     out.swap(pending_);
+    for (auto& q : out) {
+      auto it = table_.find(Key(q.process_set, q.name));
+      if (it != table_.end()) it->second.popped_us = now_us;
+    }
     return out;
   }
 

@@ -1,0 +1,31 @@
+"""Worker: single-rank shutdown must not hang (VERDICT r1 weak #8).
+
+Rank 1 calls hvd.shutdown() immediately while rank 0 keeps training; the
+bounded-shutdown path (HVD_SHUTDOWN_TIMEOUT) interrupts the control plane,
+rank 1's shutdown returns, and rank 0 observes HorovodInternalError — the
+elastic recovery signal — instead of blocking forever."""
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import HorovodInternalError
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+
+if r == 1:
+    t0 = time.time()
+    hvd.shutdown()  # peers still active -> bounded by HVD_SHUTDOWN_TIMEOUT
+    took = time.time() - t0
+    assert took < 15.0, f"shutdown took {took:.1f}s"
+    print(f"rank {r}: early shutdown returned in {took:.1f}s", flush=True)
+else:
+    got_internal_error = False
+    try:
+        for i in range(2000):
+            hvd.allreduce(np.ones(8, np.float32), op=hvd.Sum, name=f"t{i}")
+    except HorovodInternalError:
+        got_internal_error = True
+    assert got_internal_error, "rank 0 never observed the peer's departure"
+    print(f"rank {r}: got HorovodInternalError as expected", flush=True)

@@ -1,0 +1,18 @@
+"""Worker: rank 1 delays a collective so the stall inspector (on the
+coordinator) should warn iff HVD_STALL_CHECK_TIME_SECONDS > 0."""
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+
+if r == 1:
+    time.sleep(2.5)
+out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="slow.x")
+assert np.allclose(out, s)
+
+hvd.shutdown()
+print(f"rank {r}: stall worker done", flush=True)
