@@ -271,11 +271,22 @@ ResponseList Coordinator::Update(std::vector<RequestList>& lists,
     for (auto& kv : bit_ranks) {
       uint32_t b = kv.first;
       if (evict.count(b) || !cache_->Valid(b)) continue;
-      int ps = cache_->Get(b).process_set;
+      const Response& cached = cache_->Get(b);
+      int ps = cached.process_set;
       if (!process_sets_->Contains(ps)) continue;
+      // Joined ranks are implicit allreduce participants — without this,
+      // a steady-state cached tensor would deadlock the moment a rank
+      // joins (it submits nothing, so the bit AND never completes).
+      const std::set<int32_t>* joined = nullptr;
+      auto jt = joined_ranks_.find(ps);
+      if (cached.op_type == OpType::kAllreduce && jt != joined_ranks_.end())
+        joined = &jt->second;
       bool all = true;
       for (int32_t m : process_sets_->Members(ps))
-        if (!kv.second.count(m)) { all = false; break; }
+        if (!kv.second.count(m) && !(joined && joined->count(m))) {
+          all = false;
+          break;
+        }
       if (all) hits.push_back(b);  // map iteration => ascending order
     }
   }
@@ -286,6 +297,12 @@ ResponseList Coordinator::Update(std::vector<RequestList>& lists,
   for (size_t r = 0; r < lists.size(); r++) {
     if (lists[r].shutdown) shutdown_ranks_.insert((int32_t)r);
     for (auto& req : lists[r].requests) {
+      if (req.op_type == OpType::kJoin) {
+        // Zero-fill participation starts the moment the rank joins, not
+        // when the join completes.
+        joined_ranks_[req.process_set].insert(req.rank);
+        last_joined_[req.process_set] = req.rank;
+      }
       std::string key = std::to_string(req.process_set) + "\x01" + req.name;
       if (!message_table_.count(key)) arrival_order_.push_back(key);
       message_table_[key][req.rank] = req;
@@ -318,11 +335,50 @@ ResponseList Coordinator::Update(std::vector<RequestList>& lists,
     } else {
       required = process_sets_->Size(first.process_set);
     }
-    if ((int)per_rank.size() < required) {
+    auto jt = joined_ranks_.find(first.process_set);
+    const std::set<int32_t>* joined =
+        jt != joined_ranks_.end() && !jt->second.empty() ? &jt->second
+                                                         : nullptr;
+    if (joined && first.op_type != OpType::kJoin &&
+        first.op_type != OpType::kAllreduce &&
+        first.op_type != OpType::kAddProcessSet &&
+        first.op_type != OpType::kRemoveProcessSet) {
+      // Only allreduce supports zero-fill stand-ins (reference:
+      // HorovodJoinOp); any other collective racing a join is a usage
+      // error — fail it rather than stall.
+      std::string who;
+      for (int32_t m : *joined) who += std::to_string(m) + " ";
+      Response err;
+      err.op_type = first.op_type;
+      err.names = {first.name};
+      err.process_set = first.process_set;
+      err.error = "collective '" + first.name + "' submitted while ranks [ " +
+                  who + "] have joined; only allreduce may overlap join";
+      ready.push_back(err);
+      message_table_.erase(it);
+      continue;
+    }
+    if (first.op_type == OpType::kAllreduce && joined) {
+      // Joined members count as implicit (zero-contribution) participants.
+      int have = 0;
+      for (int32_t m : process_sets_->Members(first.process_set))
+        if (per_rank.count(m) || joined->count(m)) have++;
+      if (have < required) {
+        still_pending.push_back(key);
+        continue;
+      }
+    } else if ((int)per_rank.size() < required) {
       still_pending.push_back(key);
       continue;
     }
     Response resp = BuildResponse(first.name, per_rank);
+    if (first.op_type == OpType::kJoin && resp.error.empty()) {
+      // join() returns the LAST rank to join (reference semantics); the
+      // set clears so post-join collectives need everyone again.
+      resp.root = last_joined_[first.process_set];
+      joined_ranks_.erase(first.process_set);
+      last_joined_.erase(first.process_set);
+    }
     stall_.OnReady(key);
     int32_t gid = first.group_id;
     int32_t gsize = first.group_size;

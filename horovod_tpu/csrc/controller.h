@@ -151,6 +151,12 @@ class Coordinator {
   // FIFO of names in arrival order (determinism of response ordering).
   std::vector<std::string> arrival_order_;
   std::set<int32_t> shutdown_ranks_;
+  // Join bookkeeping (reference: HorovodJoinOp zero-fill participation):
+  // ranks that called join(), per process set; they count as implicit
+  // participants of allreduce readiness (and of cache-bit ANDs) until
+  // every member joins and the join response releases them.
+  std::map<int32_t, std::set<int32_t>> joined_ranks_;
+  std::map<int32_t, int32_t> last_joined_;
   ProcessSetTable* process_sets_ = nullptr;
   StallInspector stall_;
   // Grouped collectives staged until every member tensor of the group is

@@ -120,6 +120,12 @@ struct Global {
 
   std::mutex error_mu;
   std::string last_error;
+
+  // Process sets this rank has joined (join() called, not yet released):
+  // the background thread participates in allreduces for them with
+  // zero-filled stand-ins (reference: HorovodJoinOp).
+  std::mutex join_mu;
+  std::set<int32_t> joined_sets;
 };
 
 Global* g = nullptr;
@@ -187,7 +193,7 @@ void ExecAllreduce(const Response& resp,
   ReduceOp ring_op =
       resp.red_op == ReduceOp::kAverage ? ReduceOp::kSum : resp.red_op;
 
-  if (entries.size() == 1) {
+  if (entries.size() == 1 && resp.names.size() == 1) {
     // Unfused fast path: operate in place on the user's output buffer.
     auto& e = entries[0];
     int64_t n = NumElements(e.req.shape);
@@ -204,16 +210,24 @@ void ExecAllreduce(const Response& resp,
     return;
   }
 
-  // Fused path: pack into the fusion buffer, one ring, unpack.
+  // Fused / zero-fill path: lay the buffer out by the RESPONSE's tensor
+  // order (canonical across ranks); names this rank did not submit — a
+  // joined rank's stand-ins (reference: HorovodJoinOp) — stay zero.
+  std::unordered_map<std::string, TensorTableEntry*> mine;
+  for (auto& e : entries) mine[e.req.name] = &e;
   int64_t total = 0;
-  for (auto& e : entries) total += NumElements(e.req.shape);
+  for (auto& s : resp.shapes) total += NumElements(s);
   EnsureFusionCapacity(total * (int64_t)esz);
   uint8_t* fb = g->fusion_buf.data();
   int64_t t0 = NowUs();
   int64_t off = 0;
-  for (auto& e : entries) {
-    int64_t n = NumElements(e.req.shape);
-    memcpy(fb + off * esz, e.input, (size_t)n * esz);
+  for (size_t i = 0; i < resp.names.size(); i++) {
+    int64_t n = NumElements(resp.shapes[i]);
+    auto it = mine.find(resp.names[i]);
+    if (it != mine.end())
+      memcpy(fb + off * esz, it->second->input, (size_t)n * esz);
+    else
+      memset(fb + off * esz, 0, (size_t)n * esz);
     off += n;
   }
   int64_t t1 = NowUs();
@@ -225,14 +239,18 @@ void ExecAllreduce(const Response& resp,
   int64_t t2 = NowUs();
   if (post != 1.0) ScaleBuffer(fb, total, resp.dtype, post);
   off = 0;
-  for (auto& e : entries) {
-    int64_t n = NumElements(e.req.shape);
-    memcpy(e.output, fb + off * esz, (size_t)n * esz);
+  for (size_t i = 0; i < resp.names.size(); i++) {
+    int64_t n = NumElements(resp.shapes[i]);
+    auto it = mine.find(resp.names[i]);
+    if (it != mine.end()) {
+      auto& e = *it->second;
+      memcpy(e.output, fb + off * esz, (size_t)n * esz);
+      g->timeline.Record(e.req.name, "MEMCPY_IN_FUSION_BUFFER", t0, t1);
+      g->timeline.Record(e.req.name, "TCP_ALLREDUCE", t1, t2);
+      g->timeline.Record(e.req.name, "MEMCPY_OUT_FUSION_BUFFER", t2, NowUs());
+      CompleteHandle(e.handle, Status::Ok());
+    }
     off += n;
-    g->timeline.Record(e.req.name, "MEMCPY_IN_FUSION_BUFFER", t0, t1);
-    g->timeline.Record(e.req.name, "TCP_ALLREDUCE", t1, t2);
-    g->timeline.Record(e.req.name, "MEMCPY_OUT_FUSION_BUFFER", t2, NowUs());
-    CompleteHandle(e.handle, Status::Ok());
   }
 }
 
@@ -365,7 +383,16 @@ void PerformOperation(const Response& resp) {
     if (g->queue.Take(name, resp.process_set, &e))
       entries.push_back(std::move(e));
   }
-  if (entries.empty()) return;  // not a participant
+  if (entries.empty()) {
+    // Normally not a participant — except a joined rank, which must still
+    // run allreduces for its process set with zero-filled stand-ins.
+    bool joined_fill = false;
+    if (resp.op_type == OpType::kAllreduce && resp.error.empty()) {
+      std::lock_guard<std::mutex> l(g->join_mu);
+      joined_fill = g->joined_sets.count(resp.process_set) > 0;
+    }
+    if (!joined_fill) return;
+  }
 
   if (!resp.error.empty()) {
     FailEntries(entries, resp.error);
@@ -410,7 +437,18 @@ void PerformOperation(const Response& resp) {
       case OpType::kReducescatter:
         ExecReducescatter(resp, entries[0], members);
         break;
-      case OpType::kJoin:
+      case OpType::kJoin: {
+        {
+          std::lock_guard<std::mutex> l(g->join_mu);
+          g->joined_sets.erase(resp.process_set);
+        }
+        for (auto& e : entries) {
+          auto hs = GetHandle(e.handle);
+          if (hs) hs->extra = resp.root;  // last rank to join
+          CompleteHandle(e.handle, Status::Ok());
+        }
+        break;
+      }
       case OpType::kBarrier:
         for (auto& e : entries) CompleteHandle(e.handle, Status::Ok());
         break;
@@ -771,6 +809,11 @@ int Enqueue(OpType type, const char* name, const void* input, void* output,
              "' is already pending; names must be unique among in-flight "
              "collectives");
     return -1;
+  }
+  if (type == OpType::kJoin) {
+    // Zero-fill participation starts locally as soon as join is enqueued.
+    std::lock_guard<std::mutex> l(g->join_mu);
+    g->joined_sets.insert(process_set);
   }
   return handle;
 }

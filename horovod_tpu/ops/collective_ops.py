@@ -132,6 +132,8 @@ def poll(handle):
 def _collect_result(handle):
     if handle.kind in ("allreduce", "broadcast"):
         return handle.output
+    if handle.kind == "join":
+        return _lib.hvd_handle_extra(handle.id)  # last rank to join
     # Core-owned output: copy into a fresh numpy array.
     ndim = _lib.hvd_output_ndim(handle.id)
     shape_buf = (ctypes.c_int64 * max(ndim, 1))()
@@ -323,18 +325,20 @@ def reducescatter(tensor, op=Average, name=None, prescale_factor=1.0,
 # Join / barrier / process sets
 
 def join(process_set=0):
-    """Block until every rank of the process set has joined.
+    """Signal that this rank has no more collectives to submit.
 
-    Note: unlike the reference's join (which lets remaining ranks continue
-    collectives with zero-filled stand-ins), this build's join is a
-    termination barrier: call it when the rank has no more collectives to
-    submit. Returns 0. Zero-fill participation is tracked for a later round.
+    While peers keep submitting allreduces, this rank participates with
+    zero-filled stand-ins (reference: HorovodJoinOp in
+    horovod/tensorflow/mpi_ops.cc) — the uneven-final-batch pattern: ranks
+    that run out of data join early and dilute the average with zeros while
+    the rest finish. Blocks until every member of the process set has
+    joined; returns the rank of the LAST rank to join (reference
+    semantics — useful to pick the broadcast root for final state).
     """
     name = _auto_name("join", None)
     h = _check_handle(_lib.hvd_join_async(name.encode(), int(process_set)))
     handle = _register(Handle(h, "join", (), None, None, name))
-    synchronize(handle)
-    return 0
+    return synchronize(handle)
 
 
 def barrier(process_set=0):

@@ -63,3 +63,11 @@ def test_autotune(tmp_path):
         "HVD_AUTOTUNE_CYCLES_PER_SAMPLE": "4",
         "HVD_AUTOTUNE_MAX_SAMPLES": "10",
     }, timeout=180)
+
+
+@pytest.mark.parametrize("np_", [2, 3])
+def test_join_zero_fill(np_):
+    """Join parity (reference HorovodJoinOp): ranks run different step
+    counts; joined ranks zero-fill allreduces while survivors continue;
+    join() returns the last rank to join."""
+    run_worker_job(np_, "join_worker.py")

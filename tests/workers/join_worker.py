@@ -1,0 +1,42 @@
+"""Worker: join with zero-fill participation (reference: HorovodJoinOp —
+test pattern: ranks run different step counts; joined ranks contribute
+zero-filled stand-ins; the average divides by the full member count).
+
+Rank r runs 4 + 3*r steps. After a rank joins, survivors' allreduces must
+still complete, with the joined rank's contribution = 0. join() returns the
+last rank to join. Also covers the fused path (two tensors per step) and
+the cache steady state (same names every step)."""
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+
+my_steps = 4 + 3 * r
+max_steps = 4 + 3 * (s - 1)
+
+for i in range(my_steps):
+    # Ranks still active at step i (rank q runs 4+3q steps).
+    active = [q for q in range(s) if i < 4 + 3 * q]
+    va = hvd.allreduce(np.full((8,), float(r + 1), np.float32),
+                       op=hvd.Average, name="grad.a")
+    vb = hvd.allreduce(np.full((3,), float(10 * (r + 1)), np.float32),
+                       op=hvd.Sum, name="grad.b")
+    exp_a = sum(q + 1 for q in active) / s  # zero-dilated average
+    exp_b = sum(10 * (q + 1) for q in active)
+    assert np.allclose(va, exp_a), (i, va[0], exp_a, active)
+    assert np.allclose(vb, exp_b), (i, vb[0], exp_b, active)
+
+last = hvd.join()
+assert last == s - 1, last  # rank s-1 runs longest, joins last
+
+# Collectives work normally again after everyone rejoined.
+out = hvd.allreduce(np.full((4,), float(r + 1), np.float32), op=hvd.Sum,
+                    name="post.join")
+assert np.allclose(out, sum(range(1, s + 1))), out
+
+hits, misses, entries = hvd.cache_stats()
+hvd.shutdown()
+print(f"rank {r}: join PASS steps={my_steps} last={last} hits={hits}",
+      flush=True)
