@@ -72,6 +72,11 @@ def _run_op(np_fn, x, out_dtype=None):
 
 def allreduce(tensor, op=Average, name=None, process_set=0,
               prescale_factor=1.0, postscale_factor=1.0, compression=None):
+    """Differentiable allreduce (reference: horovod/tensorflow/mpi_ops.py
+    registers a gradient for HorovodAllreduceOp: the gradient of an
+    allreduce is an allreduce of the upstream gradient with the same op)."""
+    tf = _tf()
+
     def fn(a):
         ctx = None
         if compression is not None:
@@ -84,7 +89,18 @@ def allreduce(tensor, op=Average, name=None, process_set=0,
             out = compression.decompress(out, ctx)
         return out
 
-    return _run_op(fn, tensor)
+    @tf.custom_gradient
+    def _op(x):
+        y = _run_op(fn, x)
+
+        def grad(dy):
+            return allreduce(dy, op=op,
+                             name=_core._auto_name("grad.allreduce", None),
+                             process_set=process_set)
+
+        return y, grad
+
+    return _op(tensor)
 
 
 def grouped_allreduce(tensors, op=Average, name=None, process_set=0):
@@ -103,16 +119,63 @@ def grouped_allreduce(tensors, op=Average, name=None, process_set=0):
 
 
 def allgather(tensor, name=None, process_set=0):
-    return _run_op(lambda a: _core.allgather(a, name=name,
-                                             process_set=process_set),
-                   tensor)
+    """Differentiable allgather: the gradient is the SUM over ranks of the
+    upstream gradient, sliced back to this rank's segment (reference:
+    mpi_ops.py _allgather_grad using the gathered first-dim sizes)."""
+    tf = _tf()
+
+    @tf.custom_gradient
+    def _op(x):
+        y = _run_op(lambda a: _core.allgather(a, name=name,
+                                              process_set=process_set), x)
+
+        def grad(dy):
+            my_rows = int(x.shape[0])
+            sizes = _core.allgather(
+                np.asarray([my_rows], np.int64),
+                name=_core._auto_name("grad.allgather.sizes", None),
+                process_set=process_set)
+            reduced = allreduce(dy, op=Sum,
+                                name=_core._auto_name("grad.allgather", None),
+                                process_set=process_set)
+            r = _my_set_rank(process_set)
+            offset = int(np.sum(sizes[:r]))
+            return reduced[offset:offset + my_rows]
+
+        return y, grad
+
+    return _op(_tf().convert_to_tensor(tensor))
+
+
+def _my_set_rank(process_set):
+    from ..basics import _lib
+
+    return _lib.hvd_process_set_rank(int(process_set))
 
 
 def broadcast(tensor, root_rank=0, name=None, process_set=0):
-    return _run_op(lambda a: _core.broadcast(a, root_rank=root_rank,
-                                             name=name,
-                                             process_set=process_set),
-                   tensor)
+    """Differentiable broadcast: the root's gradient is the sum of every
+    rank's upstream gradient; non-roots get zero (reference: mpi_ops.py
+    _broadcast_grad)."""
+    tf = _tf()
+
+    @tf.custom_gradient
+    def _op(x):
+        y = _run_op(lambda a: _core.broadcast(a, root_rank=root_rank,
+                                              name=name,
+                                              process_set=process_set), x)
+
+        def grad(dy):
+            summed = allreduce(dy, op=Sum,
+                               name=_core._auto_name("grad.broadcast", None),
+                               process_set=process_set)
+            if _my_set_rank(process_set) == root_rank:
+                return summed
+            return tf.zeros_like(summed)
+
+        return y, grad
+
+    return _op(tensor)
 
 
 def alltoall(tensor, splits=None, name=None, process_set=0):
