@@ -153,7 +153,13 @@ void ParameterManager::Propose(double out[2]) {
 bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
                               double* cycle_ms) {
   if (!active()) return false;
-  if (bytes <= 0 && acc_cycles_ == 0) return false;  // idle before window
+  if (bytes <= 0 && acc_cycles_ == 0) {
+    // Idle before the window opens: keep re-stamping the start so a pause
+    // between windows (eval, checkpoint, compile) is not charged to the
+    // next parameter point as a spurious near-zero bytes/sec observation.
+    if (window_start_us_ >= 0) window_start_us_ = now_us;
+    return false;
+  }
   if (window_start_us_ < 0) {
     window_start_us_ = now_us;
     // Adopt the first sample point right away.

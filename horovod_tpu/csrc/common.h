@@ -326,6 +326,10 @@ struct ResponseList {
   std::vector<uint32_t> cache_hits;
   std::vector<uint32_t> evict_bits;
   bool shutdown = false;
+  // Why the coordinator is shutting the job down (empty for a cooperative
+  // all-ranks shutdown): surfaced in every rank's HorovodInternalError so
+  // aborts are diagnosable away from rank 0's stderr.
+  std::string shutdown_reason;
   // Autotune proposals (coordinator -> all ranks; -1 = unchanged). Every
   // rank adopts them while processing this list, so parameter switches are
   // cycle-synchronized (reference: ParameterManager values ride the
@@ -336,6 +340,7 @@ struct ResponseList {
 
   void serialize(Writer& w) const {
     w.u8(shutdown ? 1 : 0);
+    w.str(shutdown_reason);
     w.u32((uint32_t)responses.size());
     for (auto& s : responses) s.serialize(w);
     w.u32vec(cache_hits);
@@ -347,6 +352,7 @@ struct ResponseList {
   static ResponseList deserialize(Reader& r) {
     ResponseList l;
     l.shutdown = r.u8() != 0;
+    l.shutdown_reason = r.str();
     uint32_t n = r.u32();
     l.responses.reserve(n);
     for (uint32_t i = 0; i < n; i++)

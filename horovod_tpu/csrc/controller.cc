@@ -13,10 +13,11 @@ namespace hvd {
 bool StallInspector::Check(
     const std::unordered_map<std::string, std::map<int32_t, Request>>& table,
     const ProcessSetTable& process_sets, int64_t now_us) {
-  // warn_sec <= 0 disables the inspector entirely (--no-stall-check /
-  // HVD_STALL_CHECK_TIME_SECONDS=0); the reference uses a separate disable
-  // env, here zero-means-off keeps one knob.
-  if (warn_sec_ <= 0) return false;
+  // warn_sec <= 0 disables the warning (--no-stall-check /
+  // HVD_STALL_CHECK_TIME_SECONDS=0) but NOT the shutdown threshold: an
+  // explicitly configured HVD_STALL_SHUTDOWN_TIME_SECONDS still fires even
+  // when warnings are silenced.
+  if (warn_sec_ <= 0 && shutdown_sec_ <= 0) return false;
   bool shutdown = false;
   for (auto& kv : table) {
     const std::string& key = kv.first;
@@ -27,7 +28,7 @@ bool StallInspector::Check(
       continue;
     }
     double age = (now_us - it->second) / 1e6;
-    if (age > warn_sec_) {
+    if (warn_sec_ > 0 && age > warn_sec_) {
       auto& lw = last_warned_[key];
       if ((now_us - lw) / 1e6 > warn_sec_) {
         lw = now_us;
@@ -277,16 +278,28 @@ ResponseList Coordinator::Update(std::vector<RequestList>& lists,
       // Joined ranks are implicit allreduce participants — without this,
       // a steady-state cached tensor would deadlock the moment a rank
       // joins (it submits nothing, so the bit AND never completes).
-      const std::set<int32_t>* joined = nullptr;
       auto jt = joined_ranks_.find(ps);
-      if (cached.op_type == OpType::kAllreduce && jt != joined_ranks_.end())
-        joined = &jt->second;
+      const std::set<int32_t>* joined =
+          jt != joined_ranks_.end() ? &jt->second : nullptr;
       bool all = true;
-      for (int32_t m : process_sets_->Members(ps))
-        if (!kv.second.count(m) && !(joined && joined->count(m))) {
-          all = false;
-          break;
+      bool evict_for_join = false;
+      for (int32_t m : process_sets_->Members(ps)) {
+        if (kv.second.count(m)) continue;
+        if (joined && joined->count(m)) {
+          if (cached.op_type == OpType::kAllreduce) continue;  // stand-in
+          // A cached NON-allreduce can never complete once a member
+          // joined: evict the bit so the reporting ranks repost through
+          // negotiation, which fails it with the only-allreduce-may-
+          // overlap-join error instead of hanging the bit AND silently.
+          evict_for_join = true;
         }
+        all = false;
+        break;
+      }
+      if (evict_for_join) {
+        evict.insert(b);
+        continue;
+      }
       if (all) hits.push_back(b);  // map iteration => ascending order
     }
   }
@@ -313,6 +326,7 @@ ResponseList Coordinator::Update(std::vector<RequestList>& lists,
   // arrival order.
   std::vector<Response> ready;
   std::vector<std::string> still_pending;
+  std::vector<int32_t> joins_completed;
 
   for (auto& key : arrival_order_) {
     auto it = message_table_.find(key);
@@ -342,21 +356,33 @@ ResponseList Coordinator::Update(std::vector<RequestList>& lists,
     if (joined && first.op_type != OpType::kJoin &&
         first.op_type != OpType::kAllreduce &&
         first.op_type != OpType::kAddProcessSet &&
-        first.op_type != OpType::kRemoveProcessSet) {
+        first.op_type != OpType::kRemoveProcessSet &&
+        (int)per_rank.size() < required) {
       // Only allreduce supports zero-fill stand-ins (reference:
-      // HorovodJoinOp); any other collective racing a join is a usage
-      // error — fail it rather than stall.
-      std::string who;
-      for (int32_t m : *joined) who += std::to_string(m) + " ";
-      Response err;
-      err.op_type = first.op_type;
-      err.names = {first.name};
-      err.process_set = first.process_set;
-      err.error = "collective '" + first.name + "' submitted while ranks [ " +
-                  who + "] have joined; only allreduce may overlap join";
-      ready.push_back(err);
-      message_table_.erase(it);
-      continue;
+      // HorovodJoinOp). A fully-submitted collective needs no stand-ins and
+      // completes normally below; an incomplete one whose missing members
+      // have joined will never complete — fail it rather than stall. Missing
+      // members that have NOT joined may still submit: keep it pending.
+      bool missing_joined = false;
+      for (int32_t m : process_sets_->Members(first.process_set))
+        if (!per_rank.count(m) && joined->count(m)) {
+          missing_joined = true;
+          break;
+        }
+      if (missing_joined) {
+        std::string who;
+        for (int32_t m : *joined) who += std::to_string(m) + " ";
+        Response err;
+        err.op_type = first.op_type;
+        err.names = {first.name};
+        err.process_set = first.process_set;
+        err.error = "collective '" + first.name +
+                    "' submitted while ranks [ " + who +
+                    "] have joined; only allreduce may overlap join";
+        ready.push_back(err);
+        message_table_.erase(it);
+        continue;
+      }
     }
     if (first.op_type == OpType::kAllreduce && joined) {
       // Joined members count as implicit (zero-contribution) participants.
@@ -373,11 +399,14 @@ ResponseList Coordinator::Update(std::vector<RequestList>& lists,
     }
     Response resp = BuildResponse(first.name, per_rank);
     if (first.op_type == OpType::kJoin && resp.error.empty()) {
-      // join() returns the LAST rank to join (reference semantics); the
-      // set clears so post-join collectives need everyone again.
+      // join() returns the LAST rank to join (reference semantics). Joined
+      // state stays live for the remainder of THIS readiness pass — a join
+      // key typically precedes re-submitted tensor keys in arrival_order_,
+      // and allreduces draining in the same RequestList still need their
+      // zero-fill stand-ins (reference keeps joined state for the whole
+      // ComputeResponseList pass). Clearing is deferred past the loop.
       resp.root = last_joined_[first.process_set];
-      joined_ranks_.erase(first.process_set);
-      last_joined_.erase(first.process_set);
+      joins_completed.push_back(first.process_set);
     }
     stall_.OnReady(key);
     int32_t gid = first.group_id;
@@ -399,6 +428,13 @@ ResponseList Coordinator::Update(std::vector<RequestList>& lists,
   }
   arrival_order_ = std::move(still_pending);
 
+  // Post-join collectives need everyone again: clear joined state only after
+  // every key of this pass has been examined (see note at the join branch).
+  for (int32_t ps : joins_completed) {
+    joined_ranks_.erase(ps);
+    last_joined_.erase(ps);
+  }
+
   // Release groups whose member tensors are all ready on all ranks
   // (reference: group_table.cc atomic-group negotiation).
   for (auto it = pending_groups_.begin(); it != pending_groups_.end();) {
@@ -419,14 +455,35 @@ ResponseList Coordinator::Update(std::vector<RequestList>& lists,
       ++it;
   }
 
-  stall_.Check(message_table_, *process_sets_, NowUs());
+  // A stalled tensor past the shutdown threshold aborts the whole job: the
+  // shutdown flag rides the broadcast ResponseList, every rank's background
+  // loop exits, and pending ops fail with HorovodInternalError (reference:
+  // HOROVOD_STALL_SHUTDOWN_TIME_SECONDS in stall-check docs).
+  bool stall_shutdown =
+      stall_.Check(message_table_, *process_sets_, NowUs());
+  if (stall_shutdown)
+    LogF(LogLevel::kError,
+         "stall shutdown: a collective exceeded the stall shutdown "
+         "threshold; aborting the job");
+
+  // Join completions are delivered LAST (reference: ComputeResponseList
+  // appends the final join response after all tensor responses): an
+  // allreduce negotiated in the same cycle must execute while every joined
+  // rank still has its local joined_sets flag, or the joined side skips its
+  // zero-fill stand-in and the survivors' ring blocks forever.
+  std::stable_partition(ready.begin(), ready.end(), [](const Response& r) {
+    return r.op_type != OpType::kJoin;
+  });
 
   ResponseList out;
   Fuse(ready, out);
   out.cache_hits = std::move(hits);
   out.evict_bits.assign(evict.begin(), evict.end());
-  *all_shutdown = (int)shutdown_ranks_.size() >= size_;
+  *all_shutdown = (int)shutdown_ranks_.size() >= size_ || stall_shutdown;
   out.shutdown = *all_shutdown;
+  if (stall_shutdown)
+    out.shutdown_reason =
+        "a collective stalled past HVD_STALL_SHUTDOWN_TIME_SECONDS";
   return out;
 }
 

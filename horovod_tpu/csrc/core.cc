@@ -609,6 +609,7 @@ void FailAllPending(const std::string& why) {
 
 void BackgroundLoop() {
   bool mark_cycles = EnvInt("HVD_TIMELINE_MARK_CYCLES", 0) != 0;
+  std::string shutdown_reason;
   try {
     while (true) {
       std::this_thread::sleep_for(
@@ -652,9 +653,16 @@ void BackgroundLoop() {
       }
 
       ProcessResponseList(rl);
-      if (rl.shutdown) break;
+      if (rl.shutdown) {
+        if (!rl.shutdown_reason.empty())
+          shutdown_reason = rl.shutdown_reason;
+        break;
+      }
     }
-    FailAllPending("horovod_tpu shutdown");
+    FailAllPending(shutdown_reason.empty()
+                       ? "horovod_tpu shutdown"
+                       : "HorovodInternalError: " + shutdown_reason +
+                             " (coordinator-initiated shutdown)");
   } catch (const std::exception& ex) {
     // Control- or data-plane failure: the elastic path. Every pending and
     // future operation fails with HorovodInternalError in Python.
@@ -726,6 +734,11 @@ void EstablishMesh() {
       w.str(hosts[i]);
       w.i32(ports[i]);
     }
+    // Rank 0's cache capacity is authoritative: cache bit positions are
+    // implicit in per-replica insert/eviction order, so a per-rank capacity
+    // mismatch would silently desynchronize replicas once eviction starts
+    // (the same hit bit expanding to different tensors on different ranks).
+    w.i64(g->cache.capacity());
     for (int r = 1; r < g->size; r++) g->workers[r].SendFrame(w.buf);
   } else {
     g->to_coordinator = ConnectRetry(chost, cport, timeout);
@@ -738,6 +751,14 @@ void EstablishMesh() {
     for (int i = 0; i < g->size; i++) {
       hosts[i] = rd.str();
       ports[i] = rd.i32();
+    }
+    int64_t cap = rd.i64();
+    if (cap != g->cache.capacity()) {
+      LogF(LogLevel::kWarn,
+           "HVD_CACHE_CAPACITY mismatch: rank %d has %lld, coordinator has "
+           "%lld; adopting the coordinator's value",
+           g->rank, (long long)g->cache.capacity(), (long long)cap);
+      g->cache.Configure(cap);
     }
   }
 

@@ -65,6 +65,24 @@ def test_autotune(tmp_path):
     }, timeout=180)
 
 
+def test_join_same_cycle_drain_and_overlap():
+    """Joined state survives the whole response pass (an async allreduce
+    draining with its rank's join() keeps zero-fill stand-ins), and a
+    fully-submitted non-allreduce overlapping a join completes instead of
+    erroring (reference: Controller::ComputeResponseList)."""
+    run_worker_job(2, "join_race_worker.py", extra_env={
+        "HVD_CACHE_CAPACITY": "0",
+        "HVD_CYCLE_TIME_MS": "50",
+    })
+
+
+def test_cached_non_allreduce_overlapping_join_fails_fast():
+    """A steady-state cached broadcast whose peer joined must surface the
+    only-allreduce-may-overlap-join error via bit eviction + repost, not
+    hang the bit AND forever."""
+    run_worker_job(2, "cache_join_worker.py")
+
+
 @pytest.mark.parametrize("np_", [2, 3])
 def test_join_zero_fill(np_):
     """Join parity (reference HorovodJoinOp): ranks run different step

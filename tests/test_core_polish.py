@@ -65,6 +65,30 @@ def test_stall_check_disabled():
     assert "potential stall" not in out, out
 
 
+def test_stall_shutdown_fires_even_with_warnings_disabled():
+    """HVD_STALL_SHUTDOWN_TIME_SECONDS aborts a stalled job with
+    HorovodInternalError, and silencing warnings with
+    HVD_STALL_CHECK_TIME_SECONDS=0 does NOT disable the explicitly
+    configured shutdown threshold (ADVICE r2 #3)."""
+    codes, out = _run_job(2, "stall_shutdown_worker.py",
+                          extra_env={"HVD_STALL_CHECK_TIME_SECONDS": "0",
+                                     "HVD_STALL_SHUTDOWN_TIME_SECONDS": "1"},
+                          timeout=60)
+    assert codes == [0, 0], out
+    assert "HorovodInternalError as expected" in out, out
+    assert "potential stall" not in out, out  # warnings stayed silenced
+
+
+def test_cache_capacity_mismatch_reconciled():
+    """Per-rank HVD_CACHE_CAPACITY disagreement is reconciled during the
+    mesh handshake (rank 0 authoritative) instead of silently
+    desynchronizing replica bit positions once eviction starts
+    (ADVICE r2 #5)."""
+    codes, out = _run_job(2, "cache_mismatch_worker.py", timeout=60)
+    assert codes == [0, 0], out
+    assert "HVD_CACHE_CAPACITY mismatch" in out, out
+
+
 def test_single_rank_shutdown_does_not_hang():
     codes, out = _run_job(2, "early_shutdown_worker.py",
                           extra_env={"HVD_SHUTDOWN_TIMEOUT": "2"},

@@ -1,0 +1,41 @@
+"""Worker: a steady-state CACHED non-allreduce overlapping a join must fail
+fast, not hang. Once a collective rides the response-cache bit path, a rank
+calling join() never reports its bit; the coordinator must evict the bit so
+the survivor reposts through negotiation and receives the
+only-allreduce-may-overlap-join error (instead of the bit AND silently never
+completing — which the stall inspector cannot see because it only watches
+the negotiation table)."""
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+assert s == 2, "worker is written for 2 ranks"
+
+# Warm the cache: two steady-state broadcasts of the same named tensor.
+for _ in range(2):
+    out = hvd.broadcast(np.full((4,), 9.0 if r == 1 else 0.0, np.float32),
+                        root_rank=1, name="cj.b")
+    assert np.allclose(out, 9.0), out
+hits, misses, entries = hvd.cache_stats()
+assert hits >= 1, (hits, misses)  # second round rode the bit path
+
+if r == 0:
+    last = hvd.join()
+    assert last == 1, last
+else:
+    time.sleep(0.5)  # rank 0's join is registered before our bit report
+    try:
+        hvd.broadcast(np.full((4,), 9.0, np.float32), root_rank=1,
+                      name="cj.b")
+        raise SystemExit("cached broadcast overlapping join did not fail")
+    except RuntimeError as e:
+        assert "only allreduce may overlap join" in str(e), e
+    last = hvd.join()
+    assert last == 1, last
+
+hvd.shutdown()
+print(f"rank {r}: cache join PASS", flush=True)

@@ -1,0 +1,32 @@
+"""Worker: per-rank HVD_CACHE_CAPACITY disagreement must not desynchronize
+the response-cache replicas. Cache bit positions are implicit in insert and
+eviction order, so mismatched capacities would make the same hit bit expand
+to different tensors on different ranks once eviction starts. Rank 0's value
+is broadcast during the mesh handshake and adopted everywhere (reference
+analog: controller-coordinated cache bit assignment in response_cache.cc)."""
+import os
+
+r = int(os.environ["HVD_RANK"])
+# Deliberately disagree: rank 0 (authoritative) tiny, others large.
+os.environ["HVD_CACHE_CAPACITY"] = "2" if r == 0 else "64"
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+hvd.init()
+s = hvd.size()
+
+# Three distinct steady-state tensors against an effective capacity of 2:
+# every rank must evict in lockstep or values diverge / the job deadlocks.
+for step in range(6):
+    for t in range(3):
+        out = hvd.allreduce(np.full((4,), float(r + 1 + t), np.float32),
+                            op=hvd.Sum, name=f"mm.{t}")
+        expect = sum(q + 1 + t for q in range(s))
+        assert np.allclose(out, expect), (step, t, out[0], expect)
+
+hits, misses, entries = hvd.cache_stats()
+assert entries <= 2, entries  # coordinator's capacity was adopted
+hvd.shutdown()
+print(f"rank {r}: cache mismatch PASS entries={entries}", flush=True)

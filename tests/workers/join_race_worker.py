@@ -1,0 +1,64 @@
+"""Worker: join negotiation edge cases (reference: HorovodJoinOp +
+Controller::ComputeResponseList, which keeps joined state live for the whole
+response pass).
+
+Case 1 — same-RequestList drain: the last survivor's async allreduce and its
+join() land in ONE negotiation cycle. The join key predates the allreduce key
+in arrival order, so the coordinator examines it first; joined state must
+survive the rest of the pass or the allreduce loses its zero-fill stand-ins
+and stalls forever.
+
+Case 2 — fully-submitted non-allreduce overlapping a join: a broadcast every
+member has already submitted needs no stand-ins and must complete normally
+even while some ranks sit in join(); only an INCOMPLETE non-allreduce whose
+missing members have joined is a usage error.
+
+Run with HVD_CACHE_CAPACITY=0 (steady-state cache hits would bypass the
+negotiation table) and a long cycle so back-to-back enqueues share a cycle.
+"""
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.ops import collective_ops as ops
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+assert s == 2, "worker is written for 2 ranks"
+
+# --- Case 1: allreduce + join in the same RequestList on the last survivor.
+if r == 0:
+    out = hvd.allreduce(np.full((4,), 1.0, np.float32), op=hvd.Sum,
+                        name="race.g")
+    assert np.allclose(out, 3.0), out  # step 1: both ranks active
+    last = hvd.join()  # join key now sits in arrival order, pending rank 1
+else:
+    out = hvd.allreduce(np.full((4,), 2.0, np.float32), op=hvd.Sum,
+                        name="race.g")
+    assert np.allclose(out, 3.0), out
+    time.sleep(0.5)  # let rank 0's join arrive cycles before our drain
+    h = ops.allreduce_async(np.full((4,), 5.0, np.float32), op=hvd.Sum,
+                            name="race.g")
+    last = hvd.join()  # drains into the same cycle as the allreduce above
+    out2 = ops.synchronize(h)
+    # Rank 0 already joined: its contribution is a zero-filled stand-in.
+    assert np.allclose(out2, 5.0), out2
+assert last == 1, last  # rank 1 joins last
+
+# --- Case 2: fully-submitted broadcast while rank 0 waits in join().
+if r == 0:
+    h = ops.broadcast_async(np.zeros((3,), np.float32), root_rank=1,
+                            name="race.b")
+    last = hvd.join()
+    out = ops.synchronize(h)
+else:
+    time.sleep(0.5)  # rank 0's broadcast AND join are already pending
+    out = hvd.broadcast(np.full((3,), 7.0, np.float32), root_rank=1,
+                        name="race.b")
+    last = hvd.join()
+assert np.allclose(out, 7.0), out
+assert last == 1, last
+
+hvd.shutdown()
+print(f"rank {r}: join race PASS", flush=True)

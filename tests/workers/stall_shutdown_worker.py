@@ -1,0 +1,29 @@
+"""Worker: a stalled collective past HVD_STALL_SHUTDOWN_TIME_SECONDS must
+abort the whole job with HorovodInternalError instead of hanging — even when
+stall WARNINGS are disabled (HVD_STALL_CHECK_TIME_SECONDS=0), the explicitly
+configured shutdown threshold still fires (reference: stall-check shutdown
+semantics in horovod docs/troubleshooting)."""
+import os
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.exceptions import HorovodInternalError
+
+hvd.init()
+r = hvd.rank()
+
+if r == 1:
+    # Never submit the collective: rank 0's request ages past the shutdown
+    # threshold on the coordinator.
+    time.sleep(6.0)
+    print(f"rank {r}: slept through the stall shutdown", flush=True)
+    os._exit(0)
+
+try:
+    hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="stall.shutdown")
+    raise SystemExit(f"rank {r}: allreduce unexpectedly succeeded")
+except HorovodInternalError:
+    print(f"rank {r}: stall shutdown raised HorovodInternalError as expected",
+          flush=True)
