@@ -64,26 +64,25 @@ from .process_sets import (  # noqa: F401
 )
 
 def _maybe_init_jax_mesh():
-    """Join the job-wide jax.distributed mesh when tpurun provisioned one.
-
-    Gated so non-JAX users (torch/TF workers) never pay a jax import: we
-    initialize only when the launcher exported HVD_JAX_COORD_ADDR AND this
-    process already imported jax (or forced via HVD_JAX_DISTRIBUTED=1).
-    Elastic jobs skip it (see horovod_tpu/jax/distributed.py docstring).
-    """
+    """Join the job-wide jax.distributed mesh when the launcher provisioned
+    one — static jobs (rank 0 hosts the coordination service) AND elastic
+    jobs (the driver hosts a per-epoch service; workers join as recoverable
+    clients — see horovod_tpu/jax/distributed.py). Gated so non-JAX users
+    (torch/TF workers) never pay a jax import."""
     import os as _os
     import sys as _sys
 
+    # Gate BEFORE importing .jax: the subpackage __init__ imports jax and
+    # optax at module level, which a torch/TF worker must never pay (and
+    # may not even have installed).
     gate = _os.environ.get("HVD_JAX_DISTRIBUTED")
     if gate == "0" or not _os.environ.get("HVD_JAX_COORD_ADDR"):
-        return
-    if _os.environ.get("HVD_ELASTIC") == "1" and gate != "1":
         return
     if "jax" not in _sys.modules and gate != "1":
         return
     from .jax import distributed as _jd
 
-    _jd.initialize_from_env()
+    _jd.maybe_initialize_from_env()
 
 
 def init():

@@ -52,6 +52,12 @@ class State:
     def on_hosts_updated(self):
         self._host_messages_pending = True
 
+    def prepare_reset(self):
+        """Called BEFORE re-rendezvous tears the backend down. Framework
+        states that hold device memory (JaxState) move it to host here —
+        after the reset every live device array is dead (the PJRT backend
+        is destroyed per epoch, like the reference's NCCL communicators)."""
+
     def commit(self):
         self.save()
         self.check_host_updates()
@@ -114,17 +120,41 @@ class JaxState(ObjectState):
     pulled to host numpy before the pickle broadcast (device Arrays don't
     pickle portably) and re-placed on the default device afterwards.
     (Reference analog: `TensorFlowKerasState` / `TorchState` — framework
-    states that know how to move tensors.)"""
+    states that know how to move tensors.)
+
+    Committed state lives on HOST: every elastic re-rendezvous destroys the
+    PJRT backend (jax/distributed.py teardown — the NCCL-communicator-
+    rebuild analog), killing all live device arrays. `save()` therefore
+    copies leaves to numpy, and `prepare_reset()` hostifies the working
+    attrs so a membership change (no rollback) survives the teardown too.
+    """
+
+    @staticmethod
+    def _to_host(tree):
+        """Device leaves → host numpy; everything else → deep copy (a bare
+        pass-through would alias the live state, so later in-place mutation
+        would silently corrupt the committed snapshot)."""
+        import numpy as np
+
+        import jax
+
+        return jax.tree.map(
+            lambda x: np.asarray(x) if isinstance(x, jax.Array)
+            else copy.deepcopy(x),
+            tree)
+
+    def save(self):
+        self._saved = self._to_host(self._attrs)
+
+    def prepare_reset(self):
+        self._attrs = self._to_host(self._attrs)
 
     def sync(self):
         import numpy as np
 
         import jax
 
-        def to_host(x):
-            return np.asarray(x) if isinstance(x, jax.Array) else x
-
-        host = jax.tree.map(to_host, self._attrs)
+        host = self._to_host(self._attrs)
         synced = _core.broadcast_object(host, root_rank=0,
                                         name="elastic.jax_state")
         self._attrs = jax.tree.map(
@@ -149,6 +179,7 @@ def run_fn(func, reset):
         try:
             while True:
                 if reset_required:
+                    state.prepare_reset()
                     reset()
                     state.on_reset()
                     reset_required = False

@@ -59,6 +59,13 @@ class ElasticDriver:
         self._success_seen = False
         self._wind_down_failed = False
         self.ssh_port = None
+        # Per-epoch jax.distributed coordination services (driver-hosted so
+        # a worker death can never take the service down — see
+        # horovod_tpu/jax/distributed.py). Old epochs' services are kept
+        # until stop(): shutting one down while its clients re-rendezvous
+        # risks blocking on their disconnect.
+        self._jax_services = []
+        self._jax_disabled = os.environ.get("HVD_JAX_DISTRIBUTED") == "0"
 
     # -- lifecycle --------------------------------------------------------
 
@@ -161,11 +168,12 @@ class ElasticDriver:
             ctrl_host = rank0_host
             port = random.randint(23000, 43000)
         ctrl = f"{ctrl_host}:{port}"
+        jax_coord = self._serve_jax_coordination(len(active))
         for w, s in zip(ordered, slots):
             a = {"rank": s.rank, "size": s.size,
                  "local_rank": s.local_rank, "local_size": s.local_size,
                  "cross_rank": s.cross_rank, "cross_size": s.cross_size,
-                 "controller": ctrl}
+                 "controller": ctrl, "jax_coord": jax_coord}
             self.rdv.put(f"/assign-{self.epoch}/{w.id}",
                          json.dumps(a).encode())
         for w in extra:
@@ -174,6 +182,40 @@ class ElasticDriver:
         self.rdv.put("/ctl/epoch", str(self.epoch).encode())
         self._log(f"epoch {self.epoch}: {len(active)} active "
                   f"({[w.id for w in active]}), ctrl={ctrl}")
+
+    def _serve_jax_coordination(self, np_):
+        """Host this epoch's jax.distributed coordination service in the
+        driver. Returns its address for the assignment, or None (single
+        worker, jax unavailable, or HVD_JAX_DISTRIBUTED=0). The port is
+        driver-local, so it is genuinely probeable — no remote guessing."""
+        if self._jax_disabled or np_ < 2:
+            return None
+        try:
+            from ...jax import distributed as jd
+        except Exception:
+            return None
+        try:
+            port = find_free_port()
+            svc = jd.serve_coordination_service(port, np_)
+        except Exception as e:
+            self._log(f"jax coordination service unavailable: {e}")
+            return None
+        # Retain only the PREVIOUS epoch's service (its clients may still
+        # be disconnecting); anything older is shut down in the background
+        # so churn-heavy jobs don't accumulate threads and ports.
+        import threading
+
+        while len(self._jax_services) > 1:
+            old = self._jax_services.pop(0)
+            threading.Thread(target=lambda s=old: _safe_svc_shutdown(s),
+                             daemon=True).start()
+        self._jax_services.append(svc)
+        host = "127.0.0.1" if all(
+            is_local(w.hostname) for w in self.workers.values()
+            if w.alive) else _my_addr()
+        addr = f"{host}:{port}"
+        self._log(f"epoch {self.epoch}: jax coordination on {addr}")
+        return addr
 
     # -- main loop --------------------------------------------------------
 
@@ -267,6 +309,19 @@ class ElasticDriver:
             if w.alive:
                 util.terminate(w.proc)
         self.rdv.stop()
+        for svc in self._jax_services:
+            try:
+                svc.shutdown()
+            except Exception:
+                pass
+        self._jax_services = []
+
+
+def _safe_svc_shutdown(svc):
+    try:
+        svc.shutdown()
+    except Exception:
+        pass
 
 
 def _my_addr():

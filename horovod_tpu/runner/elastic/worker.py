@@ -60,6 +60,15 @@ def apply_assignment(a):
     os.environ["HVD_CROSS_RANK"] = str(a["cross_rank"])
     os.environ["HVD_CROSS_SIZE"] = str(a["cross_size"])
     os.environ["HVD_CONTROLLER_ADDR"] = a["controller"]
+    # The driver hosts a jax.distributed coordination service per epoch;
+    # workers join it as recoverable clients (jax/distributed.py). A
+    # single-worker epoch publishes no address — clear any stale one.
+    if a.get("jax_coord"):
+        os.environ["HVD_JAX_COORD_ADDR"] = a["jax_coord"]
+        os.environ["HVD_JAX_COORD_MODE"] = "client"
+    else:
+        os.environ.pop("HVD_JAX_COORD_ADDR", None)
+        os.environ.pop("HVD_JAX_COORD_MODE", None)
 
 
 def rendezvous_init():
@@ -81,11 +90,22 @@ def rendezvous_init():
 
 def rendezvous_reset():
     """Re-rendezvous after a failure/membership change: shutdown the core,
-    wait for a NEW epoch, re-init with its assignment."""
+    tear down the per-epoch jax mesh (PJRT client + backends — SURVEY.md §7
+    hard part (c); reference: ncclCommAbort + communicator rebuild), wait
+    for a NEW epoch, re-init both planes with its assignment."""
+    import sys
+
     from ...basics import basics
 
     if basics.is_initialized():
         basics.shutdown()
+    if "jax" in sys.modules:
+        # Tear down even when no mesh was live this epoch: a size-1 epoch
+        # still creates a local backend that would block the next epoch's
+        # mesh formation (initialize requires uninitialized backends).
+        from ...jax import distributed as _jd
+
+        _jd.teardown()
     epoch = _wait_epoch_at_least(notification_manager.epoch + 1)
     a = fetch_assignment(epoch)
     if a == "exit":
@@ -93,6 +113,11 @@ def rendezvous_reset():
     apply_assignment(a)
     notification_manager.set_epoch(epoch)
     basics.init()
+    # Same gate as hvd.init(): never import the jax subpackage (and its
+    # jax/optax module-level dependencies) into non-JAX workers.
+    import horovod_tpu
+
+    horovod_tpu._maybe_init_jax_mesh()
     return epoch
 
 

@@ -1,0 +1,104 @@
+"""Elastic JAX worker: every epoch trains IN-JIT over a global device mesh
+whose size tracks membership (VERDICT r2 #1 — elastic × ICI composition;
+reference analog: nccl_operations.cc communicator abort/rebuild per elastic
+reset).
+
+Each process pins 2 virtual CPU devices (the fake-pod convention), so a
+size-S epoch must expose a 2*S-device global mesh; an in-jit psum of ones
+over that mesh must equal 2*S. Each iteration also runs a core-bridged
+allreduce first — the fast failure detector (a dead peer breaks the TCP
+plane immediately, long before an in-mesh collective would time out).
+
+Env knobs: TEST_ITERS, TEST_LOG, TEST_SLEEP, TEST_FAIL_SLOT, TEST_MARKER
+(same contract as elastic_train_worker.py).
+"""
+
+import functools
+import os
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+from horovod_tpu.jax import distributed as jd
+
+jd.force_cpu_platform(2)
+hvd.init()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import shard_map  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+ITERS = int(os.environ.get("TEST_ITERS", "8"))
+SLEEP = float(os.environ.get("TEST_SLEEP", "0.1"))
+FAIL_SLOT = os.environ.get("TEST_FAIL_SLOT")
+MARKER = os.environ.get("TEST_MARKER", "")
+WID = os.environ.get("HVD_WORKER_ID", "?")
+
+state = elastic.JaxState(iteration=0, w=jnp.zeros(4, jnp.float32),
+                         max_ndev=0)
+
+
+def _should_die(it):
+    if FAIL_SLOT is None or not MARKER:
+        return False
+    if os.path.exists(MARKER):
+        return False
+    return it == 3 and WID.startswith(f"localhost-{FAIL_SLOT}-")
+
+
+def mesh_psum_step(w):
+    """One in-jit step over the CURRENT global mesh: psum of ones across
+    every device of every process in this epoch. The input is created
+    inside the jit (a process-local host array is not addressable on a
+    multi-process mesh) and only this process's shard is fetched."""
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), ("data",))
+
+    @jax.jit
+    @functools.partial(shard_map, mesh=mesh, in_specs=(), out_specs=P(),
+                       check_vma=False)
+    def f():
+        return jax.lax.psum(jnp.ones(4, jnp.float32), "data")
+
+    y = f()
+    got = float(np.asarray(y.addressable_data(0)).ravel()[0])
+    w = jnp.asarray(w) + got / len(devs)
+    return w, got, len(devs)
+
+
+@elastic.run
+def train(state):
+    while state.iteration < ITERS:
+        if _should_die(state.iteration):
+            with open(MARKER, "w") as f:
+                f.write(WID)
+            os._exit(1)
+        # Core-bridged op first: fast failure detection via the TCP plane.
+        hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                      name=f"hb.{state.iteration}")
+        expect_ndev = 2 * hvd.size()
+        state.w, got, ndev = mesh_psum_step(state.w)
+        assert ndev == expect_ndev, (ndev, expect_ndev)
+        assert got == expect_ndev, (got, expect_ndev)
+        state.max_ndev = max(state.max_ndev, ndev)
+        state.iteration += 1
+        state.commit()
+        # Progress beacon for tests that trigger membership changes only
+        # after real in-mesh training happened at the current size.
+        pf = os.environ.get("TEST_PROGRESS")
+        if pf and hvd.rank() == 0:
+            with open(pf, "a") as f:
+                f.write(f"{state.iteration} {hvd.size()}\n")
+        time.sleep(SLEEP)
+    return hvd.rank(), hvd.size(), 2 * hvd.size()
+
+
+rank, size, ndev = train(state)
+if os.environ.get("TEST_LOG"):
+    with open(os.environ["TEST_LOG"], "a") as f:
+        f.write(f"final rank={rank} size={size} iter={state.iteration} "
+                f"ndev={ndev} maxndev={state.max_ndev}\n")
+hvd.shutdown()
