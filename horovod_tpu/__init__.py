@@ -124,6 +124,7 @@ mpi_threads_supported = _basics.mpi_threads_supported
 nccl_built = _basics.nccl_built
 cache_stats = _basics.cache_stats
 autotune_state = _basics.autotune_state
+peer_tx_bytes = _basics.peer_tx_bytes
 
 
 def mpi_built():

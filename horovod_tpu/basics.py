@@ -115,6 +115,8 @@ _lib.hvd_cache_stats.restype = c_int
 _lib.hvd_cache_stats.argtypes = [P_int64, P_int64, P_int64]
 _lib.hvd_autotune_state.restype = c_int
 _lib.hvd_autotune_state.argtypes = [P_int64, ctypes.POINTER(c_double)]
+_lib.hvd_peer_tx_bytes.restype = c_int64
+_lib.hvd_peer_tx_bytes.argtypes = [ctypes.c_int]
 
 
 def last_error():
@@ -170,6 +172,15 @@ class HorovodBasics:
         if rc < 0:
             raise ValueError("horovod_tpu has not been initialized")
         return hits.value, misses.value, entries.value
+
+    def peer_tx_bytes(self, rank):
+        """Data-plane payload bytes this process has sent to `rank` since
+        init. Lets callers observe wire traffic per peer — e.g. that
+        HVD_HIERARCHICAL_ALLREDUCE cuts cross-host bytes ~1/local_size."""
+        v = _lib.hvd_peer_tx_bytes(int(rank))
+        if v < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        return v
 
     def autotune_state(self):
         """(status, fusion_threshold_bytes, cycle_time_ms) where status is

@@ -85,7 +85,10 @@ void DataPlane::FullDuplex(Socket& to, const void* sbuf, size_t sn,
           ssize_t k = ::send(to.fd(), sp + sent, sn - sent, MSG_NOSIGNAL);
           if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
             throw std::runtime_error("data-plane send failed");
-          if (k > 0) sent += (size_t)k;
+          if (k > 0) {
+            sent += (size_t)k;
+            to.note_tx((size_t)k);
+          }
         }
         if ((fds[i].revents & POLLIN) && recvd < rn) {
           ssize_t k = ::recv(from.fd(), rp + recvd, rn - recvd, 0);
@@ -135,6 +138,47 @@ void DataPlane::RingAllreduce(void* buf, int64_t nelem, DataType dtype,
     FullDuplex(next, p + off[sc] * esz, (size_t)lens[sc] * esz, prev,
                p + off[rc] * esz, (size_t)lens[rc] * esz);
   }
+}
+
+void DataPlane::HierarchicalAllreduce(void* buf, int64_t nelem,
+                                      DataType dtype, ReduceOp op,
+                                      const std::vector<int32_t>& members,
+                                      int local_size) {
+  int m = (int)members.size();
+  if (m <= 1 || nelem == 0) return;
+  int groups = local_size > 0 ? m / local_size : 0;
+  if (local_size <= 1 || groups <= 1 || m % local_size != 0 ||
+      nelem < local_size) {
+    RingAllreduce(buf, nelem, dtype, op, members);
+    return;
+  }
+  int my = IndexOf(members, rank_);
+  int host = my / local_size;
+  int lr = my % local_size;
+  std::vector<int32_t> local(members.begin() + host * local_size,
+                             members.begin() + (host + 1) * local_size);
+  std::vector<int32_t> cross;
+  cross.reserve(groups);
+  for (int h = 0; h < groups; h++)
+    cross.push_back(members[h * local_size + lr]);
+
+  size_t esz = DataTypeSize(dtype);
+  auto lens = SplitChunks(nelem, local_size);
+  auto off = Offsets(lens);
+
+  // 1) Local reduce-scatter: this rank finishes owning the local reduction
+  //    of chunk lr (buf is scratch afterwards — rebuilt in phase 3).
+  std::vector<uint8_t> chunk((size_t)lens[lr] * esz);
+  RingReduceScatter(buf, chunk.data(), lens, dtype, op, local);
+  // 2) Cross-plane allreduce of the owned shard: 1/local_size of the data
+  //    rides the slow plane.
+  RingAllreduce(chunk.data(), lens[lr], dtype, op, cross);
+  // 3) Local allgather of the finished chunks.
+  uint8_t* p = (uint8_t*)buf;
+  memcpy(p + off[lr] * esz, chunk.data(), chunk.size());
+  std::vector<int64_t> bytes(local_size);
+  for (int i = 0; i < local_size; i++) bytes[i] = lens[i] * (int64_t)esz;
+  RingAllgatherv(p + off[lr] * esz, p, bytes, local);
 }
 
 void DataPlane::RingAllgatherv(const void* my_data, void* out,

@@ -43,6 +43,18 @@ class DataPlane {
   void RingAllreduce(void* buf, int64_t nelem, DataType dtype, ReduceOp op,
                      const std::vector<int32_t>& members);
 
+  // Hierarchical allreduce (reference: NCCLHierarchicalAllreduce in
+  // horovod/common/ops/nccl_operations.cc): local reduce-scatter inside each
+  // host's contiguous member block, cross-plane ring allreduce of the owned
+  // 1/local_size shard between same-local-rank peers, then local allgather.
+  // Each rank's cross-plane wire bytes drop to ~1/local_size of the flat
+  // ring's. Requires host-major members with m % local_size == 0; falls back
+  // to the flat ring otherwise.
+  void HierarchicalAllreduce(void* buf, int64_t nelem, DataType dtype,
+                             ReduceOp op,
+                             const std::vector<int32_t>& members,
+                             int local_size);
+
   // Ring allgatherv: each member i contributes bytes_per_member[i] bytes; the
   // concatenation (in member order) lands in out on every member. my_data is
   // this rank's contribution.

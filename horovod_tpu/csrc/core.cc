@@ -78,6 +78,7 @@ struct Global {
   std::atomic<bool> dead{false};  // background thread exited
   int rank = 0, size = 1, local_rank = 0, local_size = 1;
   int cross_rank = 0, cross_size = 1;
+  bool hierarchical = false;  // HVD_HIERARCHICAL_ALLREDUCE
 
   TensorQueue queue;
   DataPlane data;
@@ -178,6 +179,20 @@ void FailEntries(std::vector<TensorTableEntry>& entries,
   for (auto& e : entries) CompleteHandle(e.handle, Status::Error(why));
 }
 
+bool UseHierarchical(const std::vector<int32_t>& members) {
+  // HVD_HIERARCHICAL_ALLREDUCE composes a local reduce inside each host's
+  // contiguous rank block with a cross-host ring (reference:
+  // NCCLHierarchicalAllreduce + HOROVOD_HIERARCHICAL_ALLREDUCE). Only the
+  // GLOBAL process set is host-major by construction (the launcher assigns
+  // ranks host-major); arbitrary process sets fall back to the flat ring.
+  // Uniform-topology requirement: every rank must take the same branch or
+  // the ring sub-groups deadlock (a truncated last host gives its ranks a
+  // smaller local_size than the rest — fall back to the flat ring then).
+  return g->hierarchical && g->local_size > 1 && g->cross_size > 1 &&
+         (int64_t)g->local_size * g->cross_size == g->size &&
+         (int)members.size() == g->size;
+}
+
 double EffectivePostscale(const Response& resp, int m) {
   double post = resp.postscale;
   if (resp.red_op == ReduceOp::kAverage) post /= (double)m;
@@ -202,6 +217,9 @@ void ExecAllreduce(const Response& resp,
     int64_t t0 = NowUs();
     if (resp.red_op == ReduceOp::kAdasum)
       AdasumAllreduce(g->data, e.output, n, resp.dtype, members);
+    else if (UseHierarchical(members))
+      g->data.HierarchicalAllreduce(e.output, n, resp.dtype, ring_op,
+                                    members, g->local_size);
     else
       g->data.RingAllreduce(e.output, n, resp.dtype, ring_op, members);
     g->timeline.Record(e.req.name, "TCP_ALLREDUCE", t0, NowUs());
@@ -234,6 +252,9 @@ void ExecAllreduce(const Response& resp,
   if (resp.prescale != 1.0) ScaleBuffer(fb, total, resp.dtype, resp.prescale);
   if (resp.red_op == ReduceOp::kAdasum)
     AdasumAllreduce(g->data, fb, total, resp.dtype, members);
+  else if (UseHierarchical(members))
+    g->data.HierarchicalAllreduce(fb, total, resp.dtype, ring_op, members,
+                                  g->local_size);
   else
     g->data.RingAllreduce(fb, total, resp.dtype, ring_op, members);
   int64_t t2 = NowUs();
@@ -865,6 +886,7 @@ int hvd_init() {
     g->local_size = (int)EnvInt("HVD_LOCAL_SIZE", g->size);
     g->cross_rank = (int)EnvInt("HVD_CROSS_RANK", 0);
     g->cross_size = (int)EnvInt("HVD_CROSS_SIZE", 1);
+    g->hierarchical = EnvInt("HVD_HIERARCHICAL_ALLREDUCE", 0) != 0;
     g->fusion_threshold =
         EnvInt("HVD_FUSION_THRESHOLD", 64 * 1024 * 1024);
     g->cycle_time_ms = EnvDouble("HVD_CYCLE_TIME_MS", 1.0);
@@ -1126,6 +1148,16 @@ int hvd_cache_stats(int64_t* hits, int64_t* misses, int64_t* entries) {
   if (misses) *misses = g->cache_misses_total.load();
   if (entries) *entries = g->cache.ValidCount();
   return 0;
+}
+
+// Data-plane payload bytes this process has sent to `rank` since init.
+// Observability hook for wire-traffic assertions (e.g. hierarchical
+// allreduce cutting cross-plane bytes) and future autotune signals.
+int64_t hvd_peer_tx_bytes(int rank) {
+  if (!g || !g->initialized) return -1;
+  if (rank < 0 || rank >= g->size || rank == g->rank) return 0;
+  Socket& s = g->data.peer(rank);
+  return s.valid() ? (int64_t)s.tx_bytes() : 0;
 }
 
 int hvd_mpi_threads_supported() { return 0; }

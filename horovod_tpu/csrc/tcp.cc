@@ -23,6 +23,8 @@ Socket& Socket::operator=(Socket&& o) noexcept {
   if (this != &o) {
     Close();
     fd_ = o.fd_;
+    tx_.store(o.tx_.load(std::memory_order_relaxed),
+              std::memory_order_relaxed);
     o.fd_ = -1;
   }
   return *this;
@@ -50,6 +52,7 @@ void Socket::SendAll(const void* buf, size_t n) {
       throw_errno("send");
     }
     if (k == 0) throw std::runtime_error("send: peer closed");
+    tx_ += (uint64_t)k;
     p += k;
     n -= (size_t)k;
   }

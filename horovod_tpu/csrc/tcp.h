@@ -7,6 +7,7 @@
 
 #include <cstdint>
 #include <string>
+#include <atomic>
 #include <vector>
 
 #include "common.h"
@@ -19,7 +20,10 @@ class Socket {
   explicit Socket(int fd) : fd_(fd) {}
   Socket(const Socket&) = delete;
   Socket& operator=(const Socket&) = delete;
-  Socket(Socket&& o) noexcept : fd_(o.fd_) { o.fd_ = -1; }
+  Socket(Socket&& o) noexcept
+      : fd_(o.fd_), tx_(o.tx_.load(std::memory_order_relaxed)) {
+    o.fd_ = -1;
+  }
   Socket& operator=(Socket&& o) noexcept;
   ~Socket() { Close(); }
 
@@ -43,8 +47,17 @@ class Socket {
 
   void SetNoDelay();
 
+  // Wire-byte accounting (payload sent on this socket). Written by the
+  // background IO thread, read by user threads (hvd_peer_tx_bytes) — so
+  // atomic, relaxed: a count, not a synchronization point. Lets tests and
+  // the autotuner observe per-peer traffic — e.g. that hierarchical
+  // allreduce really cuts cross-plane bytes by ~local_size.
+  void note_tx(size_t n) { tx_.fetch_add(n, std::memory_order_relaxed); }
+  uint64_t tx_bytes() const { return tx_.load(std::memory_order_relaxed); }
+
  private:
   int fd_;
+  std::atomic<uint64_t> tx_{0};
 };
 
 // Listening socket bound to 0.0.0.0:port (port=0 -> ephemeral).
