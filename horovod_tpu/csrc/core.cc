@@ -79,6 +79,10 @@ struct Global {
   int rank = 0, size = 1, local_rank = 0, local_size = 1;
   int cross_rank = 0, cross_size = 1;
   bool hierarchical = false;  // HVD_HIERARCHICAL_ALLREDUCE
+  // Set by the mesh handshake iff EVERY rank reported a uniform host-major
+  // topology (rank 0 validates and broadcasts) — guarantees all ranks take
+  // the same allreduce branch.
+  bool hier_ok = false;
 
   TensorQueue queue;
   DataPlane data;
@@ -185,12 +189,10 @@ bool UseHierarchical(const std::vector<int32_t>& members) {
   // NCCLHierarchicalAllreduce + HOROVOD_HIERARCHICAL_ALLREDUCE). Only the
   // GLOBAL process set is host-major by construction (the launcher assigns
   // ranks host-major); arbitrary process sets fall back to the flat ring.
-  // Uniform-topology requirement: every rank must take the same branch or
-  // the ring sub-groups deadlock (a truncated last host gives its ranks a
-  // smaller local_size than the rest — fall back to the flat ring then).
-  return g->hierarchical && g->local_size > 1 && g->cross_size > 1 &&
-         (int64_t)g->local_size * g->cross_size == g->size &&
-         (int)members.size() == g->size;
+  // hier_ok is the handshake-validated uniform-topology flag: EVERY rank
+  // must take the same branch or the ring sub-groups deadlock, and a
+  // per-rank env check cannot see other hosts' slot counts.
+  return g->hierarchical && g->hier_ok && (int)members.size() == g->size;
 }
 
 double EffectivePostscale(const Response& resp, int m) {
@@ -735,21 +737,44 @@ void EstablishMesh() {
   std::vector<std::string> hosts(g->size);
   std::vector<int> ports(g->size);
 
+  // Topology validation for hierarchical allreduce: every rank reports its
+  // (local_rank, local_size, cross_rank, cross_size); rank 0 accepts the
+  // hierarchy only if the WHOLE job is uniform host-major (rank r at local
+  // position r % L of host r / L, same L and C everywhere). A per-rank env
+  // check cannot do this — on heterogeneous host slot counts some ranks
+  // would pick the hierarchical branch and others the flat ring, a
+  // split-brain that deadlocks the data plane.
+  auto topo_ok = [&](int r, int lr, int ls, int cr, int cs) {
+    return ls == g->local_size && cs == g->cross_size &&
+           (int64_t)ls * cs == g->size && ls > 1 && cs > 1 &&
+           lr == r % ls && cr == r / ls;
+  };
+
   if (g->rank == 0) {
     g->control_listener.Listen(cport);
     g->workers.resize(g->size);
     hosts[0] = chost == "0.0.0.0" ? "127.0.0.1" : chost;
     ports[0] = g->data_listener.port();
+    bool hier_ok = topo_ok(0, g->local_rank, g->local_size, g->cross_rank,
+                           g->cross_size);
     for (int i = 1; i < g->size; i++) {
       Socket s = g->control_listener.Accept();
       auto frame = s.RecvFrame();
       Reader rd(frame.data(), frame.size());
       int r = rd.i32();
       int dport = rd.i32();
+      int lr = rd.i32(), ls = rd.i32(), cr = rd.i32(), cs = rd.i32();
+      if (!topo_ok(r, lr, ls, cr, cs)) hier_ok = false;
       hosts[r] = PeerAddr(s);
       ports[r] = dport;
       g->workers[r] = std::move(s);
     }
+    g->hier_ok = hier_ok;
+    if (g->hierarchical && !hier_ok)
+      LogF(LogLevel::kWarn,
+           "HVD_HIERARCHICAL_ALLREDUCE requested but the topology is not "
+           "uniform host-major (local_size x cross_size != size on some "
+           "rank); falling back to the flat ring");
     Writer w;
     for (int i = 0; i < g->size; i++) {
       w.str(hosts[i]);
@@ -760,12 +785,17 @@ void EstablishMesh() {
     // mismatch would silently desynchronize replicas once eviction starts
     // (the same hit bit expanding to different tensors on different ranks).
     w.i64(g->cache.capacity());
+    w.u8(g->hier_ok ? 1 : 0);
     for (int r = 1; r < g->size; r++) g->workers[r].SendFrame(w.buf);
   } else {
     g->to_coordinator = ConnectRetry(chost, cport, timeout);
     Writer w;
     w.i32(g->rank);
     w.i32(g->data_listener.port());
+    w.i32(g->local_rank);
+    w.i32(g->local_size);
+    w.i32(g->cross_rank);
+    w.i32(g->cross_size);
     g->to_coordinator.SendFrame(w.buf);
     auto frame = g->to_coordinator.RecvFrame();
     Reader rd(frame.data(), frame.size());
@@ -781,6 +811,7 @@ void EstablishMesh() {
            g->rank, (long long)g->cache.capacity(), (long long)cap);
       g->cache.Configure(cap);
     }
+    g->hier_ok = rd.u8() != 0;
   }
 
   // Full-mesh data plane.

@@ -49,13 +49,20 @@ class ElasticDriver:
         self.extra_env = dict(extra_env or {})
         self.verbose = verbose
         self.cooldown_range = cooldown_range or DEFAULT_COOLDOWN_RANGE
-        self.rdv = http_server.RendezvousServer(addr="0.0.0.0")
+        # Per-job HMAC secret: the KV store binds 0.0.0.0, so without
+        # signatures anyone on the network could PUT /ctl/epoch and resize
+        # or kill the job (reference: runner/common/util/secret.py tokens on
+        # every BasicService message). Workers receive it via the spawn env.
+        self.secret = util.make_secret_key()
+        self.rdv = http_server.RendezvousServer(secret_key=self.secret,
+                                                addr="0.0.0.0")
         self.rdv_port = self.rdv.start()
         self.epoch = -1
         self.workers = {}            # id -> _Worker
         self._host_failures = {}     # host -> [timestamps]
         self._blacklist_until = {}   # host -> ts
         self._excluded = set()       # worker ids told to exit (not successes)
+        self._reset_handled = set()  # (worker_id, epoch) reset requests seen
         self._success_seen = False
         self._wind_down_failed = False
         self.ssh_port = None
@@ -80,6 +87,7 @@ class ElasticDriver:
         env["HVD_ELASTIC"] = "1"
         rdv_host = "127.0.0.1" if is_local(hostname) else _my_addr()
         env["HVD_RENDEZVOUS_ADDR"] = f"{rdv_host}:{self.rdv_port}"
+        env["HVD_RENDEZVOUS_SECRET"] = self.secret.hex()
         env["HVD_WORKER_ID"] = wid
         # The first epoch that can possibly include this worker: wait for it
         # instead of latching onto a stale current epoch whose assignment
@@ -88,6 +96,8 @@ class ElasticDriver:
         if is_local(hostname):
             proc = util.safe_exec(self.command, env=env)
         else:
+            import subprocess
+
             from ..launch import get_remote_command
 
             class _S:  # SlotInfo stand-in for hostname only
@@ -95,12 +105,19 @@ class ElasticDriver:
 
             s = _S()
             s.hostname = hostname
+            # The HMAC secret rides stdin, never argv: the ssh command line
+            # is visible to every local user (ps) on both hosts.
             cmd = get_remote_command(s, self.command, {
                 k: v for k, v in env.items()
                 if k.startswith(("HVD_", "PYTHONPATH", "PATH"))},
-                ssh_port=self.ssh_port)
+                ssh_port=self.ssh_port,
+                stdin_env=("HVD_RENDEZVOUS_SECRET",))
             proc = util.safe_exec(["/bin/sh", "-c", cmd],
-                                  env=dict(os.environ))
+                                  env=dict(os.environ),
+                                  stdin=subprocess.PIPE)
+            proc.stdin.write(env["HVD_RENDEZVOUS_SECRET"].encode() + b"\n")
+            proc.stdin.flush()
+            proc.stdin.close()
         w = _Worker(wid, hostname, slot, proc, self.epoch + 1)
         self.workers[wid] = w
         self._log(f"spawned {wid}")
@@ -180,6 +197,9 @@ class ElasticDriver:
             self._excluded.add(w.id)
             self.rdv.put(f"/assign-{self.epoch}/{w.id}", b"exit")
         self.rdv.put("/ctl/epoch", str(self.epoch).encode())
+        # Reset requests for epochs before this one are resolved by it.
+        self._reset_handled = {(w, e) for (w, e) in self._reset_handled
+                               if e >= self.epoch}
         self._log(f"epoch {self.epoch}: {len(active)} active "
                   f"({[w.id for w in active]}), ctrl={ctrl}")
 
@@ -237,6 +257,24 @@ class ElasticDriver:
                          if not self._blacklisted(h, now)}
                 if found != desired:
                     desired = found
+                    membership_dirty = True
+
+            # Worker-pushed reset requests (reference:
+            # runner/elastic/worker.py WorkerNotificationService): a worker
+            # that hit HorovodInternalError while every process is still
+            # alive needs a NEW epoch to re-rendezvous into — without the
+            # push it would stall toward the 600 s rendezvous timeout.
+            for path, val in self.rdv.scan("/ctl/reset/").items():
+                wid = path.rsplit("/", 1)[-1]
+                self.rdv.delete(path)  # consume: keep the KV bounded
+                try:
+                    req_epoch = int(val.decode())
+                except ValueError:
+                    continue
+                key = (wid, req_epoch)
+                if req_epoch >= self.epoch and key not in self._reset_handled:
+                    self._reset_handled.add(key)
+                    self._log(f"reset requested by {wid} (epoch {req_epoch})")
                     membership_dirty = True
 
             # reap exits

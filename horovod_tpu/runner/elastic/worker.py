@@ -30,13 +30,20 @@ def _rdv_addr():
     return os.environ["HVD_RENDEZVOUS_ADDR"]
 
 
+def _rdv_secret():
+    """Per-job HMAC key (hex in the spawn env); None for legacy/unsigned."""
+    s = os.environ.get("HVD_RENDEZVOUS_SECRET")
+    return bytes.fromhex(s) if s else None
+
+
 def _worker_id():
     return os.environ["HVD_WORKER_ID"]
 
 
 def current_epoch():
     try:
-        return int(http_server.read_kv(_rdv_addr(), "ctl", "epoch"))
+        return int(http_server.read_kv(_rdv_addr(), "ctl", "epoch",
+                                       secret_key=_rdv_secret()))
     except Exception:
         return -1
 
@@ -45,11 +52,25 @@ def fetch_assignment(epoch, timeout=600.0):
     """Wait for this worker's assignment in `epoch`. Returns dict or the
     string directive "exit"."""
     raw = http_server.read_kv(_rdv_addr(), f"assign-{epoch}", _worker_id(),
-                              wait=True, timeout=timeout)
+                              secret_key=_rdv_secret(), wait=True,
+                              timeout=timeout)
     val = raw.decode()
     if val == "exit":
         return "exit"
     return json.loads(val)
+
+
+def request_reset(epoch):
+    """Push a reset request to the driver (reference:
+    WorkerNotificationService): this worker hit an internal error and needs
+    a NEW rendezvous epoch even though every process may still be alive.
+    The driver marks membership dirty and publishes one promptly instead of
+    the worker stalling toward the rendezvous timeout."""
+    try:
+        http_server.put_kv(_rdv_addr(), "ctl", f"reset/{_worker_id()}",
+                           str(epoch).encode(), secret_key=_rdv_secret())
+    except Exception:
+        pass  # best-effort: the epoch poll remains the fallback
 
 
 def apply_assignment(a):
@@ -106,6 +127,11 @@ def rendezvous_reset():
         from ...jax import distributed as _jd
 
         _jd.teardown()
+    # Tell the driver we need a new epoch NOW: if this reset came from a
+    # HorovodInternalError with every process still alive, no death will
+    # ever bump the epoch for us. (A membership-change reset already has a
+    # newer epoch pending; the driver ignores stale requests.)
+    request_reset(notification_manager.epoch)
     epoch = _wait_epoch_at_least(notification_manager.epoch + 1)
     a = fetch_assignment(epoch)
     if a == "exit":

@@ -27,11 +27,19 @@ class _KVHandler(BaseHTTPRequestHandler):
         pass
 
     def _check_sig(self, payload=b""):
+        # The signature binds METHOD + path + payload: a sniffed signed GET
+        # must not be replayable as a DELETE or empty-body PUT of the same
+        # path. (Verbatim replay of a signed PUT remains possible on a
+        # cleartext network — but workers only ever PUT /ctl/reset/*, whose
+        # replay just requests an extra epoch; /ctl/epoch is written by the
+        # driver directly, never over HTTP, so no resize/rollback PUT ever
+        # crosses the wire to capture.)
         key = self.server.secret_key
         if key is None:
             return True
         sig = self.headers.get(SIG_HEADER, "")
-        return util.check_signature(key, self.path.encode() + payload, sig)
+        return util.check_signature(
+            key, self.command.encode() + self.path.encode() + payload, sig)
 
     def do_GET(self):
         if not self._check_sig():
@@ -108,6 +116,16 @@ class RendezvousServer:
         with self._httpd.kv_lock:
             self._httpd.kv[path] = value
 
+    def scan(self, prefix):
+        """Snapshot of all (path, value) pairs under a path prefix."""
+        with self._httpd.kv_lock:
+            return {k: v for k, v in self._httpd.kv.items()
+                    if k.startswith(prefix)}
+
+    def delete(self, path):
+        with self._httpd.kv_lock:
+            self._httpd.kv.pop(path, None)
+
 
 def _request(method, url, payload=b"", secret_key=None, timeout=10.0):
     req = urllib.request.Request(url, data=payload or None, method=method)
@@ -115,7 +133,8 @@ def _request(method, url, payload=b"", secret_key=None, timeout=10.0):
         from urllib.parse import urlparse
         path = urlparse(url).path
         req.add_header(SIG_HEADER,
-                       util.sign(secret_key, path.encode() + payload))
+                       util.sign(secret_key,
+                                 method.encode() + path.encode() + payload))
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         return resp.read()
 

@@ -99,13 +99,23 @@ def _resolve_hosts(args):
     return hs
 
 
-def get_remote_command(slot, command, env, ssh_port=None):
+def get_remote_command(slot, command, env, ssh_port=None, stdin_env=()):
     """Assemble the per-slot ssh command (reference: gloo_run.py
-    `get_remote_command` — env exported inline, command exec'd on host)."""
+    `get_remote_command` — env exported inline, command exec'd on host).
+
+    Variables named in ``stdin_env`` are NOT placed on the command line
+    (argv is world-readable via ps on both hosts — secrets must never ride
+    it); the remote shell reads one line per variable from stdin instead,
+    and the spawner writes the values there (see ElasticDriver._spawn).
+    """
+    env = {k: v for k, v in env.items() if k not in stdin_env}
     exports = " ".join(f"{k}={shlex.quote(str(v))}"
                        for k, v in sorted(env.items()))
+    reads = "".join(f"read -r {k} && export {k} && "
+                    for k in sorted(stdin_env))
     inner = f"cd {shlex.quote(os.getcwd())} > /dev/null 2>&1 ; " \
-            f"env {exports} {' '.join(shlex.quote(c) for c in command)}"
+            f"{reads}env {exports} " \
+            f"{' '.join(shlex.quote(c) for c in command)}"
     port = f"-p {ssh_port} " if ssh_port else ""
     return f"ssh -o PasswordAuthentication=no -o StrictHostKeyChecking=no " \
            f"{port}{slot.hostname} {shlex.quote(inner)}"

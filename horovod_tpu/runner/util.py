@@ -38,11 +38,11 @@ def host_hash(salt=None):
     return hashlib.md5(h.encode()).hexdigest()
 
 
-def safe_exec(command, env=None, stdout=None, stderr=None):
+def safe_exec(command, env=None, stdout=None, stderr=None, stdin=None):
     """Spawn `command` in its own process group so the whole tree can be
     terminated (reference: safe_shell_exec.py)."""
     return subprocess.Popen(command, env=env, stdout=stdout, stderr=stderr,
-                            preexec_fn=os.setsid)
+                            stdin=stdin, preexec_fn=os.setsid)
 
 
 def terminate(proc, timeout=GRACEFUL_TERMINATION_TIME_S):

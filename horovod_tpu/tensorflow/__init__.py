@@ -296,14 +296,22 @@ def DistributedOptimizer(optimizer, op=Average, compression=None,
                          process_set=0, backward_passes_per_step=1,
                          name=None):
     """Wrap a Keras optimizer: apply_gradients allreduces first
-    (reference: hvd.DistributedOptimizer for tf.keras)."""
+    (reference: hvd.DistributedOptimizer for tf.keras).
+
+    ``backward_passes_per_step=N`` enables local gradient aggregation
+    (reference: tensorflow/gradient_aggregation.py
+    `LocalGradientAggregationHelper`): gradients accumulate into local
+    slot variables for N calls; every Nth call averages them, allreduces
+    ONCE, and applies — the other calls update nothing and return None.
+    Works eagerly and inside tf.function (tf.Variable counter + tf.cond).
+    """
     tf = _tf()
+    bpps = int(backward_passes_per_step)
 
     class _DistOpt(optimizer.__class__):
         _hvd_wrapped = True
 
-        def apply_gradients(self, grads_and_vars, *args, **kwargs):
-            gv = list(grads_and_vars)
+        def _hvd_communicate_apply(self, gv, *args, **kwargs):
             grads = [g for g, _ in gv]
             idx = [i for i, g in enumerate(grads) if g is not None]
             dense = [tf.convert_to_tensor(grads[i]) for i in idx]
@@ -315,6 +323,52 @@ def DistributedOptimizer(optimizer, op=Average, compression=None,
                 grads[i] = outs[j]
             out = list(zip(grads, [v for _, v in gv]))
             return super().apply_gradients(out, *args, **kwargs)
+
+        def _hvd_ensure_agg(self, vars_):
+            if getattr(self, "_hvd_agg", None) is None:
+                # init_scope lifts creation out of any tf.function trace —
+                # the slots are ordinary eager variables created once.
+                with tf.init_scope():
+                    self._hvd_agg = [
+                        tf.Variable(tf.zeros(v.shape, dtype=v.dtype),
+                                    trainable=False) for v in vars_]
+                    self._hvd_count = tf.Variable(
+                        0, dtype=tf.int64, trainable=False)
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            gv = list(grads_and_vars)
+            if bpps <= 1:
+                return self._hvd_communicate_apply(gv, *args, **kwargs)
+            vars_ = [v for _, v in gv]
+            self._hvd_ensure_agg(vars_)
+            for slot, (g, _) in zip(self._hvd_agg, gv):
+                if g is not None:
+                    slot.assign_add(tf.convert_to_tensor(g))
+            self._hvd_count.assign_add(1)
+
+            def _flush():
+                # A variable with g=None (frozen/unused — the pattern is
+                # static for a given model) stays None at flush: feeding a
+                # real 0.0 gradient instead would still move it under
+                # momentum/weight-decay optimizers, diverging from the
+                # bpps=1 path (reference: LocalGradientAggregationHelper
+                # applies only accumulated gradients).
+                scaled = [None if g is None else slot / float(bpps)
+                          for slot, (g, _) in zip(self._hvd_agg, gv)]
+                self._hvd_communicate_apply(
+                    list(zip(scaled, vars_)), *args, **kwargs)
+                for slot, (g, _) in zip(self._hvd_agg, gv):
+                    if g is not None:
+                        slot.assign(tf.zeros_like(slot))
+                return tf.constant(0)
+
+            if tf.executing_eagerly():
+                if int(self._hvd_count.numpy()) % bpps == 0:
+                    _flush()
+                return None
+            return tf.cond(
+                tf.equal(self._hvd_count % bpps, 0), _flush,
+                lambda: tf.constant(0))
 
     obj = _DistOpt.from_config(optimizer.get_config())
     return obj

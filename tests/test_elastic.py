@@ -155,6 +155,55 @@ def test_elastic_mesh_scale_down(tmp_path):
     assert all("iter=16" in line for line in finals), log
 
 
+def test_elastic_internal_error_reset_push(tmp_path):
+    """A worker raises HorovodInternalError while every process is ALIVE
+    (transient failure): its reset-request PUT makes the driver publish a
+    new epoch promptly, so the job recovers in seconds instead of stalling
+    toward the 600 s rendezvous timeout (r1 advisor finding: the reference
+    pushes via WorkerNotificationService)."""
+    marker = tmp_path / "raised.marker"
+    rc, log, out = _run_elastic(
+        tmp_path, "localhost:2",
+        {"TEST_ITERS": "10", "TEST_SLEEP": "0.1",
+         "TEST_INTERNAL_SLOT": "1", "TEST_MARKER": str(marker),
+         "HVD_SHUTDOWN_TIMEOUT": "2"},
+        min_np=2, max_np=2, timeout=90)
+    assert rc == 0, f"job failed rc={rc}\n{out}"
+    assert marker.exists(), "internal error was never injected"
+    assert "reset requested by" in out, out
+    finals = [line for line in log.splitlines() if line.startswith("final")]
+    assert len(finals) == 2, f"expected 2 finishers:\n{log}\n{out}"
+    assert all("iter=10" in line for line in finals), log
+
+
+def test_elastic_kv_rejects_unsigned_requests():
+    """The elastic KV store binds 0.0.0.0 with a per-job HMAC secret:
+    unsigned PUTs (e.g. a hostile /ctl/epoch resize) are rejected with 403,
+    signed ones accepted (r1 advisor finding)."""
+    import urllib.error
+    import urllib.request
+
+    from horovod_tpu.runner import http_server
+    from horovod_tpu.runner.elastic.discovery import FixedHosts
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+
+    d = ElasticDriver(["true"], FixedHosts({}), 1, 1)
+    try:
+        assert d.secret and d.rdv.secret_key == d.secret
+        url = f"http://127.0.0.1:{d.rdv_port}/ctl/epoch"
+        req = urllib.request.Request(url, data=b"999", method="PUT")
+        try:
+            urllib.request.urlopen(req, timeout=5)
+            raise AssertionError("unsigned PUT was accepted")
+        except urllib.error.HTTPError as e:
+            assert e.code == 403, e.code
+        http_server.put_kv(f"127.0.0.1:{d.rdv_port}", "ctl", "x", b"1",
+                           secret_key=d.secret)
+        assert d.rdv.get("/ctl/x") == b"1"
+    finally:
+        d.stop()
+
+
 def test_elastic_scale_down(tmp_path):
     """Discovery removes a slot mid-run: the excess worker is told to exit
     via the KV directive, the rest re-rendezvous at size=2 and finish."""

@@ -25,6 +25,7 @@ hvd.init()
 ITERS = int(os.environ.get("TEST_ITERS", "10"))
 SLEEP = float(os.environ.get("TEST_SLEEP", "0.1"))
 FAIL_SLOT = os.environ.get("TEST_FAIL_SLOT")
+INTERNAL_SLOT = os.environ.get("TEST_INTERNAL_SLOT")
 MARKER = os.environ.get("TEST_MARKER", "")
 WID = os.environ.get("HVD_WORKER_ID", "?")
 
@@ -39,6 +40,16 @@ def _should_die(it):
     return it == 3 and WID.startswith(f"localhost-{FAIL_SLOT}-")
 
 
+def _should_raise_internal(it):
+    """Transient failure with every process alive (e.g. a flaky link):
+    needs the worker→driver reset push to re-rendezvous promptly."""
+    if INTERNAL_SLOT is None or not MARKER:
+        return False
+    if os.path.exists(MARKER):
+        return False
+    return it == 3 and WID.startswith(f"localhost-{INTERNAL_SLOT}-")
+
+
 @elastic.run
 def train(state):
     while state.iteration < ITERS:
@@ -46,6 +57,10 @@ def train(state):
             with open(MARKER, "w") as f:
                 f.write(WID)
             os._exit(1)
+        if _should_raise_internal(state.iteration):
+            with open(MARKER, "w") as f:
+                f.write(WID)
+            raise hvd.HorovodInternalError("injected transient failure")
         out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
                             name=f"it.{state.iteration}")
         state.total = state.total + out

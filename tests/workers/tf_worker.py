@@ -79,6 +79,47 @@ for i, v in enumerate(fit_model.variables):
     assert np.allclose(v.numpy(), ref.numpy(), atol=1e-6), \
         f"fit var {i} diverged"
 
+# backward_passes_per_step: local aggregation, one allreduce every Nth
+# call, no variable update in between (reference:
+# tensorflow/gradient_aggregation.py LocalGradientAggregationHelper)
+agg_model = tf.keras.Sequential([tf.keras.layers.Dense(1, use_bias=False)])
+agg_model.build((None, 2))
+hvd.broadcast_variables(agg_model.variables, root_rank=0)
+aopt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(1.0),
+                                backward_passes_per_step=2)
+w0 = agg_model.trainable_variables[0].numpy().copy()
+gstep = [tf.fill([2, 1], float(r + 1))]
+aopt.apply_gradients(zip(gstep, agg_model.trainable_variables))
+assert np.allclose(agg_model.trainable_variables[0].numpy(), w0), \
+    "variables must not move on a non-communicating step"
+aopt.apply_gradients(zip(gstep, agg_model.trainable_variables))
+# accumulated (r+1)*2, averaged over passes -> (r+1), over ranks -> (s+1)/2
+expect = w0 - (s + 1) / 2.0
+assert np.allclose(agg_model.trainable_variables[0].numpy(), expect,
+                   atol=1e-6), (agg_model.trainable_variables[0].numpy(),
+                                expect)
+
+# same semantics under tf.function (the graph path: tf.Variable counter +
+# tf.cond; slot creation lifted via init_scope on first trace)
+g_model = tf.keras.Sequential([tf.keras.layers.Dense(1, use_bias=False)])
+g_model.build((None, 2))
+hvd.broadcast_variables(g_model.variables, root_rank=0)
+gopt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(1.0),
+                                backward_passes_per_step=2)
+
+
+@tf.function
+def graph_apply(g):
+    gopt.apply_gradients(zip([g], g_model.trainable_variables))
+
+
+gw0 = g_model.trainable_variables[0].numpy().copy()
+graph_apply(tf.fill([2, 1], float(r + 1)))
+assert np.allclose(g_model.trainable_variables[0].numpy(), gw0)
+graph_apply(tf.fill([2, 1], float(r + 1)))
+assert np.allclose(g_model.trainable_variables[0].numpy(),
+                   gw0 - (s + 1) / 2.0, atol=1e-6)
+
 # Keras callbacks (reference: horovod/_keras/callbacks.py)
 import horovod_tpu.keras as hvd_keras  # noqa: E402
 
