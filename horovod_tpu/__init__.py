@@ -100,6 +100,14 @@ def init():
         rc = _worker.rendezvous_init()
         _maybe_init_jax_mesh()
         return rc
+    from .runner import network as _network
+
+    if _network.NEGOTIATE in (_os.environ.get("HVD_CONTROLLER_ADDR", ""),
+                              _os.environ.get("HVD_JAX_COORD_ADDR", "")):
+        # Multi-host static launch: rank 0 registers real ports probed on
+        # ITS host; everyone else reads them (runner/network.py — the
+        # driver/task-service analog).
+        _network.negotiate_endpoints_from_env()
     rc = _basics.init()
     _maybe_init_jax_mesh()
     return rc

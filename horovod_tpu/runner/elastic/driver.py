@@ -14,7 +14,7 @@ import os
 import time
 import uuid
 
-from .. import http_server, util
+from .. import http_server, network, util
 from ..hosts import HostInfo, get_host_assignments, is_local
 from ..local import find_free_port
 from .discovery import FixedHosts, HostDiscoveryScript
@@ -85,7 +85,7 @@ class ElasticDriver:
         env = dict(os.environ)
         env.update(self.extra_env)
         env["HVD_ELASTIC"] = "1"
-        rdv_host = "127.0.0.1" if is_local(hostname) else _my_addr()
+        rdv_host = "127.0.0.1" if is_local(hostname) else _my_addr([hostname])
         env["HVD_RENDEZVOUS_ADDR"] = f"{rdv_host}:{self.rdv_port}"
         env["HVD_RENDEZVOUS_SECRET"] = self.secret.hex()
         env["HVD_WORKER_ID"] = wid
@@ -115,9 +115,7 @@ class ElasticDriver:
             proc = util.safe_exec(["/bin/sh", "-c", cmd],
                                   env=dict(os.environ),
                                   stdin=subprocess.PIPE)
-            proc.stdin.write(env["HVD_RENDEZVOUS_SECRET"].encode() + b"\n")
-            proc.stdin.flush()
-            proc.stdin.close()
+            util.send_stdin_line(proc, env["HVD_RENDEZVOUS_SECRET"].encode())
         w = _Worker(wid, hostname, slot, proc, self.epoch + 1)
         self.workers[wid] = w
         self._log(f"spawned {wid}")
@@ -174,23 +172,39 @@ class ElasticDriver:
         slots = get_host_assignments(hosts, len(active))
         ordered = [w for h, ws in by_host.items() for w in ws]
 
-        rank0_host = slots[0].hostname
-        if is_local(rank0_host):
-            ctrl_host, port = "127.0.0.1", find_free_port()
+        rdv_routable = None
+        if all(is_local(w.hostname) for w in active):
+            # Every active worker runs on this host, so a port probed here
+            # is probed on the right machine and loopback is reachable by
+            # all of them. (Keying on rank 0's host alone would publish an
+            # unreachable 127.0.0.1 controller to remote workers in a
+            # mixed local+remote epoch.)
+            ctrl = f"127.0.0.1:{find_free_port()}"
         else:
-            # Cannot probe a remote host's ports from here; pick from a
-            # high range to make collisions unlikely. The port advances
-            # every epoch, so a collision self-heals on the next failure.
-            import random
-            ctrl_host = rank0_host
-            port = random.randint(23000, 43000)
-        ctrl = f"{ctrl_host}:{port}"
+            # The driver cannot probe a remote host's ports: the epoch's
+            # rank 0 registers a real locally-probed port in the KV store
+            # and every rank reads it (runner/network.py — the driver/
+            # task-service analog; replaces the old random.randint guess
+            # whose collision surfaced as a rendezvous timeout).
+            ctrl = network.NEGOTIATE
+            # Local workers were spawned with a loopback rendezvous
+            # address, and rank 0 derives its registered IP from the
+            # interface toward the KV store — so in a mixed epoch every
+            # rank must negotiate against the routable address, or a
+            # LOCAL rank 0 would register an unreachable 127.0.0.1
+            # controller for the remote ranks.
+            remote = [w.hostname for w in active
+                      if not is_local(w.hostname)]
+            rdv_routable = f"{_my_addr(remote)}:{self.rdv_port}"
         jax_coord = self._serve_jax_coordination(len(active))
         for w, s in zip(ordered, slots):
             a = {"rank": s.rank, "size": s.size,
                  "local_rank": s.local_rank, "local_size": s.local_size,
                  "cross_rank": s.cross_rank, "cross_size": s.cross_size,
-                 "controller": ctrl, "jax_coord": jax_coord}
+                 "controller": ctrl, "jax_coord": jax_coord,
+                 "scope": f"svc-ep{self.epoch}"}
+            if rdv_routable:
+                a["rdv"] = rdv_routable
             self.rdv.put(f"/assign-{self.epoch}/{w.id}",
                          json.dumps(a).encode())
         for w in extra:
@@ -230,9 +244,9 @@ class ElasticDriver:
             threading.Thread(target=lambda s=old: _safe_svc_shutdown(s),
                              daemon=True).start()
         self._jax_services.append(svc)
-        host = "127.0.0.1" if all(
-            is_local(w.hostname) for w in self.workers.values()
-            if w.alive) else _my_addr()
+        remote = [w.hostname for w in self.workers.values()
+                  if w.alive and not is_local(w.hostname)]
+        host = "127.0.0.1" if not remote else _my_addr(remote)
         addr = f"{host}:{port}"
         self._log(f"epoch {self.epoch}: jax coordination on {addr}")
         return addr
@@ -362,9 +376,14 @@ def _safe_svc_shutdown(svc):
         pass
 
 
-def _my_addr():
-    import socket
-    return socket.getfqdn()
+def _my_addr(remote_hosts=()):
+    """This host's address as reachable by the given remote hosts: the
+    interface routing toward the first resolvable one (runner/network.py),
+    not getfqdn() — which on many distros maps to 127.0.1.1 or a name
+    absent from the workers' DNS."""
+    from ..network import routable_addr
+
+    return routable_addr(remote_hosts)
 
 
 def run_elastic(args):

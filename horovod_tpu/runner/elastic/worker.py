@@ -81,6 +81,15 @@ def apply_assignment(a):
     os.environ["HVD_CROSS_RANK"] = str(a["cross_rank"])
     os.environ["HVD_CROSS_SIZE"] = str(a["cross_size"])
     os.environ["HVD_CONTROLLER_ADDR"] = a["controller"]
+    if a.get("scope"):
+        os.environ["HVD_ENDPOINT_SCOPE"] = a["scope"]
+    if a.get("rdv"):
+        # Mixed local+remote epoch: negotiate against the driver's ROUTABLE
+        # address, not the loopback one this worker may have been spawned
+        # with — a local rank 0 derives its registered controller IP from
+        # the interface toward the KV store, and 127.0.0.1 would be
+        # unreachable for the remote ranks.
+        os.environ["HVD_RENDEZVOUS_ADDR"] = a["rdv"]
     # The driver hosts a jax.distributed coordination service per epoch;
     # workers join it as recoverable clients (jax/distributed.py). A
     # single-worker epoch publishes no address — clear any stale one.
@@ -105,6 +114,7 @@ def rendezvous_init():
         raise SystemExit(0)
     apply_assignment(a)
     notification_manager.set_epoch(epoch)
+    _negotiate()
     basics.init()
     return epoch
 
@@ -138,6 +148,7 @@ def rendezvous_reset():
         raise SystemExit(0)
     apply_assignment(a)
     notification_manager.set_epoch(epoch)
+    _negotiate()
     basics.init()
     # Same gate as hvd.init(): never import the jax subpackage (and its
     # jax/optax module-level dependencies) into non-JAX workers.
@@ -145,6 +156,16 @@ def rendezvous_reset():
 
     horovod_tpu._maybe_init_jax_mesh()
     return epoch
+
+
+def _negotiate():
+    """Resolve 'negotiate' endpoints for this epoch: rank 0 registers real
+    ports probed on ITS host (runner/network.py — replaces the driver
+    guessing a remote host's free port with random.randint)."""
+    from .. import network
+
+    if os.environ.get("HVD_CONTROLLER_ADDR") == network.NEGOTIATE:
+        network.negotiate_endpoints_from_env()
 
 
 def _wait_epoch_at_least(n, timeout=600.0):

@@ -134,18 +134,36 @@ def _run_static(args):
     slots = hosts_mod.get_host_assignments(hs, np_)
     extra = _slot_extra_env(args)
 
-    port = find_free_port()
-    rank0_host = slots[0].hostname
-    ctrl_host = "127.0.0.1" if hosts_mod.is_local(rank0_host) else rank0_host
-    ctrl = f"{ctrl_host}:{port}"
-    # jax.distributed coordinator (served by rank 0) — the cross-process
-    # ICI mesh rendezvous; see horovod_tpu/jax/distributed.py.
-    # NOTE: like the ctrl port above, the port is probed free on the
-    # LAUNCHER host; when rank 0 is remote it may collide there. The
-    # driver/task services negotiate real ports on each host (reference:
-    # runner/driver/driver_service.py) — both allocations route through
-    # that once a remote host is involved.
-    jax_coord = f"{ctrl_host}:{find_free_port()}"
+    any_remote = any(not hosts_mod.is_local(s.hostname) for s in slots)
+    rdv = None
+    if any_remote:
+        # Driver/task services (reference: runner/driver/driver_service.py
+        # + task_service.py): the launcher hosts an HMAC-signed KV store;
+        # the job's rank 0 probes real free ports ON ITS OWN HOST for the
+        # controller and jax coordinator and registers them; every rank
+        # reads the registrations (runner/network.py). No port on a remote
+        # host is ever guessed from here.
+        from . import http_server
+
+        secret = util.make_secret_key()
+        rdv = http_server.RendezvousServer(secret_key=secret, addr="0.0.0.0")
+        rdv_port = rdv.start()
+        from . import network as network_mod
+
+        remote = [s.hostname for s in slots
+                  if not hosts_mod.is_local(s.hostname)]
+        extra = dict(extra)
+        extra["HVD_RENDEZVOUS_ADDR"] = "{}:{}".format(
+            network_mod.routable_addr(remote,
+                                      probe_port=args.ssh_port or 22),
+            rdv_port)
+        extra["HVD_RENDEZVOUS_SECRET"] = secret.hex()
+        ctrl = jax_coord = network_mod.NEGOTIATE
+    else:
+        # Single-host job: the launcher IS rank 0's host, so probing here
+        # is probing the right machine.
+        ctrl = f"127.0.0.1:{find_free_port()}"
+        jax_coord = f"127.0.0.1:{find_free_port()}"
 
     procs = []
     try:
@@ -157,16 +175,23 @@ def _run_static(args):
             if hosts_mod.is_local(s.hostname):
                 procs.append(safe_exec(list(args.command), env=env))
             else:
+                import subprocess
+
                 cmd = get_remote_command(s, list(args.command), {
                     k: v for k, v in env.items()
                     if k.startswith(("HVD_", "PYTHONPATH", "PATH"))
-                }, args.ssh_port)
-                procs.append(safe_exec(["/bin/sh", "-c", cmd],
-                                       env=dict(os.environ)))
+                }, args.ssh_port, stdin_env=("HVD_RENDEZVOUS_SECRET",))
+                p = safe_exec(["/bin/sh", "-c", cmd],
+                              env=dict(os.environ), stdin=subprocess.PIPE)
+                util.send_stdin_line(
+                    p, env["HVD_RENDEZVOUS_SECRET"].encode())
+                procs.append(p)
         return _wait_all(procs, verbose=args.verbose)
     finally:
         for p in procs:
             terminate(p)
+        if rdv is not None:
+            rdv.stop()
 
 
 def _wait_all(procs, verbose=False):

@@ -1,0 +1,112 @@
+"""Endpoint negotiation: real ports registered from the host that owns them.
+
+Reference parity: `horovod/runner/driver/driver_service.py`
+(`HorovodRunDriverService` — tasks register their addresses with the
+driver), `horovod/runner/task/task_service.py`, and
+`horovod/runner/common/util/network.py` (routable-interface discovery).
+Rebuilt on this build's HMAC-signed HTTP KV store instead of the
+reference's pickled-socket BasicService protocol: rank 0 probes a free
+port ON ITS OWN HOST, discovers which local interface routes to the
+driver, and registers `ip:port` in the KV; every other rank reads it.
+This replaces the launcher guessing a remote host's free ports from afar
+(the old `find_free_port()`-on-the-wrong-host / `random.randint` paths,
+where a collision surfaced as a rendezvous timeout).
+"""
+
+import os
+import socket
+
+from . import http_server
+
+#: Sentinel the launcher/driver puts in an endpoint env var or assignment
+#: when the real port must be negotiated by rank 0 at init time.
+NEGOTIATE = "negotiate"
+
+
+def local_addr_towards(remote_host, remote_port):
+    """The local interface address that routes toward (remote_host,
+    remote_port) — the standard UDP-connect trick (no packet is sent).
+    Reference: `network.py get_local_host_addresses` + driver-side
+    `_get_localhost_intfs` route selection, collapsed into one probe
+    against the peer that actually matters (the driver)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((remote_host, int(remote_port)))
+        return s.getsockname()[0]
+    finally:
+        s.close()
+
+
+def routable_addr(remote_hosts=(), probe_port=22):
+    """This host's address as reachable by ``remote_hosts``: the local
+    interface routing toward the first resolvable one. Falls back to
+    getfqdn() only when no remote host resolves (e.g. tests with fake
+    hostnames). Used by the launcher and the elastic driver to publish
+    their own KV-store / coordination addresses to remote workers."""
+    for h in remote_hosts:
+        try:
+            return local_addr_towards(h, probe_port)
+        except OSError:
+            continue
+    return socket.getfqdn()
+
+
+def probe_free_port():
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("0.0.0.0", 0))
+        return s.getsockname()[1]
+
+
+def negotiate(rdv_addr, secret, rank, scope, names, timeout=60.0):
+    """Resolve service endpoints for this job/epoch.
+
+    Rank 0: for each name, probe a free local port, discover the routable
+    interface toward the rendezvous server, and register "ip:port" under
+    /{scope}/{name}. Other ranks: wait for the registrations. Returns
+    {name: "ip:port"}.
+    """
+    out = {}
+    if rank == 0:
+        host, port = rdv_addr.rsplit(":", 1)
+        ip = local_addr_towards(host, port)
+        for name in names:
+            addr = f"{ip}:{probe_free_port()}"
+            http_server.put_kv(rdv_addr, scope, name, addr.encode(),
+                               secret_key=secret)
+            out[name] = addr
+    else:
+        for name in names:
+            raw = http_server.read_kv(rdv_addr, scope, name,
+                                      secret_key=secret, wait=True,
+                                      timeout=timeout)
+            out[name] = raw.decode()
+    return out
+
+
+def negotiate_endpoints_from_env():
+    """Resolve any env endpoint set to the NEGOTIATE sentinel, in place.
+
+    Called from hvd.init() (static launch) and each elastic re-rendezvous,
+    after the slot env / epoch assignment is applied and before the core
+    binds anything. HVD_ENDPOINT_SCOPE namespaces the registrations (the
+    elastic driver sets it per epoch so stale entries can't be read)."""
+    pending = [name for name, var in (("controller", "HVD_CONTROLLER_ADDR"),
+                                      ("jax_coord", "HVD_JAX_COORD_ADDR"))
+               if os.environ.get(var) == NEGOTIATE]
+    if not pending:
+        return
+    rdv = os.environ.get("HVD_RENDEZVOUS_ADDR")
+    if not rdv:
+        raise RuntimeError(
+            "endpoint negotiation requested but HVD_RENDEZVOUS_ADDR is "
+            "not set (the launcher must provide the KV store address)")
+    secret_hex = os.environ.get("HVD_RENDEZVOUS_SECRET")
+    secret = bytes.fromhex(secret_hex) if secret_hex else None
+    rank = int(os.environ.get("HVD_RANK", "0"))
+    scope = os.environ.get("HVD_ENDPOINT_SCOPE", "svc")
+    timeout = float(os.environ.get("HVD_START_TIMEOUT", "60"))
+    resolved = negotiate(rdv, secret, rank, scope, pending, timeout=timeout)
+    if "controller" in resolved:
+        os.environ["HVD_CONTROLLER_ADDR"] = resolved["controller"]
+    if "jax_coord" in resolved:
+        os.environ["HVD_JAX_COORD_ADDR"] = resolved["jax_coord"]

@@ -45,6 +45,19 @@ def safe_exec(command, env=None, stdout=None, stderr=None, stdin=None):
                             stdin=stdin, preexec_fn=os.setsid)
 
 
+def send_stdin_line(proc, data: bytes):
+    """Write one line to `proc`'s stdin and close it, tolerating the process
+    having already died (ssh missing, instant connection refused) — the
+    caller learns the story from its exit code, not a BrokenPipeError.
+    Used to pass the HMAC secret to remote workers off the command line."""
+    try:
+        proc.stdin.write(data + b"\n")
+        proc.stdin.flush()
+        proc.stdin.close()
+    except (BrokenPipeError, OSError):
+        pass
+
+
 def terminate(proc, timeout=GRACEFUL_TERMINATION_TIME_S):
     """SIGTERM the process group, escalate to SIGKILL after `timeout`."""
     if proc.poll() is not None:

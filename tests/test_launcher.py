@@ -108,6 +108,97 @@ def test_get_remote_command():
     assert "python train.py" in cmd
 
 
+def test_remote_command_negotiated_endpoints_and_stdin_secret(monkeypatch):
+    """Multi-host static launch (mocked ssh, reference style:
+    test/single/test_run.py): the exact remote command carries the
+    negotiate sentinel and rendezvous address, reads the HMAC secret from
+    STDIN (never argv), and no remote port is guessed by the launcher."""
+    import horovod_tpu.runner.launch as launch_mod
+
+    spawned = []
+
+    class _FakeProc:
+        def __init__(self):
+            import io
+
+            self.stdin = io.BytesIO()
+            self.stdin.flush = lambda: None
+            self._closed = False
+            orig_close = self.stdin.close
+
+            def close():
+                self._data = self.stdin.getvalue()
+                orig_close()
+
+            self.stdin.close = close
+
+        def poll(self):
+            return 0
+
+    def fake_safe_exec(command, env=None, stdout=None, stderr=None,
+                       stdin=None):
+        p = _FakeProc()
+        spawned.append((command, env, p))
+        return p
+
+    monkeypatch.setattr(launch_mod, "safe_exec", fake_safe_exec)
+    monkeypatch.setattr(launch_mod, "terminate", lambda p: None)
+    args = launch_mod.parse_args(
+        ["-np", "2", "-H", "remote1:1,remote2:1", "python", "train.py"])
+    rc = launch_mod._run_static(args)
+    assert rc == 0
+    assert len(spawned) == 2
+    for command, env, proc in spawned:
+        sh = command[2]  # ["/bin/sh", "-c", cmd]
+        assert sh.startswith("ssh ")
+        assert "HVD_CONTROLLER_ADDR=negotiate" in sh
+        assert "HVD_JAX_COORD_ADDR=negotiate" in sh
+        assert "HVD_RENDEZVOUS_ADDR=" in sh
+        # the secret must never appear on the command line...
+        assert "HVD_RENDEZVOUS_SECRET=" not in sh.replace(
+            "read -r HVD_RENDEZVOUS_SECRET", "")
+        assert "read -r HVD_RENDEZVOUS_SECRET && "\
+               "export HVD_RENDEZVOUS_SECRET" in sh
+        # ...it rides stdin.
+        secret_line = proc._data
+        assert secret_line.endswith(b"\n") and len(secret_line) == 65
+        bytes.fromhex(secret_line.strip().decode())  # valid hex key
+
+
+def test_endpoint_negotiation_localhost():
+    """runner/network.py: rank 0 probes a free port on its own host,
+    discovers the interface routing to the driver (loopback here), and
+    registers it; rank 1 reads the same address (reference:
+    driver_service.py task registration)."""
+    import threading
+
+    from horovod_tpu.runner import network
+
+    key = util.make_secret_key()
+    srv = http_server.RendezvousServer(secret_key=key)
+    port = srv.start()
+    addr = f"127.0.0.1:{port}"
+    results = {}
+    try:
+        def rank1():
+            results[1] = network.negotiate(addr, key, 1, "svc-t",
+                                           ["controller", "jax_coord"],
+                                           timeout=10)
+
+        t = threading.Thread(target=rank1)
+        t.start()
+        results[0] = network.negotiate(addr, key, 0, "svc-t",
+                                       ["controller", "jax_coord"])
+        t.join(timeout=15)
+        assert results[0] == results[1]
+        host, p = results[0]["controller"].rsplit(":", 1)
+        assert host == "127.0.0.1"  # loopback iface selected toward driver
+        assert 0 < int(p) < 65536
+        assert results[0]["controller"] != results[0]["jax_coord"]
+    finally:
+        srv.stop()
+
+
 # -- HTTP KV rendezvous -----------------------------------------------------
 
 def test_kv_store_roundtrip():
