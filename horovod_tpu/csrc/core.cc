@@ -655,9 +655,15 @@ void BackgroundLoop() {
       } else if (g->rank == 0) {
         std::vector<RequestList> lists(g->size);
         lists[0] = std::move(mine);
+        // Poll-driven concurrent gather: with blocking per-worker recv the
+        // cycle is O(N) sequential round-trips and the coordinator stalls
+        // on its slowest-to-arrive peer N-1 times instead of once.
+        std::vector<Socket*> socks;
+        socks.reserve(g->size - 1);
+        for (int r = 1; r < g->size; r++) socks.push_back(&g->workers[r]);
+        auto frames = RecvFrameEach(socks);
         for (int r = 1; r < g->size; r++) {
-          auto frame = g->workers[r].RecvFrame();
-          Reader rd(frame.data(), frame.size());
+          Reader rd(frames[r - 1].data(), frames[r - 1].size());
           lists[r] = RequestList::deserialize(rd);
         }
         bool all_shutdown = false;

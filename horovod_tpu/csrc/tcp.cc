@@ -4,6 +4,7 @@
 #include <errno.h>
 #include <netdb.h>
 #include <netinet/in.h>
+#include <poll.h>
 #include <netinet/tcp.h>
 #include <string.h>
 #include <sys/socket.h>
@@ -78,14 +79,18 @@ void Socket::SendFrame(const std::vector<uint8_t>& payload) {
   if (len) SendAll(payload.data(), len);
 }
 
-std::vector<uint8_t> Socket::RecvFrame() {
-  uint32_t len = 0;
-  RecvAll(&len, 4);
+void Socket::CheckFrameLen(uint32_t len) {
   // Sanity cap: negotiation frames are small; a corrupt/hostile peer must
   // not be able to make us allocate arbitrary memory from a length prefix.
   if (len > kMaxFrameBytes)
     throw std::runtime_error("frame length " + std::to_string(len) +
                              " exceeds sanity cap — corrupt peer?");
+}
+
+std::vector<uint8_t> Socket::RecvFrame() {
+  uint32_t len = 0;
+  RecvAll(&len, 4);
+  CheckFrameLen(len);
   std::vector<uint8_t> payload(len);
   if (len) RecvAll(payload.data(), len);
   return payload;
@@ -95,6 +100,84 @@ void Socket::Interrupt() {
   // Unblock a thread stuck in recv/send on this socket WITHOUT releasing
   // the fd (the owner still closes it); used by the bounded-shutdown path.
   if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+}
+
+std::vector<std::vector<uint8_t>> RecvFrameEach(
+    const std::vector<Socket*>& socks) {
+  size_t n = socks.size();
+  std::vector<std::vector<uint8_t>> out(n);
+  // Per-socket frame state machine: 4-byte length header, then payload.
+  std::vector<uint32_t> len(n, 0);
+  std::vector<size_t> got(n, 0);  // bytes received of the current section
+  std::vector<uint8_t> hdr(n * 4);
+  std::vector<bool> in_header(n, true), done(n, false);
+  size_t remaining = n;
+  std::vector<pollfd> fds(n);
+  std::vector<size_t> idx(n);
+  while (remaining > 0) {
+    size_t nf = 0;
+    for (size_t i = 0; i < n; i++) {
+      if (done[i]) continue;
+      // poll(2) silently ignores negative fds — a dead socket here must
+      // fail loudly (feeding BackgroundLoop's elastic error path) like
+      // the old blocking RecvFrame's EBADF did, not wedge the gather.
+      if (!socks[i]->valid())
+        throw std::runtime_error("recv: invalid socket (peer torn down)");
+      fds[nf].fd = socks[i]->fd();
+      fds[nf].events = POLLIN;
+      fds[nf].revents = 0;
+      idx[nf] = i;
+      nf++;
+    }
+    int rc = ::poll(fds.data(), (nfds_t)nf, -1);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      throw_errno("poll");
+    }
+    for (size_t k = 0; k < nf; k++) {
+      if (fds[k].revents & POLLNVAL)
+        throw std::runtime_error("recv: stale socket fd (POLLNVAL)");
+      if (!(fds[k].revents & (POLLIN | POLLERR | POLLHUP))) continue;
+      size_t i = idx[k];
+      // POLLIN guarantees one recv() won't block; read what's there and
+      // come back for the rest on the next poll round.
+      if (in_header[i]) {
+        ssize_t r = ::recv(socks[i]->fd(), hdr.data() + i * 4 + got[i],
+                           4 - got[i], 0);
+        if (r < 0) {
+          if (errno == EINTR) continue;
+          throw_errno("recv");
+        }
+        if (r == 0) throw std::runtime_error("recv: peer closed");
+        got[i] += (size_t)r;
+        if (got[i] == 4) {
+          memcpy(&len[i], hdr.data() + i * 4, 4);
+          Socket::CheckFrameLen(len[i]);
+          out[i].resize(len[i]);
+          in_header[i] = false;
+          got[i] = 0;
+          if (len[i] == 0) {
+            done[i] = true;
+            remaining--;
+          }
+        }
+      } else {
+        ssize_t r = ::recv(socks[i]->fd(), out[i].data() + got[i],
+                           len[i] - got[i], 0);
+        if (r < 0) {
+          if (errno == EINTR) continue;
+          throw_errno("recv");
+        }
+        if (r == 0) throw std::runtime_error("recv: peer closed");
+        got[i] += (size_t)r;
+        if (got[i] == len[i]) {
+          done[i] = true;
+          remaining--;
+        }
+      }
+    }
+  }
+  return out;
 }
 
 void Listener::Listen(int port) {

@@ -45,6 +45,10 @@ class Socket {
   // Negotiation-frame sanity cap (1 GiB) — see RecvFrame.
   static constexpr uint32_t kMaxFrameBytes = 1u << 30;
 
+  // Throws if a received length prefix exceeds the sanity cap; shared by
+  // RecvFrame and RecvFrameEach so both recv paths enforce one limit.
+  static void CheckFrameLen(uint32_t len);
+
   void SetNoDelay();
 
   // Wire-byte accounting (payload sent on this socket). Written by the
@@ -74,6 +78,15 @@ class Listener {
   int fd_;
   int port_;
 };
+
+// Gather exactly one frame from each socket, poll-driven so slow peers
+// overlap instead of serializing. This is the coordinator's per-cycle
+// RequestList gather (reference: the MPI_Gather semantics inside
+// Controller::ComputeResponseList) — with blocking per-peer RecvFrame the
+// negotiation cycle is O(N) sequential round-trips; with poll it is one.
+// Returns frames in `socks` order. Throws on any peer failure.
+std::vector<std::vector<uint8_t>> RecvFrameEach(
+    const std::vector<Socket*>& socks);
 
 // Blocking connect with retry (rendezvous races are expected at startup).
 Socket ConnectRetry(const std::string& host, int port, double timeout_sec);

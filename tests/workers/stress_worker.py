@@ -1,0 +1,30 @@
+"""Negotiation-cycle latency probe: N back-to-back small allreduces.
+
+Each blocking allreduce of a tiny tensor costs ~one negotiation cycle
+(request gather -> coordinate -> response bcast -> ring on 256 bytes), so
+mean seconds/op ~= cycle latency. Rank 0 writes the mean to $STRESS_OUT.
+Used by the 8-vs-32-rank control-plane scaling test (reference concern:
+Controller::ComputeResponseList gather semantics — a serial per-worker
+recv makes the cycle O(N) sequential round-trips).
+"""
+import os
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+rounds = int(os.environ.get("STRESS_ROUNDS", "40"))
+x = np.ones(64, dtype=np.float32)
+for _ in range(5):  # warmup: mesh formed, code paths hot
+    hvd.allreduce(x, op=hvd.Sum)
+t0 = time.perf_counter()
+for _ in range(rounds):
+    y = hvd.allreduce(x, op=hvd.Sum)
+dt = (time.perf_counter() - t0) / rounds
+assert np.allclose(y, hvd.size()), y[:4]
+if hvd.rank() == 0 and os.environ.get("STRESS_OUT"):
+    with open(os.environ["STRESS_OUT"], "w") as f:
+        f.write(f"{dt:.6f}\n")
+hvd.shutdown()
