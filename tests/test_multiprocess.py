@@ -2,10 +2,11 @@
 jax device mesh (jax.distributed multi-controller), so in-jit collectives
 cross process boundaries on device — the composition of the launcher, the
 native core control plane, and the XLA data plane (SURVEY.md §7 stage 5;
-VERDICT r1 item #1).
+VERDICT r1 item #1, widened per VERDICT r2 weak #3 / next-round #7).
 
-The fake pod is 2 processes × 2 virtual CPU devices on localhost (SURVEY §4).
+The fake pod is N processes × 2 virtual CPU devices on localhost (SURVEY §4).
 """
+
 
 import pytest
 
@@ -14,5 +15,34 @@ pytest.importorskip("jax")
 from .util import run_worker_job  # noqa: E402
 
 
-def test_two_process_global_mesh():
-    run_worker_job(2, "jax_multiproc_worker.py", timeout=300, jax_coord=True)
+@pytest.mark.parametrize("np_", [2, 4])
+def test_global_mesh_train_step(np_):
+    """Mesh formation, in-jit psum across processes, full DP train step
+    with on-device gradient pmean, host metadata sync, core control plane
+    composing in the same process."""
+    run_worker_job(np_, "jax_multiproc_worker.py", timeout=300,
+                   jax_coord=True)
+
+
+def test_mesh_collective_matrix_4proc():
+    """All five in-mesh collectives × dtypes through a 4-process × 2-device
+    global mesh (the ICI analog of the host path's op matrix)."""
+    run_worker_job(4, "jax_mesh_matrix_worker.py", timeout=300,
+                   jax_coord=True)
+
+
+def test_mixed_in_mesh_and_core_ops():
+    """In-mesh XLA collectives and core-bridged (eager + in-jit io_callback)
+    collectives interleaved for several rounds in one program."""
+    run_worker_job(2, "jax_mesh_mixed_worker.py", timeout=300,
+                   jax_coord=True)
+
+
+def test_worker_death_while_meshed_fails_fast():
+    """A rank dying with the mesh live must surface HorovodInternalError on
+    survivors via the core plane promptly — not a coordination-service or
+    rendezvous timeout. The worker times the post-death collective itself
+    and asserts detection < 10s (TCP close is instant; a heartbeat fallback
+    is 60s+), so job spawn/import cost can't mask a regression."""
+    run_worker_job(3, "jax_mesh_death_worker.py", timeout=240,
+                   jax_coord=True)

@@ -1,0 +1,99 @@
+"""Worker: the five in-mesh collectives x dtypes through the GLOBAL
+(multi-process) device mesh — the ICI-plane analog of the host path's
+op x dtype matrix in collective_worker.py (reference:
+test/parallel/test_tensorflow.py collective coverage; VERDICT r2 weak #3).
+
+Launched by tpurun with a jax.distributed coordinator; every process
+contributes n_local virtual CPU devices to one global mesh, and each
+collective below executes as a single XLA op whose communication crosses
+process boundaries on device.
+"""
+from horovod_tpu.jax.distributed import force_cpu_platform
+
+force_cpu_platform(2)
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import shard_map  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+import horovod_tpu.jax as hvd  # noqa: E402
+from horovod_tpu.ops import jax_ops  # noqa: E402
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+assert hvd.is_multiprocess(), "global mesh did not form"
+mesh = hvd.global_mesh()
+n_local = len(jax.local_devices())
+n = mesh.shape["data"]
+assert n == s * n_local, (n, s, n_local)
+
+
+def run(fn, local_in):
+    f = jax.jit(shard_map(fn, mesh=mesh, in_specs=P("data"),
+                          out_specs=P("data"), check_vma=False))
+    return f(hvd.shard_local_batch(local_in, mesh))
+
+
+def check(out, expected_global):
+    """Verify this process's addressable shards against the full expected
+    global array (each shard knows its own slice via .index)."""
+    for sh in out.addressable_shards:
+        got = np.asarray(sh.data)
+        want = expected_global[sh.index]
+        assert got.shape == want.shape, (got.shape, want.shape)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float64), np.asarray(want, np.float64),
+            rtol=2e-2 if got.dtype == jnp.bfloat16 else 1e-5)
+
+
+k, d = 3, 2  # rows per device, features
+G = np.arange(n * k * d, dtype=np.float32).reshape(n * k, d)
+blocks = G.reshape(n, k, d)
+mine = G[r * n_local * k:(r + 1) * n_local * k]  # this process's rows
+
+# -- allreduce: Sum / Average / Min / Max, f32 + bf16 + i32
+for dtype in (np.float32, jnp.bfloat16, np.int32):
+    x = mine.astype(dtype)
+    out = run(lambda v: jax_ops.allreduce(v, "data", op=jax_ops.Sum), x)
+    check(out, np.tile(blocks.sum(0), (n, 1)).astype(np.float64))
+out = run(lambda v: jax_ops.allreduce(v, "data", op=jax_ops.Average), mine)
+check(out, np.tile(blocks.mean(0), (n, 1)))
+out = run(lambda v: jax_ops.allreduce(v, "data", op=jax_ops.Min), mine)
+check(out, np.tile(blocks.min(0), (n, 1)))
+out = run(lambda v: jax_ops.allreduce(v, "data", op=jax_ops.Max), mine)
+check(out, np.tile(blocks.max(0), (n, 1)))
+
+# -- allgather: every device receives the full G
+out = run(lambda v: jax_ops.allgather(v, "data"), mine)
+check(out, np.tile(G, (n, 1)))
+
+# -- broadcast from a non-zero root index
+root = min(2, n - 1)
+out = run(lambda v: jax_ops.broadcast(v, "data", root_index=root), mine)
+check(out, np.tile(blocks[root], (n, 1)))
+
+# -- alltoall: device i's row j goes to device j's position i
+m = 2
+A = np.arange(n * n * m, dtype=np.float32).reshape(n * n, m)
+a_mine = A[r * n_local * n:(r + 1) * n_local * n]
+out = run(lambda v: jax_ops.alltoall(v, "data"), a_mine)
+expect = np.empty_like(A)
+for i in range(n):
+    for j in range(n):
+        expect[i * n + j] = A[j * n + i]
+check(out, expect)
+
+# -- reducescatter: sum across devices, scatter dim0
+q = 2
+Z = np.arange(n * n * q * d, dtype=np.float32).reshape(n * n * q, d)
+z_mine = Z[r * n_local * n * q:(r + 1) * n_local * n * q]
+out = run(lambda v: jax_ops.reducescatter(v, "data", op=jax_ops.Sum), z_mine)
+zb = Z.reshape(n, n, q, d)  # [device, block, q, d]
+expect = zb.sum(0).reshape(n * q, d)  # block i lands on device i
+check(out, expect)
+
+hvd.shutdown()
+print(f"rank {r}: mesh matrix PASS", flush=True)
