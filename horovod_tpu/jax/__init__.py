@@ -34,8 +34,10 @@ from ..ops.jax_ops import (  # noqa: F401
     hvd_allgather as allgather,
     hvd_allreduce as allreduce,
     hvd_allreduce_pytree as allreduce_pytree,
+    hvd_alltoall as alltoall,
     hvd_broadcast as broadcast,
     hvd_broadcast_pytree as broadcast_parameters,
+    hvd_reducescatter as reducescatter,
 )
 from ..ops.collective_ops import join, barrier, poll, synchronize  # noqa: F401
 from .distributed import (  # noqa: F401  (multi-process ICI mesh)

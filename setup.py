@@ -1,0 +1,23 @@
+"""Build driver for the native core (reference: Horovod's root setup.py,
+which drives CMake; SURVEY.md §2.5). Metadata lives in pyproject.toml —
+this file only teaches setuptools to `make` libhvd_tpu.so before packaging,
+so `pip install .` ships a ready binary while `basics.py` keeps its
+rebuild-on-import dev convenience.
+"""
+import os
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+CSRC = os.path.join(HERE, "horovod_tpu", "csrc")
+
+
+class BuildNativeThenPy(build_py):
+    def run(self):
+        subprocess.check_call(["make", "-s"], cwd=CSRC)
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildNativeThenPy})

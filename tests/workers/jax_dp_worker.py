@@ -35,6 +35,60 @@ out = jitted(jnp.full((4,), float(r)))
 expected = 2.0 * np.mean(np.arange(s)) + 1.0
 assert np.allclose(np.asarray(out), expected), (out, expected)
 
+# --- the full core-bridged op set, eager AND in-jit (VERDICT r2 #10)
+# allgather (eager, ragged dim0 allowed)
+g = hvd.allgather(jnp.full((r + 1, 2), float(r)), name="core.ag")
+assert np.asarray(g).shape == (s * (s + 1) // 2, 2)
+
+# allgather in-jit (uniform dim0 declared at trace time)
+@jax.jit
+def jit_ag(v):
+    return hvd.allgather(v, name="jit.ag")
+
+ga = jit_ag(jnp.full((2, 3), float(r)))
+assert np.asarray(ga).shape == (2 * s, 3)
+exp = np.concatenate([np.full((2, 3), float(i)) for i in range(s)])
+assert np.allclose(np.asarray(ga), exp)
+
+# broadcast in-jit
+@jax.jit
+def jit_bc(v):
+    return hvd.broadcast(v, root_rank=s - 1, name="jit.bc")
+
+bc = jit_bc(jnp.full((4,), float(r + 1)))
+assert np.allclose(np.asarray(bc), float(s))
+
+# alltoall: eager ragged + in-jit uniform
+out, rs = hvd.alltoall(jnp.arange(s * 2, dtype=jnp.float32) + 100 * r,
+                       splits=[2] * s, name="core.a2a")
+assert np.asarray(out).shape == (2 * s,) and (np.asarray(rs) == 2).all()
+
+@jax.jit
+def jit_a2a(v):
+    # splits=None: bare tensor (same convention as the tf/torch bindings)
+    return hvd.alltoall(v, name="jit.a2a")
+
+o = np.asarray(jit_a2a(jnp.arange(s * 3, dtype=jnp.float32) + 100 * r))
+# row block j of rank r's input lands at rank j, position r
+for j in range(s):
+    assert np.allclose(o[j * 3:(j + 1) * 3],
+                       np.arange(r * 3, (r + 1) * 3) + 100 * j), (r, j, o)
+
+# reducescatter: eager + in-jit with uneven dim0 (remainder to first ranks)
+m = jnp.ones((s * 2 + 1, 3), jnp.float32) * (r + 1)
+rsout = hvd.reducescatter(m, op=hvd.Sum, name="core.rs")
+rows = (s * 2 + 1) // s + (1 if r < (s * 2 + 1) % s else 0)
+assert np.asarray(rsout).shape == (rows, 3)
+assert np.allclose(np.asarray(rsout), sum(range(1, s + 1)))
+
+@jax.jit
+def jit_rs(v):
+    return hvd.reducescatter(v, op=hvd.Average, name="jit.rs")
+
+rsj = jit_rs(jnp.ones((s * 2 + 1, 3), jnp.float32) * (r + 1))
+assert np.asarray(rsj).shape == (rows, 3)
+assert np.allclose(np.asarray(rsj), np.mean(np.arange(1, s + 1)))
+
 # --- broadcast_parameters: rank-divergent params converge to rank 0's
 params = {"w": jnp.full((3, 3), float(r)), "b": jnp.full((3,), float(r))}
 params = hvd.broadcast_parameters(params, root_rank=0)
