@@ -1,0 +1,18 @@
+"""horovod_tpu.ray — programmatic multi-worker executor (L7 opener).
+
+Reference parity: ``horovod/ray/runner.py`` (``RayExecutor``: spawn N
+workers as Ray actors, run a function on every rank, collect results).
+This build keeps the same three-call shape — ``start() / run(fn) /
+shutdown()`` — with two backends:
+
+- **ray** (when the ``ray`` package is importable): workers are Ray actors
+  placed by the cluster scheduler, one per rank.
+- **local** (always available; the default in this environment, where ray
+  is absent): workers are local processes wired into the native core's
+  controller exactly like a ``tpurun`` job.
+
+Functions and results cross the process boundary via cloudpickle, like
+the reference's task services.
+"""
+
+from .runner import RayExecutor  # noqa: F401

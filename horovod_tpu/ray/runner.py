@@ -1,0 +1,213 @@
+"""RayExecutor — run a function on every rank of a fresh job and collect
+the results (reference: ``horovod/ray/runner.py`` ``RayExecutor.start`` /
+``run`` / ``execute`` / ``shutdown``).
+"""
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import cloudpickle
+
+from ..runner.local import find_free_port, slot_env
+from ..runner.util import terminate
+
+
+def _ray_available():
+    try:
+        import ray  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+class RayExecutor:
+    """Programmatic N-rank executor.
+
+    Usage (reference shape)::
+
+        ex = RayExecutor(num_workers=4)
+        ex.start()
+        results = ex.run(train_fn, args=(cfg,))   # list, one entry per rank
+        ex.shutdown()
+
+    ``fn`` runs in a fresh process per rank with the slot env
+    (``HVD_RANK``/``HVD_SIZE``/``HVD_CONTROLLER_ADDR``/...) already set, so
+    it typically starts with ``hvd.init()``. With ``use_jax_mesh=True`` a
+    jax.distributed coordinator is provisioned and the ranks form one
+    global device mesh (see horovod_tpu/jax/distributed.py).
+
+    Backend: Ray actors when the ``ray`` package is available and
+    ``backend="ray"`` (or ``backend=None`` and ray is importable), else
+    local processes (tpurun-style) on this host.
+    """
+
+    def __init__(self, num_workers, backend=None, use_jax_mesh=False,
+                 env=None, timeout=600.0):
+        if num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        self.num_workers = num_workers
+        self.use_jax_mesh = use_jax_mesh
+        self.extra_env = {k: str(v) for k, v in (env or {}).items()}
+        self.timeout = timeout
+        if backend is None:
+            backend = "ray" if _ray_available() else "local"
+        if backend == "ray" and not _ray_available():
+            raise RuntimeError("backend='ray' requested but ray is not "
+                               "importable; use backend='local'")
+        self.backend = backend
+        self._started = False
+        self._ctrl = None
+        self._jax_coord = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self):
+        """Allocate the job's controller (and optional jax coordinator)
+        endpoints. Ranks are spawned per run() call — a RayExecutor job is
+        one negotiation domain per run, like one tpurun invocation."""
+        if self._started:
+            raise RuntimeError("already started")
+        self._started = True
+        return self
+
+    def shutdown(self):
+        self._started = False
+
+    # -- execution --------------------------------------------------------
+
+    def run(self, fn, args=(), kwargs=None):
+        """Run ``fn(*args, **kwargs)`` on every rank; return per-rank
+        results ordered by rank. Raises RuntimeError (with the failing
+        rank's stderr) if any rank fails, after killing the others."""
+        if not self._started:
+            raise RuntimeError("call start() first")
+        if self.backend == "ray":
+            return self._run_ray(fn, args, kwargs)
+        return self._run_local(fn, args, kwargs)
+
+    def execute(self, fn):
+        """Reference-parity alias: run a callable taking no arguments."""
+        return self.run(fn)
+
+    # -- local backend ----------------------------------------------------
+
+    def _run_local(self, fn, args, kwargs):
+        n = self.num_workers
+        ctrl = f"127.0.0.1:{find_free_port()}"
+        jax_coord = (f"127.0.0.1:{find_free_port()}"
+                     if self.use_jax_mesh and n > 1 else None)
+        tmp = tempfile.mkdtemp(prefix="hvd-ray-")
+        in_path = os.path.join(tmp, "fn.pkl")
+        with open(in_path, "wb") as f:
+            cloudpickle.dump((fn, tuple(args), dict(kwargs or {})), f)
+        out_paths = [os.path.join(tmp, f"out-{r}.pkl") for r in range(n)]
+        err_paths = [os.path.join(tmp, f"err-{r}.log") for r in range(n)]
+
+        import shutil
+
+        procs = []
+        try:
+            for r in range(n):
+                env = slot_env(r, n, controller_addr=ctrl,
+                               jax_coord_addr=jax_coord,
+                               extra_env=self.extra_env)
+                env.setdefault("PYTHONPATH", os.path.dirname(
+                    os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__)))))
+                with open(err_paths[r], "wb") as ef:
+                    procs.append(subprocess.Popen(
+                        [sys.executable, "-m", "horovod_tpu.ray.worker",
+                         in_path, out_paths[r]],
+                        env=env, stderr=ef, start_new_session=True))
+            self._wait(procs, err_paths)
+            results = []
+            for r in range(n):
+                with open(out_paths[r], "rb") as f:
+                    results.append(cloudpickle.load(f))
+            return results
+        finally:
+            for p in procs:
+                terminate(p)
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def _wait(self, procs, err_paths):
+        deadline = time.time() + self.timeout
+        codes = [None] * len(procs)
+        while any(c is None for c in codes):
+            for i, p in enumerate(procs):
+                if codes[i] is None:
+                    codes[i] = p.poll()
+                    if codes[i] not in (None, 0):
+                        for q in procs:
+                            terminate(q)
+                        with open(err_paths[i], "rb") as ef:
+                            tail = ef.read()[-4000:].decode("utf-8", "replace")
+                        raise RuntimeError(
+                            f"rank {i} failed (exit {codes[i]}):\n{tail}")
+            if time.time() > deadline:
+                for q in procs:
+                    terminate(q)
+                raise RuntimeError(
+                    f"RayExecutor.run timed out after {self.timeout}s")
+            time.sleep(0.02)
+
+    # -- ray backend ------------------------------------------------------
+
+    def _run_ray(self, fn, args, kwargs):
+        """Ray tasks, one per rank (reference: RayExecutor's
+        BaseHorovodWorker actors). Untestable in this environment (ray not
+        installed); kept small and structurally identical to the local path.
+
+        Ranks may land on any node, so no remote port is ever guessed from
+        the driver: the driver hosts the HMAC-signed KV store and rank 0
+        registers a controller port probed on ITS OWN node via the same
+        negotiation path tpurun multi-host launches use
+        (runner/network.py)."""
+        import ray
+
+        from ..runner import http_server, util
+        from ..runner.network import NEGOTIATE
+
+        if self.use_jax_mesh:
+            raise NotImplementedError(
+                "use_jax_mesh is not supported on the ray backend yet: the "
+                "jax coordinator must be served next to rank 0's node. Use "
+                "the local backend, or a tpurun elastic/static launch.")
+        if not ray.is_initialized():
+            ray.init()
+        secret = util.make_secret_key()
+        rdv = http_server.RendezvousServer(secret_key=secret, addr="0.0.0.0")
+        rdv_port = rdv.start()
+        rdv_addr = f"{ray.util.get_node_ip_address()}:{rdv_port}"
+        extra = dict(self.extra_env)
+        extra.update({"HVD_RENDEZVOUS_ADDR": rdv_addr,
+                      "HVD_RENDEZVOUS_SECRET": secret.hex(),
+                      "HVD_ENDPOINT_SCOPE": "ray-job"})
+
+        @ray.remote(max_calls=1)
+        def _worker(rank, size, payload):
+            import cloudpickle as cp
+            env = slot_env(rank, size, controller_addr=NEGOTIATE,
+                           extra_env=extra)
+            os.environ.update(env)
+            from ..runner.network import negotiate_endpoints_from_env
+            negotiate_endpoints_from_env()
+            f, a, kw = cp.loads(payload)
+            return f(*a, **(kw or {}))
+
+        payload = cloudpickle.dumps((fn, tuple(args), dict(kwargs or {})))
+        n = self.num_workers
+        futs = [_worker.remote(r, n, payload) for r in range(n)]
+        try:
+            return ray.get(futs, timeout=self.timeout)
+        except Exception as e:
+            # Honor run()'s failure contract: kill the survivors (a rank
+            # blocked in a collective never returns on its own) and raise
+            # one RuntimeError.
+            for f in futs:
+                ray.cancel(f, force=True)
+            raise RuntimeError(f"ray worker failed: {e}") from e
+        finally:
+            rdv.stop()

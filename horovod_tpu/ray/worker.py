@@ -1,0 +1,23 @@
+"""Worker stub for the local RayExecutor backend: deserialize the job's
+(fn, args, kwargs) payload, run it under this rank's slot env (already set
+by the executor), and write the cloudpickled result.
+
+Reference analog: the function shipped to each Ray actor / Spark task
+(horovod/ray/runner.py worker execution; horovod/runner/task/task_fn.py).
+"""
+import sys
+
+import cloudpickle
+
+
+def main():
+    in_path, out_path = sys.argv[1], sys.argv[2]
+    with open(in_path, "rb") as f:
+        fn, args, kwargs = cloudpickle.load(f)
+    result = fn(*args, **(kwargs or {}))
+    with open(out_path, "wb") as f:
+        cloudpickle.dump(result, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -20,3 +20,19 @@ def test_torch_binding_4proc():
 def test_tf_binding_2proc():
     pytest.importorskip("tensorflow")
     run_worker_job(2, "tf_worker.py", timeout=300)
+
+
+def test_mxnet_binding_import_surface():
+    """MXNet is absent in this environment (README descope note): the
+    binding must fail with a clear, actionable ImportError — and import
+    cleanly when mxnet exists (reference: horovod/mxnet/__init__.py)."""
+    try:
+        import mxnet  # noqa: F401
+    except ImportError:
+        with pytest.raises(ImportError, match="mxnet.*not.*installed"):
+            import horovod_tpu.mxnet  # noqa: F401
+    else:
+        import horovod_tpu.mxnet as hvd_mx
+
+        assert hasattr(hvd_mx, "DistributedTrainer")
+        assert hasattr(hvd_mx, "broadcast_parameters")

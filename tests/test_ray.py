@@ -1,0 +1,59 @@
+"""RayExecutor tests (reference: test/single/test_ray.py — but ray is not
+installed in this environment, so these exercise the local backend, which
+is the same start/run/shutdown surface over tpurun-style local processes)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.ray import RayExecutor
+
+
+def test_executor_runs_collectives_and_collects_results():
+    ex = RayExecutor(num_workers=3, env={"JAX_PLATFORMS": "cpu"})
+    ex.start()
+
+    def train(scale):
+        import numpy as np
+
+        import horovod_tpu as hvd
+
+        hvd.init()
+        out = hvd.allreduce(np.ones(4, np.float32) * scale, op=hvd.Sum)
+        r = hvd.rank()
+        hvd.shutdown()
+        return r, float(out[0])
+
+    results = ex.run(train, args=(2.0,))
+    ex.shutdown()
+    assert [r for r, _ in results] == [0, 1, 2]
+    assert all(v == 6.0 for _, v in results)
+
+
+def test_executor_failure_surfaces_and_kills_job():
+    ex = RayExecutor(num_workers=2, timeout=120,
+                     env={"JAX_PLATFORMS": "cpu"})
+    ex.start()
+
+    def bad():
+        import horovod_tpu as hvd
+
+        hvd.init()
+        if hvd.rank() == 1:
+            raise RuntimeError("boom on rank 1")
+        # rank 0 would block forever on a collective without the kill
+        hvd.allreduce(np.ones(2, np.float32), name="never.completes")
+
+    with pytest.raises(RuntimeError, match="rank 1 failed"):
+        ex.run(bad)
+    ex.shutdown()
+
+
+def test_executor_requires_start():
+    ex = RayExecutor(num_workers=1)
+    with pytest.raises(RuntimeError, match="start"):
+        ex.run(lambda: None)
+
+
+def test_ray_backend_unavailable_raises():
+    with pytest.raises(RuntimeError, match="ray"):
+        RayExecutor(num_workers=1, backend="ray")
