@@ -157,12 +157,8 @@ def broadcast_optimizer_state(opt_state, root_rank=0, name="hvd.opt_state",
 
 def metric_average(value, name=None):
     """Average a scalar metric across ranks (reference:
-    MetricAverageCallback)."""
-    import numpy as np
+    MetricAverageCallback). Delegates to the shared core helper."""
+    from ..ops.collective_ops import metric_average as _ma
 
-    from ..ops import collective_ops as _core
-
-    arr = np.asarray(value, dtype=np.float64).reshape(1)
-    out = _core.allreduce(arr, op=Average, name=name or "metric.avg")
-    return float(out[0])
+    return _ma(value, name=name)
 from .. import elastic  # noqa: F401  (hvd.elastic parity)

@@ -245,6 +245,16 @@ def broadcast(tensor, root_rank, name=None, process_set=0):
     return synchronize(broadcast_async(tensor, root_rank, name, process_set))
 
 
+def metric_average(value, name=None, process_set=0):
+    """Average a scalar metric across ranks (reference:
+    MetricAverageCallback). The ONE implementation every binding
+    delegates to — the tensor name must agree across frameworks so a
+    mixed-framework job negotiates one collective, not two."""
+    arr = np.asarray(float(value), dtype=np.float64).reshape(1)
+    return float(allreduce(arr, op=Average, name=name or "metric.avg",
+                           process_set=process_set)[0])
+
+
 def broadcast_object(obj, root_rank=0, name=None, process_set=0):
     """Broadcast an arbitrary picklable object (reference:
     horovod/torch/mpi_ops.py `broadcast_object`)."""

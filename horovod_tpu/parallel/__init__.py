@@ -10,7 +10,12 @@ collectives (SURVEY.md §2.4).
 
 from .mesh import create_mesh, mesh_axis_size  # noqa: F401
 from .data_parallel import make_train_step  # noqa: F401
-from .ring_attention import make_ring_attention, ring_attention  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    make_ring_attention,
+    ring_attention,
+    stripe_sequence,
+    unstripe_sequence,
+)
 from .ulysses import make_ulysses_attention, ulysses_attention  # noqa: F401
 from .expert_parallel import (  # noqa: F401
     make_moe_layer,

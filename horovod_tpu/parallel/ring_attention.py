@@ -17,11 +17,18 @@ with :func:`make_ring_attention`.
 Implementation notes:
 - block 0 (the local block) is computed before the loop, so only p-1
   rotations are issued — no K/V block is sent and then discarded;
-- under `causal=True`, blocks that are fully masked (source shard index
-  greater than ours) skip their matmuls via `lax.cond` — the rotation
-  still happens, but no FLOPs are burned. (Work remains skewed toward
-  high-index shards; striped/zig-zag sequence layout is the known fix and
-  can be layered on by permuting the sequence before sharding.)
+- under `causal=True` with the default contiguous layout, fully-masked
+  blocks (source shard index greater than ours) skip their matmuls via
+  `lax.cond`. The predicate is a per-device runtime scalar (axis_index),
+  so it survives as a real XLA conditional in each device's partitioned
+  program — but the ring rotates in lockstep, so WALL TIME is still set
+  by the busiest device each step: the skip saves energy, not latency.
+- `layout="striped"` is the real causal load-balance fix (striped /
+  zig-zag attention): device i holds global positions {i, i+p, i+2p, ...},
+  so every (query-shard, key-shard) block pair is ~half-masked and every
+  device does equal work every rotation. Use :func:`stripe_sequence` /
+  :func:`unstripe_sequence` to move between contiguous and striped
+  order at the program boundary.
 """
 
 import functools
@@ -32,15 +39,22 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def ring_attention(q, k, v, axis, causal=True, scale=None):
+def ring_attention(q, k, v, axis, causal=True, scale=None,
+                   layout="contiguous"):
     """Blockwise ring attention over mesh axis `axis`.
 
     q, k, v: [B, S_blk, H, D] — the local sequence block of each shard.
     Returns [B, S_blk, H, D] (dtype of q); softmax statistics in fp32.
 
-    With `causal=True`, global causality is enforced across blocks: shard i
-    holds global positions [i*S_blk, (i+1)*S_blk).
+    With `causal=True`, global causality is enforced across blocks.
+    `layout` declares which global positions this shard holds:
+    ``"contiguous"`` — shard i holds [i*S_blk, (i+1)*S_blk);
+    ``"striped"`` — shard i holds {i, i+p, i+2p, ...} (striped/zig-zag
+    attention: equal causal work on every device; see
+    :func:`stripe_sequence`).
     """
+    if layout not in ("contiguous", "striped"):
+        raise ValueError(f"unknown layout: {layout!r}")
     p = lax.psum(1, axis)
     my = lax.axis_index(axis)
     B, S, H, D = q.shape
@@ -51,14 +65,19 @@ def ring_attention(q, k, v, axis, causal=True, scale=None):
     q32 = q.astype(jnp.float32)
     perm = [(i, (i + 1) % p) for i in range(p)]  # ring: pass K/V to right
 
+    def positions(shard):
+        if layout == "striped":
+            return shard + p * jnp.arange(S)
+        return shard * S + jnp.arange(S)
+
     def accumulate(acc, k_blk, v_blk, src):
         """Online-softmax update of (o, m, l) with one K/V block."""
         o, m, l = acc
         s = jnp.einsum("bqhd,bkhd->bhqk", q32,
                        k_blk.astype(jnp.float32)) * scale
         if causal:
-            q_pos = my * S + jnp.arange(S)
-            k_pos = src * S + jnp.arange(S)
+            q_pos = positions(my)
+            k_pos = positions(src)
             mask = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(mask[None, None], s, -jnp.inf)
         m_new = jnp.maximum(m, s.max(axis=-1))
@@ -84,8 +103,10 @@ def ring_attention(q, k, v, axis, causal=True, scale=None):
         k_blk = lax.ppermute(k_blk, axis, perm)
         v_blk = lax.ppermute(v_blk, axis, perm)
         src = (my - i) % p  # whose block we now hold
-        if causal:
-            # src > my → every position is masked: skip the matmuls
+        if causal and layout == "contiguous":
+            # src > my → every position is masked: skip the matmuls.
+            # (Striped layout never skips: every block pair is ~half
+            # unmasked, which is exactly what balances the ring.)
             acc = lax.cond(src > my,
                            lambda a, kb, vb, s_: a,
                            accumulate,
@@ -103,20 +124,56 @@ def ring_attention(q, k, v, axis, causal=True, scale=None):
     return out.astype(dt)
 
 
+def stripe_sequence(x, p, seq_dim=1):
+    """Permute a contiguous global sequence into striped order: after
+    sharding dim `seq_dim` into p equal blocks, shard i holds global
+    positions {i, i+p, ...} in increasing order. Apply to q/k/v (and
+    inverse to the output) around a `layout="striped"` ring."""
+    S = x.shape[seq_dim]
+    idx = jnp.arange(S).reshape(S // p, p).T.reshape(-1)
+    return jnp.take(x, idx, axis=seq_dim)
+
+
+def unstripe_sequence(x, p, seq_dim=1):
+    """Inverse of :func:`stripe_sequence`."""
+    S = x.shape[seq_dim]
+    idx = jnp.argsort(jnp.arange(S).reshape(S // p, p).T.reshape(-1))
+    return jnp.take(x, idx, axis=seq_dim)
+
+
 def make_ring_attention(mesh, axis="seq", causal=True, batch_axis=None,
-                        head_axis=None, jit=True):
+                        head_axis=None, jit=True, layout="contiguous"):
     """Wrap ring_attention in shard_map over `mesh`: takes/returns global
     [B, S, H, D] arrays sequence-sharded on `axis`, optionally
     batch-sharded on `batch_axis` and head-sharded on `head_axis` (tensor
-    parallelism composes: each head group runs its own ring)."""
+    parallelism composes: each head group runs its own ring).
+
+    With ``layout="striped"`` the inputs are re-ordered into striped
+    position order on the way in and restored on the way out, so the
+    caller keeps contiguous sequences while every device does equal
+    causal work (striped/zig-zag attention). That convenience costs four
+    global sequence permutations (resharding traffic) PER CALL — for a
+    many-layer model, stripe the token stream ONCE outside the model with
+    :func:`stripe_sequence` and call the ring with already-striped inputs
+    instead. Without causality striping buys nothing, so ``causal=False``
+    ignores ``layout`` and skips the permutes entirely."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(batch_axis, axis, head_axis, None)
+    p = mesh.shape[axis]
+    striped = layout == "striped" and causal
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     def fn(q, k, v):
-        return ring_attention(q, k, v, axis=axis, causal=causal)
+        return ring_attention(q, k, v, axis=axis, causal=causal,
+                              layout="striped" if striped else "contiguous")
 
-    return jax.jit(fn) if jit else fn
+    def wrapped(q, k, v):
+        if striped:
+            q, k, v = (stripe_sequence(t, p) for t in (q, k, v))
+            return unstripe_sequence(fn(q, k, v), p)
+        return fn(q, k, v)
+
+    return jax.jit(wrapped) if jit else wrapped

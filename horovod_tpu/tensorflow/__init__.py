@@ -434,6 +434,6 @@ def __getattr__(name):
 
 
 def metric_average(value, name=None):
-    arr = np.asarray(float(value), np.float64).reshape(1)
-    return float(_core.allreduce(arr, op=Average,
-                                 name=name or "tf.metric")[0])
+    """Delegates to the shared core helper (one tensor name across
+    frameworks)."""
+    return _core.metric_average(value, name=name)

@@ -453,6 +453,6 @@ class ElasticSampler(torch.utils.data.Sampler):
 
 
 def metric_average(value, name=None):
-    arr = np.asarray(float(value), np.float64).reshape(1)
-    return float(_core.allreduce(arr, op=Average,
-                                 name=name or "torch.metric")[0])
+    """Delegates to the shared core helper (one tensor name across
+    frameworks, so mixed-framework jobs negotiate one collective)."""
+    return _core.metric_average(value, name=name)
