@@ -1,0 +1,6 @@
+"""horovod_tpu.data — data-loading helpers for estimator-style training.
+
+Reference parity: ``horovod/data/data_loader_base.py``.
+"""
+
+from .data_loader_base import AsyncDataLoaderMixin, BaseDataLoader  # noqa: F401

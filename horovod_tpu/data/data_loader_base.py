@@ -1,0 +1,94 @@
+"""Base data-loader contracts (reference: horovod/data/data_loader_base.py
+`BaseDataLoader`, `AsyncDataLoaderMixin`).
+
+Estimator-style trainers iterate per-epoch over a loader that shards rows
+across ranks; the async mixin double-buffers batches on a background
+thread so host-side input prep overlaps device compute — on TPU this is
+the host-side half of the input pipeline (the device half is an on-device
+prefetch via `jax.device_put` of the next batch while the step runs).
+"""
+import queue
+import threading
+
+
+class BaseDataLoader:
+    """Iterable over batches for ONE rank's shard of an epoch."""
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def _iterate(self):
+        """Yield batches for one epoch (subclass hook)."""
+        raise NotImplementedError
+
+    def __iter__(self):
+        return iter(self._iterate())
+
+
+class AsyncDataLoaderMixin:
+    """Mix in BEFORE a BaseDataLoader subclass to move `_iterate` onto a
+    background thread with a bounded prefetch queue::
+
+        class AsyncXLoader(AsyncDataLoaderMixin, XLoader):
+            pass
+
+    ``async_loading=False`` falls back to synchronous iteration.
+    """
+
+    def __init__(self, *args, num_prefetch_batches=2, async_loading=True,
+                 **kwargs):
+        self.num_prefetch_batches = max(1, int(num_prefetch_batches))
+        self.async_loading = async_loading
+        super().__init__(*args, **kwargs)
+
+    def __iter__(self):
+        if not self.async_loading:
+            return iter(super()._iterate())
+        return iter(self._async_iterate())
+
+    def _async_iterate(self):
+        q = queue.Queue(maxsize=self.num_prefetch_batches)
+        done = object()
+        stop = threading.Event()
+        err = []
+
+        def produce():
+            try:
+                for batch in super(AsyncDataLoaderMixin, self)._iterate():
+                    # Bounded put with a stop check: if the consumer
+                    # abandons iteration (early stop, exception) the
+                    # producer must exit, not block on a full queue
+                    # forever holding batches and data-source handles.
+                    while not stop.is_set():
+                        try:
+                            q.put(batch, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:  # surfaced on the consumer side
+                err.append(e)
+            finally:
+                # The done sentinel needs the same bounded-put loop: a
+                # full queue here usually means a SLOW consumer, not a
+                # gone one — dropping the sentinel would hang its q.get().
+                while not stop.is_set():
+                    try:
+                        q.put(done, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is done:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            stop.set()

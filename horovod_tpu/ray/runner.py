@@ -167,8 +167,10 @@ class RayExecutor:
         (runner/network.py)."""
         import ray
 
-        from ..runner import http_server, util
-        from ..runner.network import NEGOTIATE
+        from ..runner.program import (
+            host_negotiation_kv,
+            run_negotiated_payload,
+        )
 
         if self.use_jax_mesh:
             raise NotImplementedError(
@@ -177,30 +179,20 @@ class RayExecutor:
                 "the local backend, or a tpurun elastic/static launch.")
         if not ray.is_initialized():
             ray.init()
-        secret = util.make_secret_key()
-        rdv = http_server.RendezvousServer(secret_key=secret, addr="0.0.0.0")
-        rdv_port = rdv.start()
-        rdv_addr = f"{ray.util.get_node_ip_address()}:{rdv_port}"
-        extra = dict(self.extra_env)
-        extra.update({"HVD_RENDEZVOUS_ADDR": rdv_addr,
-                      "HVD_RENDEZVOUS_SECRET": secret.hex(),
-                      "HVD_ENDPOINT_SCOPE": "ray-job"})
-
-        @ray.remote(max_calls=1)
-        def _worker(rank, size, payload):
-            import cloudpickle as cp
-            env = slot_env(rank, size, controller_addr=NEGOTIATE,
-                           extra_env=extra)
-            os.environ.update(env)
-            from ..runner.network import negotiate_endpoints_from_env
-            negotiate_endpoints_from_env()
-            f, a, kw = cp.loads(payload)
-            return f(*a, **(kw or {}))
-
-        payload = cloudpickle.dumps((fn, tuple(args), dict(kwargs or {})))
-        n = self.num_workers
-        futs = [_worker.remote(r, n, payload) for r in range(n)]
+        # ray knows the driver's cluster-routable IP directly — no
+        # probe/getfqdn fallback (reverse DNS can stall for seconds).
+        rdv, extra = host_negotiation_kv(
+            "ray-job", extra_env=self.extra_env, timeout=self.timeout,
+            advertised_host=ray.util.get_node_ip_address())
+        futs = []
         try:
+            @ray.remote(max_calls=1)
+            def _worker(rank, size, payload):
+                return run_negotiated_payload(rank, size, payload, extra)
+
+            payload = cloudpickle.dumps((fn, tuple(args), dict(kwargs or {})))
+            n = self.num_workers
+            futs = [_worker.remote(r, n, payload) for r in range(n)]
             return ray.get(futs, timeout=self.timeout)
         except Exception as e:
             # Honor run()'s failure contract: kill the survivors (a rank

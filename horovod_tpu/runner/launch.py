@@ -143,22 +143,15 @@ def _run_static(args):
         # controller and jax coordinator and registers them; every rank
         # reads the registrations (runner/network.py). No port on a remote
         # host is ever guessed from here.
-        from . import http_server
-
-        secret = util.make_secret_key()
-        rdv = http_server.RendezvousServer(secret_key=secret, addr="0.0.0.0")
-        rdv_port = rdv.start()
-        from . import network as network_mod
+        from .network import NEGOTIATE
+        from .program import host_negotiation_kv
 
         remote = [s.hostname for s in slots
                   if not hosts_mod.is_local(s.hostname)]
-        extra = dict(extra)
-        extra["HVD_RENDEZVOUS_ADDR"] = "{}:{}".format(
-            network_mod.routable_addr(remote,
-                                      probe_port=args.ssh_port or 22),
-            rdv_port)
-        extra["HVD_RENDEZVOUS_SECRET"] = secret.hex()
-        ctrl = jax_coord = network_mod.NEGOTIATE
+        rdv, extra = host_negotiation_kv(
+            "svc", remote, extra_env=extra,
+            probe_port=args.ssh_port or 22)
+        ctrl = jax_coord = NEGOTIATE
     else:
         # Single-host job: the launcher IS rank 0's host, so probing here
         # is probing the right machine.

@@ -1,0 +1,72 @@
+"""Shared driver/worker halves of a programmatic negotiated launch.
+
+Used by the ray backend (horovod_tpu/ray/runner.py) and the Spark shim
+(horovod_tpu/spark): a driver hosts the HMAC-signed KV store and ships
+env to workers that may land on ANY node; each worker applies its slot
+env with the NEGOTIATE sentinel, rank 0 registers real ports probed on
+its own node, everyone else reads them (runner/network.py). One
+implementation so a fix to the negotiation env contract cannot silently
+diverge between backends.
+"""
+import os
+
+import cloudpickle
+
+from . import http_server, util
+from .local import slot_env
+from .network import NEGOTIATE, negotiate_endpoints_from_env, routable_addr
+
+
+def host_negotiation_kv(scope, driver_probe_hosts=(), extra_env=None,
+                        timeout=None, advertised_host=None, probe_port=22):
+    """Driver half: start a signed KV store bound 0.0.0.0 and build the
+    worker env pointing at it. Returns ``(rdv_server, env_dict)``; the
+    caller must ``rdv_server.stop()`` when the job ends.
+
+    ``driver_probe_hosts``: remote hosts to probe the driver's routable
+    interface toward (empty → getfqdn fallback; see routable_addr).
+    ``advertised_host``: skip probing entirely when the caller already
+    knows its cluster-reachable address (e.g. ray's node IP).
+    """
+    secret = util.make_secret_key()
+    rdv = http_server.RendezvousServer(secret_key=secret, addr="0.0.0.0")
+    rdv_port = rdv.start()
+    host = advertised_host or routable_addr(driver_probe_hosts,
+                                            probe_port=probe_port)
+    env = {k: str(v) for k, v in (extra_env or {}).items()}
+    env.update({
+        "HVD_RENDEZVOUS_ADDR": f"{host}:{rdv_port}",
+        "HVD_RENDEZVOUS_SECRET": secret.hex(),
+        "HVD_ENDPOINT_SCOPE": scope,
+    })
+    if timeout is not None:
+        env["HVD_START_TIMEOUT"] = str(timeout)
+    return rdv, env
+
+
+def run_negotiated_payload(rank, size, payload, extra_env,
+                           scope_suffix=""):
+    """Worker half: apply the slot env with a NEGOTIATE controller,
+    resolve endpoints through the driver's KV, then run the cloudpickled
+    ``(fn, args, kwargs)`` payload and return its result.
+
+    ``scope_suffix`` namespaces retries (e.g. a Spark stage attempt) so a
+    re-run cannot read a dead prior attempt's registrations.
+    """
+    env = slot_env(rank, size, controller_addr=NEGOTIATE,
+                   extra_env=extra_env)
+    if scope_suffix:
+        env["HVD_ENDPOINT_SCOPE"] = \
+            f"{env.get('HVD_ENDPOINT_SCOPE', 'svc')}-{scope_suffix}"
+    # Snapshot/restore: pyspark reuses executor worker processes
+    # (spark.python.worker.reuse=true), so one job's HVD_*/extra env must
+    # not leak into the next job that lands on the same worker.
+    saved = dict(os.environ)
+    os.environ.update(env)
+    try:
+        negotiate_endpoints_from_env()
+        fn, args, kwargs = cloudpickle.loads(payload)
+        return fn(*args, **(kwargs or {}))
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)

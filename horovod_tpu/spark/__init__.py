@@ -1,0 +1,72 @@
+"""horovod_tpu.spark — run ranks inside Spark executors.
+
+Reference parity: ``horovod/spark/__init__.py`` (``horovod.spark.run``:
+one rank per Spark task, results collected to the driver). The estimator
+layer (KerasEstimator/TorchEstimator + petastorm) is descoped with
+pyspark unavailability — see the README descope note; :mod:`.store`
+(``Store``/``LocalStore``) is importable without pyspark.
+
+Like the reference, each Spark task becomes one rank of a fresh job. The
+driver hosts the HMAC-signed KV store; rank 0 registers a controller port
+probed on ITS OWN executor node through the same negotiation path tpurun
+multi-host launches and the ray backend use (runner/network.py) — no
+remote port is ever guessed from the driver.
+"""
+import cloudpickle
+
+
+def _require_pyspark():
+    try:
+        import pyspark  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.spark.run requires the 'pyspark' package, which "
+            "is not installed in this environment (see the README descope "
+            "note). horovod_tpu.spark.store works without pyspark; for a "
+            "programmatic multi-rank launcher use horovod_tpu.ray."
+        ) from e
+
+
+def run(fn, args=(), kwargs=None, num_proc=None, extra_env=None,
+        timeout=600.0):
+    """Run ``fn(*args, **kwargs)`` as ``num_proc`` ranks inside barrier
+    Spark tasks; returns per-rank results ordered by rank (reference:
+    ``horovod.spark.run``)."""
+    _require_pyspark()
+    from pyspark import BarrierTaskContext, SparkContext
+
+    from ..runner.program import host_negotiation_kv, run_negotiated_payload
+
+    sc = SparkContext.getOrCreate()
+    n = num_proc or int(sc.defaultParallelism)
+    # The driver's address as reachable by executors: probe toward the
+    # cluster master when its host is known, else fall back to fqdn.
+    master = sc.master or ""
+    probe_hosts = []
+    if "://" in master:
+        host = master.split("://", 1)[1].rsplit(":", 1)[0]
+        if host and host != "local":
+            probe_hosts.append(host)
+    rdv, extra = host_negotiation_kv("spark-job", probe_hosts,
+                                     extra_env=extra_env, timeout=timeout)
+    try:
+        payload = cloudpickle.dumps((fn, tuple(args), dict(kwargs or {})))
+
+        def task(_):
+            ctx = BarrierTaskContext.get()
+            rank = ctx.partitionId()
+            # Scope per stage attempt: a retried barrier stage must not
+            # read the dead prior attempt's port registrations.
+            attempt = getattr(ctx, "stageAttemptNumber", lambda: 0)()
+            out = run_negotiated_payload(rank, n, payload, extra,
+                                         scope_suffix=f"try{attempt}")
+            return [(rank, out)]
+
+        rdd = sc.parallelize(range(n), n).barrier()
+        results = rdd.mapPartitions(task).collect()
+        return [out for _, out in sorted(results)]
+    finally:
+        rdv.stop()
+
+
+from .store import LocalStore, Store  # noqa: E402,F401

@@ -1,0 +1,79 @@
+"""Artifact stores for estimator-style training (reference:
+``horovod/spark/common/store.py`` — ``Store``, ``LocalStore``; the HDFS and
+DBFS variants are descoped with pyspark, see the README).
+
+A Store names where intermediate data, checkpoints and logs live. It has
+no pyspark dependency — the estimator/runner layer passes paths around; IO
+happens with ordinary filesystem calls here.
+"""
+import os
+import shutil
+
+
+class Store:
+    """Abstract artifact store."""
+
+    def get_train_data_path(self, idx=None):
+        raise NotImplementedError
+
+    def get_val_data_path(self, idx=None):
+        raise NotImplementedError
+
+    def get_checkpoint_path(self, run_id):
+        raise NotImplementedError
+
+    def get_logs_path(self, run_id):
+        raise NotImplementedError
+
+    def exists(self, path):
+        raise NotImplementedError
+
+    @staticmethod
+    def create(prefix_path):
+        """Factory (reference parity): local filesystem paths only in this
+        build; hdfs:// / dbfs:// schemes are descoped with pyspark."""
+        for scheme in ("hdfs://", "dbfs://", "s3://", "gs://"):
+            if str(prefix_path).startswith(scheme):
+                raise NotImplementedError(
+                    f"{scheme} stores are descoped in this build (see "
+                    f"README); use a local/NFS path")
+        return LocalStore(prefix_path)
+
+
+class LocalStore(Store):
+    """Store rooted at a local (or NFS-mounted) directory."""
+
+    def __init__(self, prefix_path):
+        self.prefix_path = os.path.abspath(str(prefix_path))
+        os.makedirs(self.prefix_path, exist_ok=True)
+
+    def _sub(self, *parts):
+        # Every store path is a directory (parquet datasets, checkpoint
+        # dirs, log dirs) — create it so indexed and un-indexed variants
+        # behave identically for writers.
+        p = os.path.join(self.prefix_path, *parts)
+        os.makedirs(p, exist_ok=True)
+        return p
+
+    def get_train_data_path(self, idx=None):
+        return self._sub("intermediate_train_data" +
+                         (f".{idx}" if idx is not None else ""))
+
+    def get_val_data_path(self, idx=None):
+        return self._sub("intermediate_val_data" +
+                         (f".{idx}" if idx is not None else ""))
+
+    def get_checkpoint_path(self, run_id):
+        return self._sub("runs", str(run_id), "checkpoint")
+
+    def get_logs_path(self, run_id):
+        return self._sub("runs", str(run_id), "logs")
+
+    def exists(self, path):
+        return os.path.exists(path)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.unlink(path)

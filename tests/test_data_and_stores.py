@@ -1,0 +1,103 @@
+"""Data-loader base + Spark store/shim tests (reference:
+horovod/data/data_loader_base.py; horovod/spark/common/store.py;
+test/single/test_spark.py local-mode pieces — pyspark is absent here, so
+run() is tested for its gating only; see README descope note)."""
+
+import time
+
+import pytest
+
+from horovod_tpu.data import AsyncDataLoaderMixin, BaseDataLoader
+from horovod_tpu.spark.store import LocalStore, Store
+
+
+class _RangeLoader(BaseDataLoader):
+    def __init__(self, n, fail_at=None, delay=0.0):
+        self.n, self.fail_at, self.delay = n, fail_at, delay
+
+    def __len__(self):
+        return self.n
+
+    def _iterate(self):
+        for i in range(self.n):
+            if self.fail_at is not None and i == self.fail_at:
+                raise RuntimeError("loader exploded")
+            if self.delay:
+                time.sleep(self.delay)
+            yield i
+
+
+class _AsyncRangeLoader(AsyncDataLoaderMixin, _RangeLoader):
+    pass
+
+
+def test_base_loader_iterates():
+    assert list(_RangeLoader(5)) == [0, 1, 2, 3, 4]
+    assert len(_RangeLoader(5)) == 5
+
+
+def test_async_loader_matches_sync_and_overlaps():
+    loader = _AsyncRangeLoader(8, delay=0.01, num_prefetch_batches=4)
+    assert list(loader) == list(range(8))
+    # sync fallback
+    assert list(_AsyncRangeLoader(4, async_loading=False)) == [0, 1, 2, 3]
+
+
+def test_async_loader_surfaces_producer_error():
+    loader = _AsyncRangeLoader(8, fail_at=3)
+    got = []
+    with pytest.raises(RuntimeError, match="loader exploded"):
+        for x in loader:
+            got.append(x)
+    assert got == [0, 1, 2]
+
+
+def test_local_store_paths(tmp_path):
+    store = Store.create(str(tmp_path / "artifacts"))
+    assert isinstance(store, LocalStore)
+    ckpt = store.get_checkpoint_path("run1")
+    logs = store.get_logs_path("run1")
+    assert store.exists(ckpt) and store.exists(logs)
+    assert ckpt != logs
+    assert store.get_train_data_path() != store.get_val_data_path()
+    store.delete(ckpt)
+    assert not store.exists(ckpt)
+
+
+def test_remote_store_schemes_descoped(tmp_path):
+    with pytest.raises(NotImplementedError, match="descoped"):
+        Store.create("hdfs://nn/path")
+
+
+def test_spark_run_gated_without_pyspark():
+    try:
+        import pyspark  # noqa: F401
+    except ImportError:
+        import horovod_tpu.spark as hs
+
+        with pytest.raises(ImportError, match="pyspark.*not.*installed"):
+            hs.run(lambda: None, num_proc=1)
+    else:
+        pytest.skip("pyspark present; run() exercised elsewhere")
+
+
+def test_async_loader_abandoned_consumer_stops_producer():
+    """Breaking out of iteration must release the producer thread (it
+    must not stay blocked on a full queue holding batches forever)."""
+    import threading
+
+    before = threading.active_count()
+    loader = _AsyncRangeLoader(1000, num_prefetch_batches=1)
+    for i, _ in enumerate(loader):
+        if i == 2:
+            break
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.02)
+    assert threading.active_count() <= before, "producer thread leaked"
+
+
+def test_local_store_indexed_paths_are_directories(tmp_path):
+    store = LocalStore(str(tmp_path))
+    p = store.get_train_data_path(0)
+    assert store.exists(p)
