@@ -96,7 +96,7 @@ def _bench_resnet50():
 
     dev = jax.devices()[0]
     on_cpu = dev.platform == "cpu"
-    batch = 32 if on_cpu else 128
+    batch = int(os.environ.get("HVD_BENCH_BATCH", 32 if on_cpu else 128))
     image = 128 if on_cpu else 224
     steps = 3 if on_cpu else 30
     warmup = 1 if on_cpu else 5

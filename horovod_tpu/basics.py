@@ -15,11 +15,21 @@ import os
 import subprocess
 
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
-_LIB_PATH = os.path.join(_PKG_DIR, "lib", "libhvd_tpu.so")
+# HVD_LIB overrides the library to load (e.g. the TSAN build
+# libhvd_tpu_tsan.so from `make tsan`; see tests/test_tsan.py).
+_LIB_PATH = os.environ.get(
+    "HVD_LIB", os.path.join(_PKG_DIR, "lib", "libhvd_tpu.so"))
 _CSRC_DIR = os.path.join(_PKG_DIR, "csrc")
 
 
 def _maybe_build():
+    if "HVD_LIB" in os.environ:
+        # Explicit override (e.g. the TSAN build): the caller built it via
+        # its own make target — the default `make` heuristic below would
+        # rebuild the WRONG target and then load the override stale.
+        if not os.path.exists(_LIB_PATH):
+            raise ImportError(f"HVD_LIB={_LIB_PATH} does not exist")
+        return
     if os.path.isdir(_CSRC_DIR):
         srcs = [
             os.path.join(_CSRC_DIR, f)
