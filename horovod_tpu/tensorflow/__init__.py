@@ -228,10 +228,14 @@ def broadcast_variables(variables, root_rank=0):
 
 def DistributedGradientTape(tape, op=Average, compression=None,
                             process_set=0, sparse_as_dense=False,
-                            num_groups=0):
+                            num_groups=0, gradient_predivide_factor=1.0):
     """Wrap tf.GradientTape so gradient() allreduces the results in one
-    fused group (reference: `_DistributedGradientTape`)."""
+    fused group (reference: `_DistributedGradientTape`).
+    ``gradient_predivide_factor`` splits the averaging around the sum
+    (prescale 1/f, postscale f/size); requires op=Average."""
     tf = _tf()
+    if float(gradient_predivide_factor) != 1.0 and op != Average:
+        raise ValueError("gradient_predivide_factor requires op=Average")
 
     class _Wrapped:
         def __init__(self, tape):
@@ -252,9 +256,10 @@ def DistributedGradientTape(tape, op=Average, compression=None,
                 if isinstance(g, tf.IndexedSlices):
                     g = tf.convert_to_tensor(g)  # sparse_as_dense default
                 dense.append(g)
-            outs = _grouped_np(dense, op=op, name="tape.grads",
-                               process_set=process_set,
-                               compression=compression)
+            outs = _grouped_np(
+                dense, op=op, name="tape.grads", process_set=process_set,
+                compression=compression,
+                gradient_predivide_factor=gradient_predivide_factor)
             for j, i in enumerate(idx):
                 flat[i] = outs[j]
             return tf.nest.pack_sequence_as(grads, flat)
@@ -262,22 +267,32 @@ def DistributedGradientTape(tape, op=Average, compression=None,
     return _Wrapped(tape)
 
 
-def _grouped_np(tensors, op, name, process_set, compression):
+def _grouped_np(tensors, op, name, process_set, compression,
+                gradient_predivide_factor=1.0):
     """Fused grouped allreduce of dense tf tensors; eager direct, traced
     via tf.py_function (the collective still runs on the core's background
-    thread — the analog of the reference's AsyncOpKernel enqueue)."""
+    thread — the analog of the reference's AsyncOpKernel enqueue).
+
+    The predivide factors are computed INSIDE the callback, i.e. at
+    execution time: a tf.function trace must not bake in the current
+    world size, or an elastic resize would silently keep the old
+    postscale."""
     tf = _tf()
 
     def np_fn(*arrs):
         arrs = [a.numpy() if hasattr(a, "numpy") else np.asarray(a)
                 for a in arrs]
+        eff_op, pre, post = _core.predivide_factors(
+            op, gradient_predivide_factor, process_set)
         ctxs = []
         if compression is not None:
             pairs = [compression.compress(a) for a in arrs]
             arrs = [p[0] for p in pairs]
             ctxs = [p[1] for p in pairs]
-        outs = _core.grouped_allreduce(arrs, op=op, name=name,
-                                       process_set=process_set)
+        outs = _core.grouped_allreduce(arrs, op=eff_op, name=name,
+                                       process_set=process_set,
+                                       prescale_factor=pre,
+                                       postscale_factor=post)
         if compression is not None:
             outs = [compression.decompress(o, c)
                     for o, c in zip(outs, ctxs)]
@@ -294,9 +309,11 @@ def _grouped_np(tensors, op, name, process_set, compression):
 
 def DistributedOptimizer(optimizer, op=Average, compression=None,
                          process_set=0, backward_passes_per_step=1,
-                         name=None):
+                         name=None, gradient_predivide_factor=1.0):
     """Wrap a Keras optimizer: apply_gradients allreduces first
     (reference: hvd.DistributedOptimizer for tf.keras).
+    ``gradient_predivide_factor`` splits the averaging around the sum
+    (prescale 1/f, postscale f/size); requires op=Average.
 
     ``backward_passes_per_step=N`` enables local gradient aggregation
     (reference: tensorflow/gradient_aggregation.py
@@ -307,6 +324,8 @@ def DistributedOptimizer(optimizer, op=Average, compression=None,
     """
     tf = _tf()
     bpps = int(backward_passes_per_step)
+    if float(gradient_predivide_factor) != 1.0 and op != Average:
+        raise ValueError("gradient_predivide_factor requires op=Average")
 
     class _DistOpt(optimizer.__class__):
         _hvd_wrapped = True
@@ -316,6 +335,8 @@ def DistributedOptimizer(optimizer, op=Average, compression=None,
             idx = [i for i, g in enumerate(grads) if g is not None]
             dense = [tf.convert_to_tensor(grads[i]) for i in idx]
             outs = _grouped_np(dense, op=op, name="opt.grads",
+                               gradient_predivide_factor=(
+                                   gradient_predivide_factor),
                                process_set=process_set,
                                compression=compression)
             grads = list(grads)

@@ -207,11 +207,16 @@ class _DistributedOptimizerMixin:
     add_param_group, LR schedulers all behave."""
 
     def _hvd_init(self, named_parameters, op, compression,
-                  backward_passes_per_step, process_set):
+                  backward_passes_per_step, process_set,
+                  gradient_predivide_factor=1.0):
         self._hvd_op = op
         self._hvd_compression = compression
         self._hvd_bpps = backward_passes_per_step
         self._hvd_process_set = process_set
+        self._hvd_predivide = float(gradient_predivide_factor)
+        if self._hvd_predivide != 1.0 and op != Average:
+            raise ValueError(
+                "gradient_predivide_factor requires op=Average")
         self._hvd_step_count = 0
         self._hvd_handles = {}
         if named_parameters is not None:
@@ -237,10 +242,15 @@ class _DistributedOptimizerMixin:
             a, ctx = self._hvd_compression.compress(a)
         if self._hvd_bpps > 1:
             a = a / self._hvd_bpps
+        # Execution-time factors (shared helper): elastic resizes are
+        # honored and an unknown process set fails loudly.
+        op, pre, post = _core.predivide_factors(
+            self._hvd_op, self._hvd_predivide, self._hvd_process_set)
         h = _core.allreduce_async(
-            a, op=self._hvd_op,
+            a, op=op,
             name=f"allreduce.{self._hvd_names.get(p, id(p))}",
-            process_set=self._hvd_process_set)
+            process_set=self._hvd_process_set,
+            prescale_factor=pre, postscale_factor=post)
         self._hvd_handles[p] = (h, ctx)
 
     def synchronize(self):
@@ -264,16 +274,19 @@ class _DistributedOptimizerMixin:
 
 def DistributedOptimizer(optimizer, named_parameters=None, op=Average,
                          compression=None, backward_passes_per_step=1,
-                         process_set=0):
+                         process_set=0, gradient_predivide_factor=1.0):
     """Wrap a torch optimizer: backward hooks launch async allreduces per
     gradient (overlapped with the rest of backward); step() synchronizes
-    then applies (reference: horovod/torch DistributedOptimizer)."""
+    then applies (reference: horovod/torch DistributedOptimizer).
+    ``gradient_predivide_factor`` splits the averaging around the sum
+    (prescale 1/f, postscale f/size); requires op=Average."""
     cls = type("DistributedOptimizer",
                (_DistributedOptimizerMixin, optimizer.__class__), {})
     dist = cls.__new__(cls)
     dist.__dict__.update(optimizer.__dict__)
     dist._hvd_init(named_parameters, op, compression,
-                   backward_passes_per_step, process_set)
+                   backward_passes_per_step, process_set,
+                   gradient_predivide_factor)
     return dist
 
 

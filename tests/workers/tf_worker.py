@@ -158,5 +158,14 @@ sched_cb.set_model(cb_model)
 sched_cb.on_epoch_begin(5)
 assert abs(float(cb_model.optimizer.learning_rate.numpy()) - 0.04) < 1e-6
 
+# gradient_predivide_factor on the tape: must equal plain Average
+v_pd = tf.Variable(tf.ones((3,)) * (r + 1.0))
+with tf.GradientTape() as t_pd:
+    loss_pd = tf.reduce_sum(v_pd * v_pd)
+tape_pd = hvd.DistributedGradientTape(t_pd, gradient_predivide_factor=2.0)
+g_pd = tape_pd.gradient(loss_pd, [v_pd])[0]
+expect_pd = np.mean([2.0 * (i + 1) for i in range(s)])
+assert np.allclose(g_pd.numpy(), expect_pd, atol=1e-5), g_pd.numpy()
+
 print(f"rank {r}: TF PASS", flush=True)
 hvd.shutdown()

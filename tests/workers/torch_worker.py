@@ -89,5 +89,18 @@ assert np.allclose(local.grad.numpy(),
 m = hvd.metric_average(float(r), name="m")
 assert abs(m - (s - 1) / 2.0) < 1e-9
 
+# gradient_predivide_factor: (1/f)*sum*(f/size) must equal plain Average
+model_pd = torch.nn.Linear(4, 1, bias=False)
+for q in model_pd.parameters():
+    q.data.fill_(0.5)
+opt_pd = hvd.DistributedOptimizer(torch.optim.SGD(model_pd.parameters(), lr=0.1),
+                                  gradient_predivide_factor=2.0)
+x_pd = torch.full((2, 4), float(r + 1))
+model_pd(x_pd).sum().backward()
+opt_pd.synchronize()
+g = model_pd.weight.grad.numpy()
+expect = np.mean([2 * (i + 1) for i in range(s)])  # avg over ranks of sum_b x
+assert np.allclose(g, expect, atol=1e-5), (g, expect)
+
 print(f"rank {r}: TORCH PASS", flush=True)
 hvd.shutdown()
