@@ -1,0 +1,62 @@
+"""Elastic training demo (reference: docs/elastic.rst usage pattern +
+test/integration elastic drivers): state commits every epoch; membership
+changes sync from rank 0; failures roll back to the last commit.
+
+Run: tpurun --min-np 1 --max-np 4 --host-discovery-script ./d.sh \
+         python examples/elastic_train.py
+where d.sh prints "localhost:N" (edit N while the job runs to resize).
+"""
+import os
+
+import numpy as np
+
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu.jax as hvd
+
+hvd.init()
+
+DIM = int(os.environ.get("DIM", 32))
+EPOCHS = int(os.environ.get("EPOCHS", 10))
+
+rng = np.random.default_rng(0)
+params = {"w": jnp.asarray(rng.normal(0, 0.1, (DIM, 1)), jnp.float32)}
+tx = optax.sgd(0.05)
+state = hvd.elastic.JaxState(params=params, opt_state=tx.init(params),
+                             epoch=0)
+
+
+@hvd.elastic.run
+def train(state):
+    import jax
+
+    def loss_fn(p, x, y):
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    @jax.jit
+    def local_step(p, o, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        updates, o = tx.update(g, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    while state.epoch < EPOCHS:
+        r, s = hvd.rank(), hvd.size()
+        data = np.random.default_rng(state.epoch).normal(
+            size=(64, DIM)).astype(np.float32)
+        x = jnp.asarray(data[r::s])
+        y = jnp.asarray((data[r::s] @ np.ones((DIM, 1), np.float32)))
+        p, o, loss = local_step(state.params, state.opt_state, x, y)
+        # average the update across the CURRENT membership via the core
+        state.params = hvd.allreduce_pytree(p, op=hvd.Average,
+                                            name=f"sync.{state.epoch}")
+        state.opt_state = o
+        state.epoch += 1
+        state.commit()
+        if r == 0:
+            print(f"epoch {state.epoch}: ranks={s} "
+                  f"loss={float(loss):.5f}", flush=True)
+
+
+train(state)
+hvd.shutdown()

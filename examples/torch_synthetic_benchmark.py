@@ -1,0 +1,43 @@
+"""PyTorch synthetic benchmark (reference:
+examples/pytorch/pytorch_synthetic_benchmark.py): hook-based
+DistributedOptimizer overlaps gradient allreduce with backward.
+
+Run: tpurun -np 4 python examples/torch_synthetic_benchmark.py
+"""
+import os
+import time
+
+import torch
+
+import horovod_tpu.torch as hvd
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+BATCH = int(os.environ.get("BATCH", 32))
+STEPS = int(os.environ.get("STEPS", 20))
+DIM = int(os.environ.get("DIM", 128))
+
+torch.manual_seed(0)
+model = torch.nn.Sequential(
+    torch.nn.Linear(DIM, DIM), torch.nn.ReLU(), torch.nn.Linear(DIM, 1))
+hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+opt = hvd.DistributedOptimizer(
+    torch.optim.SGD(model.parameters(), lr=0.01),
+    named_parameters=model.named_parameters())
+
+torch.manual_seed(r)
+x = torch.randn(BATCH, DIM)
+y = torch.randn(BATCH, 1)
+
+t0 = time.perf_counter()
+for _ in range(STEPS):
+    opt.zero_grad()
+    loss = torch.nn.functional.mse_loss(model(x), y)
+    loss.backward()
+    opt.step()
+dt = time.perf_counter() - t0
+if r == 0:
+    print(f"{s} ranks: {BATCH * STEPS * s / dt:.1f} samples/sec total "
+          f"(loss {loss.item():.4f})")
+hvd.shutdown()

@@ -245,26 +245,35 @@ def broadcast(tensor, root_rank, name=None, process_set=0):
     return synchronize(broadcast_async(tensor, root_rank, name, process_set))
 
 
-def predivide_factors(op, gradient_predivide_factor, process_set=0):
-    """Reference semantics (horovod gradient_predivide_factor): with
-    op=Average, split the 1/size averaging into prescale=1/f before the
-    sum and postscale=f/size after. Returns ``(eff_op, pre, post)``.
+def validate_predivide(op, gradient_predivide_factor):
+    """Construction-time validation for ``gradient_predivide_factor`` —
+    the ONE copy every binding calls, so a future relaxation can't
+    silently diverge between frontends."""
+    f = float(gradient_predivide_factor)
+    if f == 1.0:
+        return
+    if op != Average:
+        raise ValueError("gradient_predivide_factor requires op=Average")
+    if f <= 0.0:
+        raise ValueError(
+            f"gradient_predivide_factor must be > 0, got {f}")
 
-    The ONE implementation every binding calls, at EXECUTION time — the
-    process-set size is read per call, so elastic resizes are honored and
-    a dead/unknown set fails loudly instead of scaling by a -1 sentinel.
+
+def predivide_factors(op, gradient_predivide_factor, process_set=0):
+    """Reference semantics (horovod gradient_predivide_factor): split the
+    averaging into prescale=1/f before the reduction and f back out after
+    it. Returns ``(eff_op, pre, post)``.
+
+    The op STAYS Average: the core divides by the member count it reads
+    from the negotiated response at collective-execution time, so the
+    factor can never bake in a stale world size across elastic resizes —
+    no Python-side size query at all.
     """
+    validate_predivide(op, gradient_predivide_factor)
     f = float(gradient_predivide_factor)
     if f == 1.0:
         return op, 1.0, 1.0
-    if op != Average:
-        raise ValueError("gradient_predivide_factor requires op=Average")
-    n = _lib.hvd_process_set_size(int(process_set))
-    if n <= 0:
-        raise RuntimeError(
-            f"process set {process_set} unknown or core not initialized "
-            f"(size={n}); cannot apply gradient_predivide_factor")
-    return Sum, 1.0 / f, f / n
+    return op, 1.0 / f, f
 
 
 def metric_average(value, name=None, process_set=0):

@@ -234,8 +234,7 @@ def DistributedGradientTape(tape, op=Average, compression=None,
     ``gradient_predivide_factor`` splits the averaging around the sum
     (prescale 1/f, postscale f/size); requires op=Average."""
     tf = _tf()
-    if float(gradient_predivide_factor) != 1.0 and op != Average:
-        raise ValueError("gradient_predivide_factor requires op=Average")
+    _core.validate_predivide(op, gradient_predivide_factor)
 
     class _Wrapped:
         def __init__(self, tape):
@@ -324,8 +323,7 @@ def DistributedOptimizer(optimizer, op=Average, compression=None,
     """
     tf = _tf()
     bpps = int(backward_passes_per_step)
-    if float(gradient_predivide_factor) != 1.0 and op != Average:
-        raise ValueError("gradient_predivide_factor requires op=Average")
+    _core.validate_predivide(op, gradient_predivide_factor)
 
     class _DistOpt(optimizer.__class__):
         _hvd_wrapped = True

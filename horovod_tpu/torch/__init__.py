@@ -214,9 +214,7 @@ class _DistributedOptimizerMixin:
         self._hvd_bpps = backward_passes_per_step
         self._hvd_process_set = process_set
         self._hvd_predivide = float(gradient_predivide_factor)
-        if self._hvd_predivide != 1.0 and op != Average:
-            raise ValueError(
-                "gradient_predivide_factor requires op=Average")
+        _core.validate_predivide(op, self._hvd_predivide)
         self._hvd_step_count = 0
         self._hvd_handles = {}
         if named_parameters is not None:

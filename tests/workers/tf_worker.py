@@ -167,5 +167,33 @@ g_pd = tape_pd.gradient(loss_pd, [v_pd])[0]
 expect_pd = np.mean([2.0 * (i + 1) for i in range(s)])
 assert np.allclose(g_pd.numpy(), expect_pd, atol=1e-5), g_pd.numpy()
 
+# ...and through a tf.function trace (the py_function path): the factors
+# must be computed at EXECUTION time, never baked into the trace.
+v_pg = tf.Variable(tf.ones((3,)) * (r + 1.0))
+
+@tf.function
+def pd_graph_step():
+    with tf.GradientTape() as t_g:
+        loss_g = tf.reduce_sum(v_pg * v_pg)
+    tape_g = hvd.DistributedGradientTape(t_g, gradient_predivide_factor=2.0)
+    return tape_g.gradient(loss_g, [v_pg])[0]
+
+g_pg = pd_graph_step()
+assert np.allclose(g_pg.numpy(), expect_pd, atol=1e-5), g_pg.numpy()
+
+# invalid factors fail at construction, not mid-backward
+try:
+    hvd.DistributedGradientTape(tf.GradientTape(), op=hvd.Sum,
+                                gradient_predivide_factor=2.0)
+    raise SystemExit("expected ValueError (op=Sum)")
+except ValueError:
+    pass
+try:
+    hvd.DistributedGradientTape(tf.GradientTape(),
+                                gradient_predivide_factor=0.0)
+    raise SystemExit("expected ValueError (f=0)")
+except ValueError:
+    pass
+
 print(f"rank {r}: TF PASS", flush=True)
 hvd.shutdown()
