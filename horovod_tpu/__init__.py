@@ -48,8 +48,12 @@ from .ops.collective_ops import (  # noqa: F401
     broadcast,
     broadcast_async,
     broadcast_object,
+    grouped_allgather,
+    grouped_allgather_async,
     grouped_allreduce,
     grouped_allreduce_async,
+    grouped_reducescatter,
+    grouped_reducescatter_async,
     join,
     poll,
     reducescatter,

@@ -78,7 +78,9 @@ _lib.hvd_allreduce_async.argtypes = [
     c_double, c_double, c_int, c_int, c_int,
 ]
 _lib.hvd_allgather_async.restype = c_int
-_lib.hvd_allgather_async.argtypes = [c_char_p, c_void_p, P_int64, c_int, c_int, c_int]
+_lib.hvd_allgather_async.argtypes = [
+    c_char_p, c_void_p, P_int64, c_int, c_int, c_int, c_int, c_int,
+]
 _lib.hvd_broadcast_async.restype = c_int
 _lib.hvd_broadcast_async.argtypes = [
     c_char_p, c_void_p, c_void_p, P_int64, c_int, c_int, c_int, c_int,
@@ -89,7 +91,8 @@ _lib.hvd_alltoall_async.argtypes = [
 ]
 _lib.hvd_reducescatter_async.restype = c_int
 _lib.hvd_reducescatter_async.argtypes = [
-    c_char_p, c_void_p, P_int64, c_int, c_int, c_int, c_double, c_double, c_int,
+    c_char_p, c_void_p, P_int64, c_int, c_int, c_int, c_double, c_double,
+    c_int, c_int, c_int,
 ]
 _lib.hvd_join_async.restype = c_int
 _lib.hvd_join_async.argtypes = [c_char_p, c_int]

@@ -275,6 +275,11 @@ struct Response {
   std::vector<std::vector<int64_t>> per_rank_meta;
   std::vector<std::vector<int64_t>> shapes;  // canonical shape per tensor
   int32_t new_process_set_id = -1;           // AddProcessSet result
+  // Member of an atomic group (group_table path). Carried on the wire so
+  // EVERY replica skips response-cache insertion identically — a rank-
+  // local decision (e.g. from its own Request) would desynchronize cache
+  // bit positions between owners and joined ranks.
+  uint8_t grouped = 0;
 
   void serialize(Writer& w) const {
     w.u8((uint8_t)op_type);
@@ -292,6 +297,7 @@ struct Response {
     w.u32((uint32_t)shapes.size());
     for (auto& v : shapes) w.i64vec(v);
     w.i32(new_process_set_id);
+    w.u8(grouped);
   }
   static Response deserialize(Reader& r) {
     Response s;
@@ -313,6 +319,7 @@ struct Response {
     s.shapes.resize(k);
     for (uint32_t i = 0; i < k; i++) s.shapes[i] = r.i64vec();
     s.new_process_set_id = r.i32();
+    s.grouped = r.u8();
     return s;
   }
 };

@@ -91,6 +91,7 @@ Response Coordinator::BuildResponse(const std::string& name,
   resp.process_set = first.process_set;
   resp.prescale = first.prescale;
   resp.postscale = first.postscale;
+  resp.grouped = first.group_id >= 0 ? 1 : 0;
 
   auto error = [&](const std::string& msg) {
     resp.error = msg;

@@ -516,7 +516,11 @@ void CacheFilterRequests(RequestList& mine) {
   std::vector<Request> keep;
   for (auto& q : mine.requests) {
     uint32_t pos = 0;
-    if (!CacheableOp(q.op_type)) {
+    // Grouped members always take full negotiation: a cache hit would
+    // bypass the controller's group table, so an LRU eviction of SOME
+    // members would strand the rest in pending_groups_ forever (group
+    // count never reached -> stall shutdown).
+    if (!CacheableOp(q.op_type) || q.group_id >= 0) {
       keep.push_back(std::move(q));
       continue;
     }
@@ -608,8 +612,11 @@ void ProcessResponseList(ResponseList& rl) {
     for (auto& resp : fused.responses) PerformOperation(resp);
   }
   for (auto& resp : rl.responses) {
+    // resp.grouped: group members never enter the cache (see
+    // CacheFilterRequests) — the flag rides the wire so every replica,
+    // including joined ranks with no local Request, skips identically.
     if (g->cache.enabled() && CacheableOp(resp.op_type) &&
-        resp.error.empty()) {
+        resp.error.empty() && !resp.grouped) {
       for (size_t i = 0; i < resp.names.size(); i++) {
         Response sub = SubResponse(resp, i);
         Request sig;
@@ -1022,9 +1029,10 @@ int hvd_allreduce_async(const char* name, const void* input, void* output,
 
 int hvd_allgather_async(const char* name, const void* input,
                         const int64_t* shape, int ndim, int dtype,
-                        int process_set) {
+                        int process_set, int group_id, int group_size) {
   return Enqueue(OpType::kAllgather, name, input, nullptr, shape, ndim, dtype,
-                 0, 0, process_set, -1, 0, 1.0, 1.0, nullptr, 0);
+                 0, 0, process_set, group_id, group_size, 1.0, 1.0, nullptr,
+                 0);
 }
 
 int hvd_broadcast_async(const char* name, const void* input, void* output,
@@ -1044,10 +1052,10 @@ int hvd_alltoall_async(const char* name, const void* input,
 int hvd_reducescatter_async(const char* name, const void* input,
                             const int64_t* shape, int ndim, int dtype,
                             int red_op, double prescale, double postscale,
-                            int process_set) {
+                            int process_set, int group_id, int group_size) {
   return Enqueue(OpType::kReducescatter, name, input, nullptr, shape, ndim,
-                 dtype, red_op, 0, process_set, -1, 0, prescale, postscale,
-                 nullptr, 0);
+                 dtype, red_op, 0, process_set, group_id, group_size,
+                 prescale, postscale, nullptr, 0);
 }
 
 int hvd_join_async(const char* name, int process_set) {

@@ -171,6 +171,7 @@ inline Response SubResponse(const Response& r, size_t i) {
   s.process_set = r.process_set;
   s.prescale = r.prescale;
   s.postscale = r.postscale;
+  s.grouped = r.grouped;
   if (i < r.shapes.size()) s.shapes = {r.shapes[i]};
   if (i < r.per_rank_meta.size()) s.per_rank_meta = {r.per_rank_meta[i]};
   return s;

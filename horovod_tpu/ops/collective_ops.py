@@ -184,20 +184,28 @@ def allreduce(tensor, op=Average, name=None, prescale_factor=1.0,
                                        postscale_factor, process_set))
 
 
+def _grouped(kind, name, tensors, enqueue_one):
+    """Shared atomic-group fan-out: allocate one group id, derive member
+    names, enqueue each tensor with (gid, len). `enqueue_one(t, name,
+    group)` does the per-op enqueue."""
+    with _lock:
+        gid = _group_counter[0]
+        _group_counter[0] += 1
+    base = _auto_name(kind, name)
+    group = (gid, len(tensors))
+    return [enqueue_one(t, f"{base}.{i}", group)
+            for i, t in enumerate(tensors)]
+
+
 def grouped_allreduce_async(tensors, op=Average, name=None, process_set=0,
                             prescale_factor=1.0, postscale_factor=1.0):
     """Negotiate and fuse `tensors` as one atomic group (reference:
     grouped_allreduce / group_table.cc)."""
-    with _lock:
-        gid = _group_counter[0]
-        _group_counter[0] += 1
-    base = _auto_name("grouped_allreduce", name)
-    return [
-        allreduce_async(t, op, f"{base}.{i}", prescale_factor,
-                        postscale_factor, process_set,
-                        _group=(gid, len(tensors)))
-        for i, t in enumerate(tensors)
-    ]
+    return _grouped(
+        "grouped_allreduce", name, tensors,
+        lambda t, n, grp: allreduce_async(
+            t, op, n, prescale_factor, postscale_factor, process_set,
+            _group=grp))
 
 
 def grouped_allreduce(tensors, op=Average, name=None, process_set=0,
@@ -209,7 +217,7 @@ def grouped_allreduce(tensors, op=Average, name=None, process_set=0,
 # ---------------------------------------------------------------------------
 # Allgather
 
-def allgather_async(tensor, name=None, process_set=0):
+def allgather_async(tensor, name=None, process_set=0, _group=(-1, 0)):
     arr = np.ascontiguousarray(tensor)
     if arr.ndim == 0:
         arr = arr.reshape(1)
@@ -217,12 +225,26 @@ def allgather_async(tensor, name=None, process_set=0):
     shape, ndim = _shape_arg(arr)
     h = _check_handle(_lib.hvd_allgather_async(
         name.encode(), _ptr(arr), shape, ndim, _dtype_code(arr),
-        int(process_set)))
+        int(process_set), _group[0], _group[1]))
     return _register(Handle(h, "allgather", (arr,), None, arr.dtype, name))
 
 
 def allgather(tensor, name=None, process_set=0):
     return synchronize(allgather_async(tensor, name, process_set))
+
+
+def grouped_allgather_async(tensors, name=None, process_set=0):
+    """Negotiate `tensors` as one atomic group (reference:
+    grouped_allgather): all members are released in the same cycle. (Only
+    allreduce responses are additionally FUSED into one wire collective;
+    other ops execute per tensor after the atomic release.)"""
+    return _grouped(
+        "grouped_allgather", name, tensors,
+        lambda t, n, grp: allgather_async(t, n, process_set, _group=grp))
+
+
+def grouped_allgather(tensors, name=None, process_set=0):
+    return synchronize(grouped_allgather_async(tensors, name, process_set))
 
 
 # ---------------------------------------------------------------------------
@@ -344,7 +366,7 @@ def alltoall(tensor, splits=None, name=None, process_set=0):
 # Reducescatter
 
 def reducescatter_async(tensor, op=Average, name=None, prescale_factor=1.0,
-                        postscale_factor=1.0, process_set=0):
+                        postscale_factor=1.0, process_set=0, _group=(-1, 0)):
     arr = np.ascontiguousarray(tensor)
     if arr.ndim == 0:
         raise ValueError("reducescatter requires a tensor with at least 1 dim")
@@ -352,7 +374,8 @@ def reducescatter_async(tensor, op=Average, name=None, prescale_factor=1.0,
     shape, ndim = _shape_arg(arr)
     h = _check_handle(_lib.hvd_reducescatter_async(
         name.encode(), _ptr(arr), shape, ndim, _dtype_code(arr), int(op),
-        float(prescale_factor), float(postscale_factor), int(process_set)))
+        float(prescale_factor), float(postscale_factor), int(process_set),
+        _group[0], _group[1]))
     return _register(Handle(h, "reducescatter", (arr,), None, arr.dtype, name))
 
 
@@ -360,6 +383,22 @@ def reducescatter(tensor, op=Average, name=None, prescale_factor=1.0,
                   postscale_factor=1.0, process_set=0):
     return synchronize(reducescatter_async(
         tensor, op, name, prescale_factor, postscale_factor, process_set))
+
+
+def grouped_reducescatter_async(tensors, op=Average, name=None,
+                                process_set=0):
+    """Negotiate `tensors` as one atomic group (reference:
+    grouped_reducescatter); same atomic-release (not wire-fused)
+    semantics as grouped_allgather."""
+    return _grouped(
+        "grouped_reducescatter", name, tensors,
+        lambda t, n, grp: reducescatter_async(
+            t, op, n, process_set=process_set, _group=grp))
+
+
+def grouped_reducescatter(tensors, op=Average, name=None, process_set=0):
+    return synchronize(grouped_reducescatter_async(
+        tensors, op, name, process_set))
 
 
 # ---------------------------------------------------------------------------

@@ -164,3 +164,11 @@ def test_control_plane_scales_to_32_ranks(tmp_path):
     # (4x from 8->32) ON TOP of the 4x CPU oversubscription this host
     # already imposes; flat-ish control plane stays well under 8x total.
     assert c32 < max(8 * c8, 0.25), (c8, c32)
+
+
+def test_grouped_ops_bypass_response_cache():
+    """Grouped members must never be cache-signaled: an LRU eviction of
+    SOME members would strand the group in the group table forever. Runs
+    named grouped collectives under HVD_CACHE_CAPACITY=1 churn."""
+    run_worker_job(2, "grouped_cache_worker.py",
+                   extra_env={"HVD_CACHE_CAPACITY": "1"})

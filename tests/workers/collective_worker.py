@@ -26,6 +26,16 @@ assert (rs == 3).all()
 m = np.ones((s * 2 + 1, 4), dtype=np.float32) * (r + 1)
 rsout = hvd.reducescatter(m, op=hvd.Sum)
 assert np.allclose(rsout, sum(range(1, s + 1)))
+# grouped allgather + grouped reducescatter (atomic group negotiation)
+gouts = hvd.grouped_allgather([np.full((r + 1, 2), r, np.float32),
+                               np.full((2,), float(r), np.float32)])
+assert gouts[0].shape == (s * (s + 1) // 2, 2)
+assert gouts[1].shape == (2 * s,)
+routs = hvd.grouped_reducescatter(
+    [np.ones((s * 2, 3), np.float32) * (r + 1),
+     np.ones((s, 1), np.float32) * (r + 1)], op=hvd.Sum)
+assert routs[0].shape == (2, 3) and np.allclose(routs[0], sum(range(1, s + 1)))
+assert routs[1].shape == (1, 1) and np.allclose(routs[1], sum(range(1, s + 1)))
 # grouped allreduce (fusion)
 outs = hvd.grouped_allreduce([np.full(10, float(r), np.float32), np.full(20, 2.0 * r, np.float32)], op=hvd.Sum)
 assert np.allclose(outs[0], sum(range(s)))

@@ -1,0 +1,36 @@
+"""Worker: grouped collectives under response-cache eviction pressure.
+
+Regression for the group x cache interaction: group members bypass the
+cache entirely (CacheFilterRequests skips group_id >= 0; the coordinator
+marks responses `grouped` so no replica inserts them). Before that fix, a
+repeated EXPLICITLY-NAMED group under LRU pressure could have some
+members bit-signaled as hits while others went through the group table —
+the group count never completed and the job stalled to shutdown.
+
+Run with HVD_CACHE_CAPACITY=1 so every cacheable tensor fights for one
+slot (max eviction churn).
+"""
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+
+for step in range(6):
+    # same names every step: cacheable if groups ever entered the cache
+    gouts = hvd.grouped_allgather(
+        [np.full((2, 2), float(r), np.float32),
+         np.full((3,), float(r), np.float32)], name="w")
+    assert gouts[0].shape == (2 * s, 2)
+    routs = hvd.grouped_allreduce(
+        [np.ones(4, np.float32) * (r + 1), np.ones(2, np.float32)],
+        op=hvd.Sum, name="g")
+    assert np.allclose(routs[0], sum(range(1, s + 1)))
+    # interleave a plain cached tensor to churn the 1-slot LRU
+    y = hvd.allreduce(np.ones(3, np.float32), op=hvd.Sum, name="plain")
+    assert np.allclose(y, s)
+
+stats = hvd.cache_stats()
+print(f"rank {r}: grouped-cache PASS {stats}", flush=True)
+hvd.shutdown()
