@@ -16,7 +16,7 @@ import uuid
 
 from .. import http_server, network, util
 from ..hosts import HostInfo, get_host_assignments, is_local
-from ..local import find_free_port
+from ..local import find_free_port, maybe_bind_tpu_chip
 from .discovery import FixedHosts, HostDiscoveryScript
 
 DISCOVERY_INTERVAL_S = 1.0
@@ -85,6 +85,10 @@ class ElasticDriver:
         env = dict(os.environ)
         env.update(self.extra_env)
         env["HVD_ELASTIC"] = "1"
+        # Pin the chip by per-host slot index at SPAWN time (libtpu
+        # initializes at import, before the epoch assigns local_rank; the
+        # slot index is the stable per-host analog).
+        maybe_bind_tpu_chip(env, slot)
         rdv_host = "127.0.0.1" if is_local(hostname) else _my_addr([hostname])
         env["HVD_RENDEZVOUS_ADDR"] = f"{rdv_host}:{self.rdv_port}"
         env["HVD_RENDEZVOUS_SECRET"] = self.secret.hex()
@@ -109,7 +113,7 @@ class ElasticDriver:
             # is visible to every local user (ps) on both hosts.
             cmd = get_remote_command(s, self.command, {
                 k: v for k, v in env.items()
-                if k.startswith(("HVD_", "PYTHONPATH", "PATH"))},
+                if k.startswith(("HVD_", "PYTHONPATH", "PATH", "TPU_"))},
                 ssh_port=self.ssh_port,
                 stdin_env=("HVD_RENDEZVOUS_SECRET",))
             proc = util.safe_exec(["/bin/sh", "-c", cmd],

@@ -20,7 +20,7 @@ import shlex
 import sys
 
 from . import config_parser, hosts as hosts_mod, util
-from .local import find_free_port, slot_env
+from .local import find_free_port, maybe_bind_tpu_chip, slot_env
 from .util import safe_exec, terminate
 
 
@@ -165,6 +165,8 @@ def _run_static(args):
                            s.cross_rank, s.cross_size,
                            controller_addr=ctrl, jax_coord_addr=jax_coord,
                            extra_env=extra)
+            # Pin the chip BEFORE libtpu initializes; harmless off-TPU.
+            maybe_bind_tpu_chip(env, s.local_rank)
             if hosts_mod.is_local(s.hostname):
                 procs.append(safe_exec(list(args.command), env=env))
             else:
@@ -172,7 +174,7 @@ def _run_static(args):
 
                 cmd = get_remote_command(s, list(args.command), {
                     k: v for k, v in env.items()
-                    if k.startswith(("HVD_", "PYTHONPATH", "PATH"))
+                    if k.startswith(("HVD_", "PYTHONPATH", "PATH", "TPU_"))
                 }, args.ssh_port, stdin_env=("HVD_RENDEZVOUS_SECRET",))
                 p = safe_exec(["/bin/sh", "-c", cmd],
                               env=dict(os.environ), stdin=subprocess.PIPE)

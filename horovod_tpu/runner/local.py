@@ -17,6 +17,17 @@ import sys
 import time
 
 
+def maybe_bind_tpu_chip(env, index):
+    """One process = one chip (reference: local_rank pins a GPU): set
+    ``TPU_VISIBLE_CHIPS=<index>``, OVERWRITING any inherited value — a
+    launcher-level pin applied to every rank would bind all ranks to the
+    same chip. ``HVD_BIND_TPU_CHIPS=0`` opts out. The ONE implementation
+    every launch path (static, elastic, local) uses."""
+    if os.environ.get("HVD_BIND_TPU_CHIPS", "1") != "0":
+        env["TPU_VISIBLE_CHIPS"] = str(index)
+    return env
+
+
 def find_free_port():
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
         s.bind(("127.0.0.1", 0))
@@ -64,7 +75,7 @@ def run_local(np_, command, env=None, timeout=None, stdout=None,
         for r in range(np_):
             extra = dict(env or {})
             if bind_tpu_chips:
-                extra.setdefault("TPU_VISIBLE_CHIPS", str(r))
+                maybe_bind_tpu_chip(extra, r)
             e = slot_env(r, np_, controller_addr=addr,
                          jax_coord_addr=jax_addr, extra_env=extra)
             procs.append(

@@ -154,6 +154,7 @@ def test_remote_command_negotiated_endpoints_and_stdin_secret(monkeypatch):
         assert "HVD_CONTROLLER_ADDR=negotiate" in sh
         assert "HVD_JAX_COORD_ADDR=negotiate" in sh
         assert "HVD_RENDEZVOUS_ADDR=" in sh
+        assert "TPU_VISIBLE_CHIPS=0" in sh  # chip pin reaches remote hosts
         # the secret must never appear on the command line...
         assert "HVD_RENDEZVOUS_SECRET=" not in sh.replace(
             "read -r HVD_RENDEZVOUS_SECRET", "")
@@ -270,3 +271,43 @@ def test_tpurun_failure_propagates(tmp_path):
     script.write_text("import sys; sys.exit(3)\n")
     rc = run_commandline(["-np", "2", "python", str(script)])
     assert rc != 0
+
+
+def test_tpu_chip_binding(monkeypatch):
+    """tpurun pins TPU_VISIBLE_CHIPS=local_rank per slot (one process =
+    one chip, set before libtpu init); HVD_BIND_TPU_CHIPS=0 opts out."""
+    import horovod_tpu.runner.launch as launch_mod
+
+    def capture(np_):
+        seen = []
+
+        def fake_safe_exec(command, env=None, **kw):
+            seen.append(env)
+
+            class _P:
+                def poll(self):
+                    return 0
+            return _P()
+
+        monkeypatch.setattr(launch_mod, "safe_exec", fake_safe_exec)
+        monkeypatch.setattr(launch_mod, "terminate", lambda p: None)
+        args = launch_mod.parse_args(
+            ["-np", str(np_), "python", "train.py"])
+        assert launch_mod._run_static(args) == 0
+        return seen
+
+    envs = capture(2)
+    assert [e.get("TPU_VISIBLE_CHIPS") for e in envs] == ["0", "1"]
+
+    # an inherited launcher-level pin must be OVERWRITTEN per rank, not
+    # kept (setdefault would bind every rank to the same chip)
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "3")
+    envs = capture(2)
+    assert [e.get("TPU_VISIBLE_CHIPS") for e in envs] == ["0", "1"]
+    monkeypatch.delenv("TPU_VISIBLE_CHIPS")
+
+    monkeypatch.setenv("HVD_BIND_TPU_CHIPS", "0")
+    envs = capture(2)
+    assert all(e.get("TPU_VISIBLE_CHIPS") != "0" or
+               e.get("TPU_VISIBLE_CHIPS") != "1" for e in envs)
+    assert all("TPU_VISIBLE_CHIPS" not in e for e in envs)
