@@ -75,3 +75,36 @@ def test_prescale_postscale():
     out = hvd.allreduce(x, op=hvd.Sum, prescale_factor=0.5,
                         postscale_factor=3.0)
     assert np.allclose(out, 3.0)
+
+
+def test_keras_elastic_callbacks():
+    """Elastic Keras callbacks mutate/commit state at the right hooks
+    (reference: _keras/callbacks.py CommitStateCallback /
+    UpdateBatchStateCallback / UpdateEpochStateCallback)."""
+    pytest.importorskip("tensorflow")
+    from horovod_tpu._keras.callbacks import (
+        CommitStateCallback,
+        UpdateBatchStateCallback,
+        UpdateEpochStateCallback,
+    )
+
+    class _State:
+        def __init__(self):
+            self.commits = 0
+            self.batch = None
+            self.epoch = None
+
+        def commit(self):
+            self.commits += 1
+
+    st = _State()
+    commit_cb = CommitStateCallback(st, batches_per_commit=2)
+    batch_cb = UpdateBatchStateCallback(st)
+    epoch_cb = UpdateEpochStateCallback(st)
+    for b in range(4):
+        batch_cb.on_train_batch_end(b)
+        commit_cb.on_train_batch_end(b)
+    epoch_cb.on_epoch_end(3)
+    assert st.commits == 2      # batches 1 and 3 (every 2nd)
+    assert st.batch == 3
+    assert st.epoch == 3
