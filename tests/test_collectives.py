@@ -83,12 +83,13 @@ def test_cached_non_allreduce_overlapping_join_fails_fast():
     run_worker_job(2, "cache_join_worker.py")
 
 
-def test_hierarchical_allreduce_correct_and_saves_cross_bytes():
-    """HVD_HIERARCHICAL_ALLREDUCE on a fake 2x2 pod (reference:
-    NCCLHierarchicalAllreduce): results match the flat ring for sum/avg/
-    fused/odd-length, and each rank's cross-plane wire bytes drop to
-    ~1/local_size of the flat ring's (local reduce-scatter first, so only
-    one shard rides the cross plane)."""
+@pytest.mark.parametrize("np_,local", [(4, 2), (8, 4)])
+def test_hierarchical_allreduce_correct_and_saves_cross_bytes(np_, local):
+    """HVD_HIERARCHICAL_ALLREDUCE on fake pods (2 hosts x `local` ranks;
+    reference: NCCLHierarchicalAllreduce): results match the flat ring
+    for sum/avg/fused/odd-length, and each rank's cross-plane wire bytes
+    drop to ~1/local_size of the flat ring's (local reduce-scatter first,
+    so only one shard rides the cross plane)."""
     import os
     import sys
 
@@ -97,32 +98,35 @@ def test_hierarchical_allreduce_correct_and_saves_cross_bytes():
     from .util import WORKERS, _REPO
 
     def run(hier):
-        out_path = f"/tmp/hier_{os.getpid()}_{hier}.log"
+        out_path = f"/tmp/hier_{os.getpid()}_{np_}_{hier}.log"
         env = {"PYTHONPATH": _REPO, "JAX_PLATFORMS": "cpu",
+               "HIER_LOCAL_SIZE": str(local),
                "HVD_HIERARCHICAL_ALLREDUCE": str(hier)}
         with open(out_path, "w") as f:
             codes = run_local(
-                4, [sys.executable, os.path.join(WORKERS, "hier_worker.py")],
-                env=env, timeout=120, stdout=f)
+                np_,
+                [sys.executable, os.path.join(WORKERS, "hier_worker.py")],
+                env=env, timeout=180, stdout=f)
         with open(out_path) as f:
             out = f.read()
         os.unlink(out_path)
-        assert codes == [0] * 4, out
+        assert codes == [0] * np_, out
         tx = {}
         for line in out.splitlines():
             if line.startswith("HIERTX"):
                 parts = dict(kv.split("=") for kv in line.split()[1:])
                 tx[int(parts["rank"])] = int(parts["cross"])
-        assert len(tx) == 4, out
+        assert len(tx) == np_, out
         return tx
 
     flat = run(0)
     hier = run(1)
     # Flat ring: the worst rank ships every byte it forwards across the
-    # "host" boundary; hierarchical: only the owned 1/local_size shard does.
+    # "host" boundary; hierarchical: only the owned 1/local_size shard
+    # does. Expect roughly a local_size-fold drop; assert half that.
     worst_flat = max(flat.values())
     worst_hier = max(hier.values())
-    assert worst_hier * 2 < worst_flat, (flat, hier)
+    assert worst_hier * (local / 2 + 1) < worst_flat, (flat, hier)
 
 
 @pytest.mark.parametrize("np_", [2, 3])

@@ -11,12 +11,16 @@ wire traffic (expected drop: ~1/local_size per rank).
 import os
 
 r = int(os.environ["HVD_RANK"])
-# Fake 2-host topology: ranks are host-major ([0,1] on "host0", [2,3] on
-# "host1"), matching the launcher's host-major slot assignment.
-os.environ["HVD_LOCAL_RANK"] = str(r % 2)
-os.environ["HVD_LOCAL_SIZE"] = "2"
-os.environ["HVD_CROSS_RANK"] = str(r // 2)
-os.environ["HVD_CROSS_SIZE"] = "2"
+_s = int(os.environ["HVD_SIZE"])
+# Fake multi-host topology: ranks are host-major (first L on "host0",
+# next L on "host1", ...), matching the launcher's host-major slot
+# assignment. L via HIER_LOCAL_SIZE (default 2: the 2x2 pod).
+L = int(os.environ.get("HIER_LOCAL_SIZE", "2"))
+assert _s % L == 0, (_s, L)
+os.environ["HVD_LOCAL_RANK"] = str(r % L)
+os.environ["HVD_LOCAL_SIZE"] = str(L)
+os.environ["HVD_CROSS_RANK"] = str(r // L)
+os.environ["HVD_CROSS_SIZE"] = str(_s // L)
 
 import numpy as np  # noqa: E402
 
@@ -24,8 +28,9 @@ import horovod_tpu as hvd  # noqa: E402
 
 hvd.init()
 s = hvd.size()
-assert s == 4, "worker is written for 4 ranks"
-host = r // 2
+host = r // L
+SUM = s * (s + 1) // 2  # sum over ranks of (r+1)
+RSUM = s * (s - 1) // 2  # sum over ranks of r
 
 N = 1 << 15  # 32k floats = 128 KiB per tensor
 
@@ -33,18 +38,18 @@ N = 1 << 15  # 32k floats = 128 KiB per tensor
 for it in range(3):
     out = hvd.allreduce(np.full(N, float(r + 1), np.float32), op=hvd.Sum,
                         name="h.sum")
-    assert np.allclose(out, 10.0), out[:4]
+    assert np.allclose(out, float(SUM)), out[:4]
 
 # Average.
 out = hvd.allreduce(np.full(N, float(r + 1), np.float32), op=hvd.Average,
                     name="h.avg")
-assert np.allclose(out, 2.5), out[:4]
+assert np.allclose(out, SUM / s), out[:4]
 
 # Odd length (chunk remainder spread) + distinct per-element data.
 M = (1 << 12) + 3
 x = (np.arange(M, dtype=np.float32) + r * 1000.0)
 out = hvd.allreduce(x, op=hvd.Sum, name="h.odd")
-expect = 4.0 * np.arange(M, dtype=np.float32) + 1000.0 * (0 + 1 + 2 + 3)
+expect = s * np.arange(M, dtype=np.float32) + 1000.0 * RSUM
 assert np.allclose(out, expect), (out[:4], expect[:4])
 
 # Fused pair (two tensors in one cycle ride the fusion buffer).
@@ -55,16 +60,16 @@ hb = hvd.allreduce_async(np.full(123, 2.0 * r, np.float32), op=hvd.Sum,
 from horovod_tpu.ops import collective_ops as ops  # noqa: E402
 
 va, vb = ops.synchronize(ha), ops.synchronize(hb)
-assert np.allclose(va, 0 + 1 + 2 + 3), va[:4]
-assert np.allclose(vb, 2.0 * (0 + 1 + 2 + 3)), vb[:4]
+assert np.allclose(va, float(RSUM)), va[:4]
+assert np.allclose(vb, 2.0 * RSUM), vb[:4]
 
 # Tiny tensor (nelem < local_size falls back to the flat ring).
 out = hvd.allreduce(np.full(1, float(r + 1), np.float32), op=hvd.Sum,
                     name="h.tiny")
-assert np.allclose(out, 10.0), out
+assert np.allclose(out, float(SUM)), out
 
-cross_tx = sum(hvd.peer_tx_bytes(q) for q in range(s) if q // 2 != host)
-local_tx = sum(hvd.peer_tx_bytes(q) for q in range(s) if q // 2 == host
+cross_tx = sum(hvd.peer_tx_bytes(q) for q in range(s) if q // L != host)
+local_tx = sum(hvd.peer_tx_bytes(q) for q in range(s) if q // L == host
                and q != r)
 hvd.shutdown()
 print(f"HIERTX rank={r} cross={cross_tx} local={local_tx}", flush=True)
