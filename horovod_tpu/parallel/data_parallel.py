@@ -20,7 +20,7 @@ from jax import shard_map  # requires jax >= 0.8
 
 
 def make_train_step(loss_fn, tx, mesh, data_axis="data", extra_reduce=None,
-                    jit=True, donate=True):
+                    jit=True, donate=True, accum_steps=1):
     """Build `step(params, opt_state, batch) -> (params, opt_state, loss)`.
 
     - `loss_fn(params, batch) -> scalar loss` written for ONE shard of the
@@ -29,13 +29,56 @@ def make_train_step(loss_fn, tx, mesh, data_axis="data", extra_reduce=None,
       `data_axis`.
     - Gradients are averaged with `lax.pmean` over `data_axis` (the ring
       allreduce analog), the optimizer applies replicated updates.
+    - ``accum_steps=N`` is the compiled-path analog of the reference's
+      ``backward_passes_per_step`` (local gradient aggregation): each
+      device's batch shard is split into N microbatches, gradients
+      accumulate locally via ``lax.scan`` (activation memory drops ~N×),
+      and ONE pmean + update runs per step. The accumulated grads/loss
+      are scaled by 1/N, so the result is identical to the full-shard
+      gradient for a MEAN-type ``loss_fn`` (mean over examples — the
+      usual case). A SUM-type loss changes scale by 1/N under
+      accumulation; normalize inside ``loss_fn`` if you use one.
     """
     axes = (data_axis,) if isinstance(data_axis, str) else tuple(data_axis)
+    accum_steps = int(accum_steps)
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
     def _pmean_all(x):
         for ax in axes:
             x = jax.lax.pmean(x, ax)
         return x
+
+    def _shard_grad(params, batch):
+        if accum_steps == 1:
+            return jax.value_and_grad(loss_fn)(params, batch)
+
+        def split(x):
+            if x.shape[0] % accum_steps != 0:
+                raise ValueError(
+                    f"per-device batch dim0 ({x.shape[0]}) must be "
+                    f"divisible by accum_steps ({accum_steps})")
+            return x.reshape((accum_steps, x.shape[0] // accum_steps)
+                             + x.shape[1:])
+
+        micro = jax.tree.map(split, batch)
+
+        def body(carry, mb):
+            loss_acc, grad_acc = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+            return (loss_acc + loss,
+                    jax.tree.map(jnp.add, grad_acc, grads)), None
+
+        # Accumulators in the loss's / grads' own dtypes: an f32-hardcoded
+        # carry breaks lax.scan's carry-type invariant (e.g. f64 loss
+        # under jax_enable_x64).
+        first = jax.tree.map(lambda x: x[0], micro)
+        loss_shape = jax.eval_shape(loss_fn, params, first)
+        zero = (jnp.zeros(loss_shape.shape, loss_shape.dtype),
+                jax.tree.map(jnp.zeros_like, params))
+        (loss_sum, grad_sum), _ = jax.lax.scan(body, zero, micro)
+        scale = 1.0 / accum_steps
+        return loss_sum * scale, jax.tree.map(lambda g: g * scale, grad_sum)
 
     # Replicated over every mesh axis; batch split on dim0 over data axes.
     rep = P()
@@ -48,7 +91,7 @@ def make_train_step(loss_fn, tx, mesh, data_axis="data", extra_reduce=None,
         check_vma=False,
     )
     def step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        loss, grads = _shard_grad(params, batch)
         grads = jax.tree.map(_pmean_all, grads)
         if extra_reduce is not None:
             grads = extra_reduce(grads)
