@@ -131,7 +131,9 @@ def DistributedOptimizer(tx, op=Average, compression=None, process_set=0,
             if _jops._is_traced(count):
                 raise NotImplementedError(
                     "backward_passes_per_step>1 requires the eager path or "
-                    "calling update() outside jit")
+                    "calling update() outside jit; for compiled SPMD "
+                    "training use parallel.make_train_step(accum_steps=N) "
+                    "— the in-jit local-aggregation equivalent")
             if int(count) % backward_passes_per_step == 0:
                 return do_step(None)
             return skip(None)
