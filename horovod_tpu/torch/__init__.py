@@ -467,3 +467,6 @@ def metric_average(value, name=None):
     """Delegates to the shared core helper (one tensor name across
     frameworks, so mixed-framework jobs negotiate one collective)."""
     return _core.metric_average(value, name=name)
+
+
+from . import elastic  # noqa: E402,F401  (hvd.elastic.TorchState parity)

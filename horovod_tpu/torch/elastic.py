@@ -1,0 +1,14 @@
+"""horovod_tpu.torch.elastic — reference parity:
+``horovod/torch/elastic/__init__.py`` (`TorchState`, `ElasticSampler`,
+`run`) re-exported under the namespace reference users expect
+(``hvd.elastic.TorchState``, ``@hvd.elastic.run``).
+"""
+import sys
+
+from ..elastic import ObjectState, State, run, run_fn  # noqa: F401
+
+# Imported from the tail of torch/__init__.py, by which point these are
+# defined on the (still-initializing) package module.
+_pkg = sys.modules["horovod_tpu.torch"]
+TorchState = _pkg.TorchState
+ElasticSampler = _pkg.ElasticSampler

@@ -221,3 +221,26 @@ def test_elastic_scale_down(tmp_path):
     assert all("size=2" in line for line in finals), \
         f"survivors should finish at size=2:\n{log}\n{out}"
     assert all("iter=14" in line for line in finals), log
+
+
+TORCH_WORKER = os.path.join(os.path.dirname(__file__), "workers",
+                            "elastic_torch_worker.py")
+
+
+def test_elastic_torch_failure_recovery(tmp_path):
+    """Torch binding end-to-end elastic (reference:
+    test/integration/test_elastic_torch.py): a rank dies mid-job;
+    TorchState restores model+optimizer from the last commit, the driver
+    respawns, and every finisher holds identical weights."""
+    marker = tmp_path / "torch-died.marker"
+    rc, log, out = _run_elastic(
+        tmp_path, "localhost:2",
+        {"TEST_ITERS": "8", "TEST_SLEEP": "0.1",
+         "TEST_FAIL_SLOT": "1", "TEST_MARKER": str(marker),
+         "JAX_PLATFORMS": "cpu"},
+        min_np=2, max_np=2, worker=TORCH_WORKER)
+    assert rc == 0, f"job failed rc={rc}\n{out}"
+    assert marker.exists(), "failure was never injected"
+    finals = [line for line in log.splitlines() if line.startswith("final")]
+    assert len(finals) == 2, f"expected 2 finishers:\n{log}\n{out}"
+    assert all("iter=8" in line for line in finals), log

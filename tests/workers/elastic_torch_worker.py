@@ -1,0 +1,83 @@
+"""Torch elastic training worker (reference:
+test/integration/test_elastic_torch.py + data driver scripts): TorchState
+captures model + optimizer, commit() each iteration, restore-on-failure,
+sync-on-membership-change — the torch binding's full elastic loop over
+the shared core.
+
+Env knobs (same contract as elastic_train_worker.py):
+- TEST_ITERS / TEST_SLEEP / TEST_LOG
+- TEST_FAIL_SLOT + TEST_MARKER: slot that os._exit(1)s once at iter 3
+"""
+import os
+import time
+
+import numpy as np
+import torch
+
+import horovod_tpu.torch as hvd
+from horovod_tpu import elastic
+
+hvd.init()
+
+ITERS = int(os.environ.get("TEST_ITERS", "8"))
+SLEEP = float(os.environ.get("TEST_SLEEP", "0.1"))
+FAIL_SLOT = os.environ.get("TEST_FAIL_SLOT")
+MARKER = os.environ.get("TEST_MARKER", "")
+WID = os.environ.get("HVD_WORKER_ID", "?")
+
+
+def _should_die(it):
+    """Key off the STABLE worker id (sibling-worker convention):
+    HVD_LOCAL_RANK is rewritten every rendezvous epoch and could target
+    the wrong process after a membership change."""
+    if FAIL_SLOT is None or not MARKER or os.path.exists(MARKER):
+        return False
+    return it == 3 and WID.startswith(f"localhost-{FAIL_SLOT}-")
+
+torch.manual_seed(0)
+model = torch.nn.Linear(6, 1, bias=False)
+opt = torch.optim.SGD(model.parameters(), lr=0.05)
+state = hvd.elastic.TorchState(model, opt, iteration=0)
+
+X = np.random.default_rng(0).normal(size=(32, 6)).astype(np.float32)
+Y = (X @ np.ones((6, 1), np.float32))
+
+
+@elastic.run
+def train(state):
+    while state.iteration < ITERS:
+        r, s = hvd.rank(), hvd.size()
+        if _should_die(state.iteration):
+            open(MARKER, "w").write("died\n")
+            os._exit(1)
+        xb = torch.from_numpy(X[r::s])
+        yb = torch.from_numpy(Y[r::s])
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(xb), yb)
+        loss.backward()
+        # average grads across the CURRENT membership through the core
+        for p in model.parameters():
+            hvd.allreduce_(p.grad, op=hvd.Average,
+                           name=f"g.{state.iteration}")
+        opt.step()
+        state.iteration += 1
+        state.commit()
+        time.sleep(SLEEP)
+
+
+train(state)
+
+# All survivors end with identical weights (restore/sync kept them lockstep).
+w = model.weight.detach().numpy()
+gathered = hvd.allgather(torch.from_numpy(w.reshape(1, -1)).contiguous(),
+                         name="final.w")
+gw = np.asarray(gathered)
+assert np.allclose(gw, gw[0], atol=1e-6), gw
+
+log = os.environ.get("TEST_LOG")
+if log:
+    with open(log, "a") as f:
+        f.write(f"final rank={hvd.rank()} size={hvd.size()} "
+                f"iter={state.iteration}\n")
+print(f"rank {hvd.rank()}: torch elastic PASS", flush=True)
+hvd.shutdown()
