@@ -395,7 +395,14 @@ def DistributedOptimizer(optimizer, op=Average, compression=None,
 
 # -- elastic ----------------------------------------------------------------
 
+_keras_state_cls = None
+
+
 def _make_keras_state():
+    # Memoized: a fresh class per call breaks isinstance/identity checks.
+    global _keras_state_cls
+    if _keras_state_cls is not None:
+        return _keras_state_cls
     from .. import elastic as _elastic
 
     class TensorFlowKerasState(_elastic.State):
@@ -425,9 +432,19 @@ def _make_keras_state():
             else:
                 object.__setattr__(self, name, value)
 
+        def _opt_vars(self):
+            return list(self.optimizer.variables) \
+                if self.optimizer is not None else []
+
         def save(self):
             self._saved = {
                 "weights": [w.copy() for w in self.model.get_weights()],
+                # Optimizer slots too (momentum/Adam moments): restoring
+                # weights while slots keep post-rollback values makes
+                # ranks apply different updates from the first recovered
+                # step — silent divergence (reference TensorFlowKerasState
+                # captures the optimizer as well).
+                "opt": [v.numpy().copy() for v in self._opt_vars()],
                 "extras": dict(self._extras),
             }
 
@@ -435,20 +452,50 @@ def _make_keras_state():
             if self._saved is None:
                 return
             self.model.set_weights(self._saved["weights"])
+            for v, a in zip(self._opt_vars(), self._saved["opt"]):
+                v.assign(a)
             self._extras = dict(self._saved["extras"])
 
         def sync(self):
             broadcast_variables(self.model.variables, root_rank=0)
+            if self.optimizer is not None:
+                # A respawned worker's optimizer has no slots until its
+                # first apply_gradients; build them so every rank holds
+                # the same variable set, then broadcast as ONE object
+                # (count mismatches fail loudly, not by stalling a
+                # variable-wise broadcast).
+                if (hasattr(self.optimizer, "build")
+                        and not getattr(self.optimizer, "built", True)):
+                    self.optimizer.build(self.model.trainable_variables)
+                vals = broadcast_object(
+                    [v.numpy() for v in self._opt_vars()], root_rank=0,
+                    name="keras_state.opt")
+                mine = self._opt_vars()
+                if len(vals) != len(mine):
+                    raise RuntimeError(
+                        f"optimizer variable count mismatch in elastic "
+                        f"sync: rank 0 has {len(vals)}, this rank has "
+                        f"{len(mine)}")
+                for v, a in zip(mine, vals):
+                    v.assign(a)
             self._extras = broadcast_object(
                 self._extras, root_rank=0, name="keras_state.extras")
             self.save()
 
+    _keras_state_cls = TensorFlowKerasState
     return TensorFlowKerasState
 
 
 def __getattr__(name):
     if name == "TensorFlowKerasState":
         return _make_keras_state()
+    if name == "elastic":
+        # hvd.elastic.* namespace (reference: horovod/tensorflow/elastic).
+        # Lazy: importing the submodule eagerly at the top would be fine,
+        # but keeping module attrs lazy matches TensorFlowKerasState.
+        import importlib
+
+        return importlib.import_module(__name__ + ".elastic")
     raise AttributeError(name)
 
 

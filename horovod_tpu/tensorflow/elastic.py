@@ -1,0 +1,22 @@
+"""horovod_tpu.tensorflow.elastic — reference parity:
+``horovod/tensorflow/elastic.py`` (`TensorFlowKerasState`, `run`)
+re-exported under the namespace reference users expect
+(``hvd.elastic.TensorFlowKerasState``, ``@hvd.elastic.run``).
+
+The TF1-style ``TensorFlowState`` (variables/session signature) is not
+provided — this build is TF2-only; asking for it raises AttributeError
+rather than handing back a class with a different constructor.
+"""
+from ..elastic import ObjectState, State, run, run_fn  # noqa: F401
+
+
+def __getattr__(name):
+    # Lazily built ONCE and cached in module globals: a fresh class per
+    # access would break isinstance/identity checks.
+    if name == "TensorFlowKerasState":
+        from . import _make_keras_state
+
+        cls = _make_keras_state()
+        globals()[name] = cls
+        return cls
+    raise AttributeError(name)

@@ -244,3 +244,29 @@ def test_elastic_torch_failure_recovery(tmp_path):
     finals = [line for line in log.splitlines() if line.startswith("final")]
     assert len(finals) == 2, f"expected 2 finishers:\n{log}\n{out}"
     assert all("iter=8" in line for line in finals), log
+
+
+TF_WORKER = os.path.join(os.path.dirname(__file__), "workers",
+                         "elastic_tf_worker.py")
+
+
+def test_elastic_tf_failure_recovery(tmp_path):
+    """TF/Keras binding end-to-end elastic (reference:
+    test/integration/test_elastic_tensorflow.py): a rank dies mid-job;
+    TensorFlowKerasState restores from the last commit, the driver
+    respawns, and every finisher holds identical weights."""
+    import pytest
+
+    pytest.importorskip("tensorflow")
+    marker = tmp_path / "tf-died.marker"
+    rc, log, out = _run_elastic(
+        tmp_path, "localhost:2",
+        {"TEST_ITERS": "6", "TEST_SLEEP": "0.1",
+         "TEST_FAIL_SLOT": "1", "TEST_MARKER": str(marker),
+         "JAX_PLATFORMS": "cpu"},
+        min_np=2, max_np=2, worker=TF_WORKER, timeout=240)
+    assert rc == 0, f"job failed rc={rc}\n{out}"
+    assert marker.exists(), "failure was never injected"
+    finals = [line for line in log.splitlines() if line.startswith("final")]
+    assert len(finals) == 2, f"expected 2 finishers:\n{log}\n{out}"
+    assert all("iter=6" in line for line in finals), log

@@ -1,0 +1,77 @@
+"""TF/Keras elastic training worker (reference:
+test/integration/test_elastic_tensorflow.py): TensorFlowKerasState
+captures model + optimizer variables, commit() each iteration,
+restore-on-failure, sync-on-membership-change.
+
+Env knobs (same contract as elastic_train_worker.py):
+- TEST_ITERS / TEST_SLEEP / TEST_LOG
+- TEST_FAIL_SLOT + TEST_MARKER: worker that os._exit(1)s once at iter 2
+"""
+import os
+import time
+
+import numpy as np
+
+import horovod_tpu.tensorflow as hvd
+
+hvd.init()
+import tensorflow as tf  # noqa: E402
+
+ITERS = int(os.environ.get("TEST_ITERS", "6"))
+SLEEP = float(os.environ.get("TEST_SLEEP", "0.1"))
+FAIL_SLOT = os.environ.get("TEST_FAIL_SLOT")
+MARKER = os.environ.get("TEST_MARKER", "")
+WID = os.environ.get("HVD_WORKER_ID", "?")
+
+
+def _should_die(it):
+    if FAIL_SLOT is None or not MARKER or os.path.exists(MARKER):
+        return False
+    return it == 2 and WID.startswith(f"localhost-{FAIL_SLOT}-")
+
+
+tf.random.set_seed(0)
+model = tf.keras.Sequential([tf.keras.layers.Dense(1, use_bias=False)])
+# momentum: the optimizer has SLOT variables, so restore/sync must carry
+# them too or post-recovery updates diverge across ranks.
+opt = tf.keras.optimizers.SGD(0.05, momentum=0.9)
+model(tf.zeros((1, 6)))  # build variables
+
+X = np.random.default_rng(0).normal(size=(32, 6)).astype(np.float32)
+Y = (X @ np.ones((6, 1), np.float32))
+
+state = hvd.elastic.TensorFlowKerasState(model, opt, iteration=0)
+
+
+@hvd.elastic.run
+def train(state):
+    while state.iteration < ITERS:
+        r, s = hvd.rank(), hvd.size()
+        if _should_die(state.iteration):
+            open(MARKER, "w").write("died\n")
+            os._exit(1)
+        xb, yb = tf.constant(X[r::s]), tf.constant(Y[r::s])
+        with tf.GradientTape() as t:
+            loss = tf.reduce_mean((model(xb) - yb) ** 2)
+        tape = hvd.DistributedGradientTape(t)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        state.iteration += 1
+        state.commit()
+        time.sleep(SLEEP)
+
+
+train(state)
+
+w = model.trainable_variables[0].numpy()
+gathered = hvd.allgather(tf.constant(w.reshape(1, -1)), name="final.w")
+gw = np.asarray(gathered)
+assert np.allclose(gw, gw[0], atol=1e-6), gw
+
+log = os.environ.get("TEST_LOG")
+if log:
+    with open(log, "a") as f:
+        f.write(f"final rank={hvd.rank()} size={hvd.size()} "
+                f"iter={state.iteration}\n")
+print(f"rank {hvd.rank()}: tf elastic PASS", flush=True)
+hvd.shutdown()
