@@ -1,0 +1,100 @@
+"""Rank-aware on-disk checkpointing, delegated to orbax.
+
+Reference parity: SURVEY.md §5 checkpoint/resume — the reference ships no
+custom on-disk format; examples/docs follow the "rank 0 writes
+framework-native checkpoints" pattern, and the TPU build should delegate
+to orbax while keeping the elastic in-memory State protocol
+(horovod_tpu/elastic.py) for fast rollback. These helpers wrap that
+pattern for multi-process jobs:
+
+- :func:`save` — rank 0 writes the pytree via orbax; everyone barriers so
+  no rank races ahead of a half-written checkpoint.
+- :func:`restore` — every rank reads the same step (rank 0 picks the
+  latest and broadcasts its choice, so ranks can't disagree after a
+  partial save).
+- :func:`latest_step` — newest step on disk, or None.
+
+Single-process use works too (the collectives are no-ops at size 1).
+"""
+import os
+
+import numpy as np
+
+from .basics import basics as _basics
+from .ops import collective_ops as _core
+
+
+def _mgr(directory):
+    import orbax.checkpoint as ocp
+
+    return ocp.CheckpointManager(os.path.abspath(str(directory)))
+
+
+def _resolve_set(process_set):
+    """(set_id, root_global_rank): the writer/broadcast root is the set's
+    LOWEST member — hardcoding global rank 0 would silently write nothing
+    for a set excluding it. Non-global sets must be passed as ProcessSet
+    objects (a bare id carries no membership)."""
+    if hasattr(process_set, "process_set_id"):
+        ranks = process_set.ranks
+        return int(process_set.process_set_id), (min(ranks) if ranks else 0)
+    ps = int(process_set)
+    if ps != 0:
+        raise ValueError(
+            "pass a ProcessSet object for non-global process sets: the "
+            "checkpoint writer/root is the set's lowest member, which a "
+            "bare id cannot name")
+    return 0, 0
+
+
+def latest_step(directory):
+    """Newest checkpoint step in `directory`, or None."""
+    with _mgr(directory) as mgr:
+        return mgr.latest_step()
+
+
+def save(directory, step, tree, process_set=0):
+    """Write `tree` (a pytree of arrays) as checkpoint `step`; the set's
+    root writes, every member returns only after the write is durable."""
+    import orbax.checkpoint as ocp
+
+    ps, root = _resolve_set(process_set)
+    if _basics.rank() == root:
+        with _mgr(directory) as mgr:
+            mgr.save(int(step),
+                     args=ocp.args.StandardSave(_to_host(tree)))
+            mgr.wait_until_finished()
+    _core.barrier(process_set=ps)
+
+
+def restore(directory, tree_like, step=None, process_set=0):
+    """Restore a checkpoint into the structure of `tree_like`.
+
+    The set's root resolves which step to load (`step` or the latest) and
+    broadcasts its choice so every member reads the SAME checkpoint even
+    if a newer one landed mid-call. Returns (tree, step) or (None, None)
+    if no checkpoint exists.
+    """
+    import orbax.checkpoint as ocp
+
+    ps, root = _resolve_set(process_set)
+    with _mgr(directory) as mgr:
+        if _basics.rank() == root:
+            chosen = step if step is not None else mgr.latest_step()
+        else:
+            chosen = None
+        chosen = _core.broadcast_object(chosen, root_rank=root,
+                                        name="ckpt.step", process_set=ps)
+        if chosen is None:
+            return None, None
+        out = mgr.restore(
+            int(chosen),
+            args=ocp.args.StandardRestore(_to_host(tree_like)))
+    return out, int(chosen)
+
+
+def _to_host(tree):
+    """Orbax round-trips numpy; device arrays (jax) are pulled to host."""
+    import jax
+
+    return jax.tree.map(np.asarray, tree)

@@ -36,3 +36,13 @@ def test_mxnet_binding_import_surface():
 
         assert hasattr(hvd_mx, "DistributedTrainer")
         assert hasattr(hvd_mx, "broadcast_parameters")
+
+
+def test_rank_aware_checkpointing(tmp_path):
+    """Orbax-delegated checkpoint/resume (SURVEY §5): the root writes +
+    barrier; restore picks one step for ALL ranks; explicit-step and
+    empty-dir paths covered."""
+    pytest.importorskip("orbax.checkpoint")
+    run_worker_job(2, "checkpoint_worker.py",
+                   extra_env={"CKPT_DIR": str(tmp_path / "ck")},
+                   timeout=240)

@@ -1,0 +1,42 @@
+"""Worker: rank-aware orbax checkpointing across a 2-rank job — rank 0
+writes, the barrier holds everyone until durable, restore agrees on the
+step across ranks (SURVEY.md §5 checkpoint/resume)."""
+import os
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+ckdir = os.environ["CKPT_DIR"]
+
+tree = {"w": np.full((4, 2), float(r + 1), np.float32),
+        "step_count": np.asarray(7, np.int64)}
+
+# Save at steps 3 and 5; every rank may call save (only rank 0 writes).
+checkpoint.save(ckdir, 3, tree)
+tree2 = {"w": tree["w"] * 10.0, "step_count": np.asarray(9, np.int64)}
+checkpoint.save(ckdir, 5, tree2)
+
+assert checkpoint.latest_step(ckdir) == 5
+
+# Restore latest: every rank gets rank 0's tree (it was the writer).
+like = {"w": np.zeros((4, 2), np.float32),
+        "step_count": np.asarray(0, np.int64)}
+out, step = checkpoint.restore(ckdir, like)
+assert step == 5, step
+assert np.allclose(out["w"], 10.0), out["w"]  # rank 0 wrote (0+1)*10
+assert int(out["step_count"]) == 9
+
+# Restore an explicit earlier step.
+out3, step3 = checkpoint.restore(ckdir, like, step=3)
+assert step3 == 3 and np.allclose(out3["w"], 1.0)
+
+# Empty dir: (None, None) on every rank.
+none_out, none_step = checkpoint.restore(ckdir + "-empty", like)
+assert none_out is None and none_step is None
+
+print(f"rank {r}: checkpoint PASS", flush=True)
+hvd.shutdown()
