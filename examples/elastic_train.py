@@ -19,12 +19,33 @@ hvd.init()
 
 DIM = int(os.environ.get("DIM", 32))
 EPOCHS = int(os.environ.get("EPOCHS", 10))
+EPOCH_SLEEP = float(os.environ.get("EPOCH_SLEEP", "0"))  # demo pacing
 
 rng = np.random.default_rng(0)
 params = {"w": jnp.asarray(rng.normal(0, 0.1, (DIM, 1)), jnp.float32)}
 tx = optax.sgd(0.05)
+
+# Conventional on-disk resume (horovod_tpu.checkpoint, orbax-backed)
+# composes with the elastic in-memory State: disk survives full-job
+# restarts; State survives membership changes within one run.
+CKPT_DIR = os.environ.get("CKPT_DIR")
+start_epoch = 0
+if CKPT_DIR:
+    from horovod_tpu import checkpoint
+
+    # coordinate=False: this runs BEFORE hvd.elastic.run, where a mid-run
+    # joiner executes it while veterans sit in state.sync() — a collective
+    # here would deadlock. Local resolution is safe on a shared FS (orbax
+    # writes atomically) and state.sync() reconciles any residual skew.
+    restored, step = checkpoint.restore(
+        CKPT_DIR, {"w": np.zeros((DIM, 1), np.float32)}, coordinate=False)
+    if restored is not None:
+        params = {"w": jnp.asarray(restored["w"])}
+        start_epoch = step
+        print(f"resumed from checkpoint epoch {step}", flush=True)
+
 state = hvd.elastic.JaxState(params=params, opt_state=tx.init(params),
-                             epoch=0)
+                             epoch=start_epoch)
 
 
 @hvd.elastic.run
@@ -53,9 +74,18 @@ def train(state):
         state.opt_state = o
         state.epoch += 1
         state.commit()
+        if CKPT_DIR and state.epoch % 2 == 0:
+            from horovod_tpu import checkpoint
+
+            checkpoint.save(CKPT_DIR, state.epoch,
+                            {"w": np.asarray(state.params["w"])})
         if r == 0:
             print(f"epoch {state.epoch}: ranks={s} "
                   f"loss={float(loss):.5f}", flush=True)
+        if EPOCH_SLEEP:
+            import time
+
+            time.sleep(EPOCH_SLEEP)
 
 
 train(state)

@@ -47,6 +47,7 @@ from .ops.collective_ops import (  # noqa: F401
     barrier,
     broadcast,
     broadcast_async,
+    allgather_object,
     broadcast_object,
     grouped_allgather,
     grouped_allgather_async,

@@ -7,14 +7,23 @@ to orbax while keeping the elastic in-memory State protocol
 (horovod_tpu/elastic.py) for fast rollback. These helpers wrap that
 pattern for multi-process jobs:
 
-- :func:`save` — rank 0 writes the pytree via orbax; everyone barriers so
-  no rank races ahead of a half-written checkpoint.
-- :func:`restore` — every rank reads the same step (rank 0 picks the
+- :func:`save` — the set's root writes the pytree via orbax; everyone
+  barriers so no rank races ahead of a half-written checkpoint.
+- :func:`restore` — every rank reads the same step (the root picks the
   latest and broadcasts its choice, so ranks can't disagree after a
   partial save).
 - :func:`latest_step` — newest step on disk, or None.
 
+Cross-rank coordination is THIS module's (core barrier + broadcast step
+agreement); orbax runs with its multihost sync confined to the calling
+process — the synchronous ``Checkpointer``, not ``CheckpointManager``,
+because under an initialized ``jax.distributed`` mesh the manager runs
+global barriers and the preemption service, which deadlock/fail when
+only the root enters orbax (elastic and tpurun jobs form such a mesh).
+
 Single-process use works too (the collectives are no-ops at size 1).
+Layout: ``<directory>/<step>/`` per checkpoint, written atomically by
+orbax (a plain-integer directory name is a complete checkpoint).
 """
 import os
 
@@ -24,10 +33,15 @@ from .basics import basics as _basics
 from .ops import collective_ops as _core
 
 
-def _mgr(directory):
+def _ckptr():
+    import jax
     import orbax.checkpoint as ocp
 
-    return ocp.CheckpointManager(os.path.abspath(str(directory)))
+    me = jax.process_index() if jax.distributed.is_initialized() else 0
+    return ocp.Checkpointer(
+        ocp.StandardCheckpointHandler(),
+        multiprocessing_options=ocp.options.MultiprocessingOptions(
+            primary_host=me, active_processes={me}))
 
 
 def _resolve_set(process_set):
@@ -48,48 +62,76 @@ def _resolve_set(process_set):
 
 
 def latest_step(directory):
-    """Newest checkpoint step in `directory`, or None."""
-    with _mgr(directory) as mgr:
-        return mgr.latest_step()
+    """Newest complete checkpoint step in `directory`, or None. Orbax
+    writes atomically (tmp-suffixed dir + rename), so a plain-integer
+    directory name is a finished checkpoint."""
+    d = str(directory)
+    if not os.path.isdir(d):
+        return None
+    steps = [int(n) for n in os.listdir(d)
+             if n.isdigit() and os.path.isdir(os.path.join(d, n))]
+    return max(steps) if steps else None
 
 
 def save(directory, step, tree, process_set=0):
     """Write `tree` (a pytree of arrays) as checkpoint `step`; the set's
-    root writes, every member returns only after the write is durable."""
+    root writes, every member returns only after the write is durable.
+    The barrier is named by `step` so elastic joiners (whose auto-name
+    counters differ from veterans') negotiate the same tensor."""
     import orbax.checkpoint as ocp
 
     ps, root = _resolve_set(process_set)
     if _basics.rank() == root:
-        with _mgr(directory) as mgr:
-            mgr.save(int(step),
-                     args=ocp.args.StandardSave(_to_host(tree)))
-            mgr.wait_until_finished()
-    _core.barrier(process_set=ps)
+        os.makedirs(str(directory), exist_ok=True)
+        with _ckptr() as ck:
+            ck.save(os.path.join(str(directory), str(int(step))),
+                    args=ocp.args.StandardSave(_to_host(tree)),
+                    force=True)
+    _core.barrier(process_set=ps, name=f"ckpt.save.{int(step)}")
 
 
-def restore(directory, tree_like, step=None, process_set=0):
+def restore(directory, tree_like, step=None, process_set=0,
+            coordinate=True):
     """Restore a checkpoint into the structure of `tree_like`.
 
-    The set's root resolves which step to load (`step` or the latest) and
-    broadcasts its choice so every member reads the SAME checkpoint even
-    if a newer one landed mid-call. Returns (tree, step) or (None, None)
-    if no checkpoint exists.
+    With ``coordinate=True`` the set's root resolves which step to load
+    (`step` or the latest) and broadcasts its choice so every member
+    reads the SAME checkpoint even if a newer one lands mid-call.
+    Returns (tree, step) or (None, None) if no checkpoint exists.
+
+    ``coordinate=False`` skips the broadcast and resolves locally —
+    REQUIRED when ranks may reach this call with different collective
+    histories (e.g. startup code before ``hvd.elastic.run``, where a
+    mid-run joiner executes it while veterans sit in ``state.sync()``):
+    a collective here would deadlock the job. Orbax writes atomically,
+    so a locally visible plain-integer step directory is complete; on a
+    shared filesystem all ranks resolve the same latest step unless a
+    save is racing — exactly the window ``coordinate=True`` exists for.
     """
     import orbax.checkpoint as ocp
 
     ps, root = _resolve_set(process_set)
-    with _mgr(directory) as mgr:
+    if not coordinate:
+        chosen = step if step is not None else latest_step(directory)
+    else:
         if _basics.rank() == root:
-            chosen = step if step is not None else mgr.latest_step()
+            chosen = step if step is not None else latest_step(directory)
         else:
             chosen = None
         chosen = _core.broadcast_object(chosen, root_rank=root,
                                         name="ckpt.step", process_set=ps)
-        if chosen is None:
-            return None, None
-        out = mgr.restore(
-            int(chosen),
-            args=ocp.args.StandardRestore(_to_host(tree_like)))
+    if chosen is None:
+        return None, None
+    path = os.path.join(str(directory), str(int(chosen)))
+    # Back-compat: an earlier revision wrote via orbax CheckpointManager,
+    # which nests the payload under <step>/default/.
+    legacy = os.path.join(path, "default")
+    if os.path.isdir(legacy) and not os.path.exists(
+            os.path.join(path, "_METADATA")):
+        path = legacy
+    with _ckptr() as ck:
+        out = ck.restore(
+            path, args=ocp.args.StandardRestore(_to_host(tree_like)))
     return out, int(chosen)
 
 

@@ -39,7 +39,14 @@ from ..ops.jax_ops import (  # noqa: F401
     hvd_broadcast_pytree as broadcast_parameters,
     hvd_reducescatter as reducescatter,
 )
-from ..ops.collective_ops import join, barrier, poll, synchronize  # noqa: F401
+from ..ops.collective_ops import (  # noqa: F401
+    allgather_object,
+    barrier,
+    broadcast_object,
+    join,
+    poll,
+    synchronize,
+)
 from .distributed import (  # noqa: F401  (multi-process ICI mesh)
     global_mesh,
     initialize_from_env as init_distributed,

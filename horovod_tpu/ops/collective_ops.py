@@ -298,6 +298,27 @@ def predivide_factors(op, gradient_predivide_factor, process_set=0):
     return op, 1.0 / f, f
 
 
+def allgather_object(obj, name=None, process_set=0):
+    """Gather an arbitrary picklable object from every member; returns a
+    list ordered by rank (reference: horovod/torch/mpi_ops.py
+    `allgather_object`). Rides the ragged allgather: each rank
+    contributes its pickle as a [nbytes] uint8 row-block plus a length
+    row — gathered as ONE atomic group (one negotiation round, and the
+    pair can't be split by an elastic interrupt)."""
+    import pickle
+
+    name = _auto_name("allgather_object", name)
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+    lengths, flat = grouped_allgather(
+        [np.array([payload.size], dtype=np.int64), payload],
+        name=name, process_set=process_set)
+    out, off = [], 0
+    for n in lengths.ravel().tolist():
+        out.append(pickle.loads(flat[off:off + int(n)].tobytes()))
+        off += int(n)
+    return out
+
+
 def metric_average(value, name=None, process_set=0):
     """Average a scalar metric across ranks (reference:
     MetricAverageCallback). The ONE implementation every binding
@@ -421,8 +442,12 @@ def join(process_set=0):
     return synchronize(handle)
 
 
-def barrier(process_set=0):
-    name = _auto_name("barrier", None)
+def barrier(process_set=0, name=None):
+    """Block until every member arrives. Pass an explicit `name` when the
+    call may be reached by ranks with different collective histories
+    (e.g. an elastic joiner vs veterans): the auto-name counter is
+    process-local, and mismatched names stall negotiation forever."""
+    name = _auto_name("barrier", name)
     h = _check_handle(_lib.hvd_barrier_async(name.encode(), int(process_set)))
     synchronize(_register(Handle(h, "barrier", (), None, None, name)))
 

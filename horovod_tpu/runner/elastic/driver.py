@@ -23,6 +23,7 @@ DISCOVERY_INTERVAL_S = 1.0
 FAILURE_WINDOW_S = 60.0
 FAILURES_TO_BLACKLIST = 3
 DEFAULT_COOLDOWN_RANGE = (10.0, 60.0)
+WIND_DOWN_GRACE_S = 30.0
 
 
 class _Worker:
@@ -64,7 +65,9 @@ class ElasticDriver:
         self._excluded = set()       # worker ids told to exit (not successes)
         self._reset_handled = set()  # (worker_id, epoch) reset requests seen
         self._success_seen = False
+        self._success_spawn_max = -1
         self._wind_down_failed = False
+        self._wind_down_since = None
         self.ssh_port = None
         # Per-epoch jax.distributed coordination services (driver-hosted so
         # a worker death can never take the service down — see
@@ -306,20 +309,53 @@ class ElasticDriver:
                                 self._log(f"{w.id} exited (excluded)")
                             else:
                                 self._success_seen = True
+                                self._success_spawn_max = max(
+                                    self._success_spawn_max, w.spawn_epoch)
                                 self._log(f"{w.id} finished OK")
+                        elif (self._success_seen and
+                              w.spawn_epoch > self._success_spawn_max):
+                            # Collateral: a worker spawned AFTER every
+                            # finisher (late joiner) failing while the job
+                            # winds down — typically init against a rank 0
+                            # that already left. It never carried training
+                            # state, so it cannot invalidate the result.
+                            self._log(f"late joiner {w.id} exited rc={code} "
+                                      f"during wind-down (ignored)")
                         else:
                             self._log(f"{w.id} FAILED rc={code}")
                             self._record_failure(w.hostname)
                             if self._success_seen:
+                                # An ESTABLISHED peer failing after a
+                                # finisher: its collective work completed
+                                # (lockstep), but rank-local post-work
+                                # (final artifact writes) may not have —
+                                # surface it.
                                 self._wind_down_failed = True
                             membership_dirty = True
 
             alive = [w for w in self.workers.values() if w.alive]
 
             if self._success_seen:
-                # job is winding down: no respawns, wait for the rest
+                # Winding down: no respawns. Tell workers still waiting in
+                # rendezvous to exit (they'd otherwise sit out the 600 s
+                # assignment timeout). ESTABLISHED workers get unbounded
+                # time (legitimate tail work: final eval, rank-0 artifact
+                # writes); only late joiners — which never trained — are
+                # terminated after a grace period.
                 if not alive:
                     return 1 if self._wind_down_failed else 0
+                if self._wind_down_since is None:
+                    self._wind_down_since = now
+                    self.epoch += 1
+                    for w in alive:
+                        self._excluded.add(w.id)
+                        self.rdv.put(f"/assign-{self.epoch}/{w.id}", b"exit")
+                    self.rdv.put("/ctl/epoch", str(self.epoch).encode())
+                elif now - self._wind_down_since > WIND_DOWN_GRACE_S:
+                    for w in alive:
+                        if w.spawn_epoch > self._success_spawn_max:
+                            self._log(f"terminating late joiner {w.id}")
+                            util.terminate(w.proc)
                 time.sleep(0.1)
                 continue
 

@@ -212,6 +212,10 @@ def broadcast_object(obj, root_rank=0, name=None, process_set=0):
                                   process_set=process_set)
 
 
+def allgather_object(obj, name=None, process_set=0):
+    return _core.allgather_object(obj, name=name, process_set=process_set)
+
+
 def broadcast_variables(variables, root_rank=0):
     """Assign every variable its root-rank value (reference:
     `broadcast_variables` / `broadcast_global_variables`). One fused

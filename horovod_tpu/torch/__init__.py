@@ -123,6 +123,10 @@ def broadcast_object(obj, root_rank=0, name=None, process_set=0):
                                   process_set=process_set)
 
 
+def allgather_object(obj, name=None, process_set=0):
+    return _core.allgather_object(obj, name=name, process_set=process_set)
+
+
 # -- async + handles --------------------------------------------------------
 
 class TorchHandle:

@@ -26,6 +26,10 @@ assert (rs == 3).all()
 m = np.ones((s * 2 + 1, 4), dtype=np.float32) * (r + 1)
 rsout = hvd.reducescatter(m, op=hvd.Sum)
 assert np.allclose(rsout, sum(range(1, s + 1)))
+# allgather_object: ragged picklable objects, ordered by rank
+objs = hvd.allgather_object({"rank": r, "data": list(range(r + 1))})
+assert [o["rank"] for o in objs] == list(range(s)), objs
+assert objs[-1]["data"] == list(range(s)), objs
 # grouped allgather + grouped reducescatter (atomic group negotiation)
 gouts = hvd.grouped_allgather([np.full((r + 1, 2), r, np.float32),
                                np.full((2,), float(r), np.float32)])
