@@ -135,6 +135,8 @@ cross_rank = _basics.cross_rank
 cross_size = _basics.cross_size
 mpi_threads_supported = _basics.mpi_threads_supported
 nccl_built = _basics.nccl_built
+start_timeline = _basics.start_timeline
+stop_timeline = _basics.stop_timeline
 cache_stats = _basics.cache_stats
 autotune_state = _basics.autotune_state
 peer_tx_bytes = _basics.peer_tx_bytes

@@ -98,6 +98,10 @@ _lib.hvd_join_async.restype = c_int
 _lib.hvd_join_async.argtypes = [c_char_p, c_int]
 _lib.hvd_barrier_async.restype = c_int
 _lib.hvd_barrier_async.argtypes = [c_char_p, c_int]
+_lib.hvd_start_timeline.restype = c_int
+_lib.hvd_start_timeline.argtypes = [c_char_p, c_int]
+_lib.hvd_stop_timeline.restype = c_int
+_lib.hvd_stop_timeline.argtypes = []
 _lib.hvd_add_process_set_async.restype = c_int
 _lib.hvd_add_process_set_async.argtypes = [c_char_p, P_int64, c_int]
 _lib.hvd_remove_process_set_async.restype = c_int
@@ -172,6 +176,20 @@ class HorovodBasics:
 
     def cross_size(self):
         return _check_init(_lib.hvd_cross_size())
+
+    def start_timeline(self, file_path, mark_cycles=False):
+        """Begin writing the Chrome-trace timeline at runtime (reference:
+        horovod_start_timeline). Rank 0 writes `file_path`, other ranks
+        `file_path.rankN`."""
+        if _lib.hvd_start_timeline(str(file_path).encode(),
+                                   1 if mark_cycles else 0) != 0:
+            raise RuntimeError(f"start_timeline failed: {last_error()}")
+
+    def stop_timeline(self):
+        """Stop and finalize a running timeline (reference:
+        horovod_stop_timeline)."""
+        if _lib.hvd_stop_timeline() != 0:
+            raise RuntimeError(f"stop_timeline failed: {last_error()}")
 
     def cache_stats(self):
         """(hits, misses, entries) of the response cache (reference:

@@ -76,6 +76,8 @@ struct Global {
   std::atomic<bool> initialized{false};
   std::atomic<bool> shutdown_requested{false};
   std::atomic<bool> dead{false};  // background thread exited
+  std::atomic<bool> mark_cycles{false};  // re-read per cycle: dynamic
+                                         // start_timeline can flip it
   int rank = 0, size = 1, local_rank = 0, local_size = 1;
   int cross_rank = 0, cross_size = 1;
   bool hierarchical = false;  // HVD_HIERARCHICAL_ALLREDUCE
@@ -638,13 +640,13 @@ void FailAllPending(const std::string& why) {
 }
 
 void BackgroundLoop() {
-  bool mark_cycles = EnvInt("HVD_TIMELINE_MARK_CYCLES", 0) != 0;
   std::string shutdown_reason;
   try {
     while (true) {
       std::this_thread::sleep_for(
           std::chrono::duration<double, std::milli>(g->cycle_time_ms));
-      if (mark_cycles) g->timeline.Mark("CYCLE_START");
+      if (g->mark_cycles.load(std::memory_order_relaxed))
+        g->timeline.Mark("CYCLE_START");
 
       RequestList mine;
       mine.requests = g->queue.PopRequests(NowUs());
@@ -961,6 +963,7 @@ int hvd_init() {
     if (!tl_path.empty() && g->rank != 0)
       tl_path += ".rank" + std::to_string(g->rank);
     g->timeline.Init(tl_path, g->rank);
+    g->mark_cycles = EnvInt("HVD_TIMELINE_MARK_CYCLES", 0) != 0;
     g->initialized = true;
     g->background = std::thread(BackgroundLoop);
     return 1;
@@ -1056,6 +1059,54 @@ int hvd_reducescatter_async(const char* name, const void* input,
   return Enqueue(OpType::kReducescatter, name, input, nullptr, shape, ndim,
                  dtype, red_op, 0, process_set, group_id, group_size,
                  prescale, postscale, nullptr, 0);
+}
+
+// Serializes start/stop against each other: without it two concurrent
+// starts both pass the enabled() check and Timeline::Init move-assigns
+// writer_ over a joinable thread — std::terminate.
+static std::mutex timeline_ctl_mu;
+
+int hvd_start_timeline(const char* path, int mark_cycles) {
+  // Reference parity: horovod_start_timeline — begin tracing at runtime
+  // (the HVD_TIMELINE env var remains the init-time way). Per-rank file
+  // suffixing matches init: rank 0 at `path`, others at `path.rankN`.
+  if (!g || !g->initialized) {
+    tl_error = "horovod_tpu not initialized";
+    return -1;
+  }
+  std::lock_guard<std::mutex> ctl(timeline_ctl_mu);
+  if (g->timeline.enabled()) {
+    tl_error = "timeline already running; call hvd_stop_timeline first";
+    return -1;
+  }
+  std::string p = path ? path : "";
+  if (p.empty()) {
+    tl_error = "timeline path is empty";
+    return -1;
+  }
+  if (g->rank != 0) p += ".rank" + std::to_string(g->rank);
+  g->timeline.Init(p, g->rank);
+  if (!g->timeline.enabled()) {
+    tl_error = "could not open timeline file: " + p;
+    return -1;
+  }
+  g->mark_cycles = mark_cycles != 0;
+  return 0;
+}
+
+int hvd_stop_timeline() {
+  if (!g || !g->initialized) {
+    tl_error = "horovod_tpu not initialized";
+    return -1;
+  }
+  std::lock_guard<std::mutex> ctl(timeline_ctl_mu);
+  if (!g->timeline.enabled()) {
+    tl_error = "timeline is not running";
+    return -1;
+  }
+  g->mark_cycles = false;
+  g->timeline.Shutdown();
+  return 0;
 }
 
 int hvd_join_async(const char* name, int process_set) {

@@ -29,7 +29,16 @@ void Timeline::Init(const std::string& path, int rank) {
   if (!file_) return;
   fputs("[\n", file_);
   first_event_ = true;
-  stop_ = false;
+  {
+    // Restartable (dynamic start/stop): drop any events that raced a
+    // previous Shutdown — they belong to the old session's file. The
+    // session counter catches the racer that is still between its
+    // enabled_ check and the lock.
+    std::lock_guard<std::mutex> l(mu_);
+    queue_.clear();
+    session_++;
+    stop_ = false;
+  }
   enabled_ = true;
   writer_ = std::thread([this] { WriterLoop(); });
 }
@@ -52,6 +61,7 @@ void Timeline::Shutdown() {
 
 void Timeline::Record(const std::string& tensor, const std::string& phase,
                       int64_t start_us, int64_t end_us) {
+  uint64_t sess = session_.load();
   if (!enabled_) return;
   char buf[512];
   snprintf(buf, sizeof(buf),
@@ -61,12 +71,14 @@ void Timeline::Record(const std::string& tensor, const std::string& phase,
            (long long)(end_us - start_us), rank_, JsonEscape(tensor).c_str());
   {
     std::lock_guard<std::mutex> l(mu_);
+    if (session_.load() != sess) return;  // raced a restart: old session
     queue_.emplace_back(buf);
   }
   cv_.notify_one();
 }
 
 void Timeline::Mark(const std::string& label) {
+  uint64_t sess = session_.load();
   if (!enabled_) return;
   char buf[256];
   snprintf(buf, sizeof(buf),
@@ -75,6 +87,7 @@ void Timeline::Mark(const std::string& label) {
            JsonEscape(label).c_str(), (long long)NowUs(), rank_);
   {
     std::lock_guard<std::mutex> l(mu_);
+    if (session_.load() != sess) return;  // raced a restart: old session
     queue_.emplace_back(buf);
   }
   cv_.notify_one();

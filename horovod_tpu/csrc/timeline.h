@@ -44,6 +44,10 @@ class Timeline {
 
   std::atomic<bool> enabled_{false};
   std::atomic<bool> stop_{false};
+  // Bumped by Init: an event that read enabled_ in an OLD session but
+  // acquires the queue lock after a restart must not leak into the new
+  // session's file.
+  std::atomic<uint64_t> session_{0};
   int rank_ = 0;
   FILE* file_ = nullptr;
   bool first_event_ = true;

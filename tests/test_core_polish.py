@@ -147,3 +147,14 @@ def _free_port():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def test_dynamic_timeline(tmp_path):
+    """start_timeline/stop_timeline at runtime (reference:
+    horovod_start_timeline): traced window captured with cycle marks,
+    untraced ops absent, restartable into a second file, error on double
+    start / stop-before-start."""
+    from .util import run_worker_job
+
+    run_worker_job(2, "timeline_worker.py",
+                   extra_env={"TL_PATH": str(tmp_path / "tl.json")})

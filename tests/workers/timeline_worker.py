@@ -1,0 +1,56 @@
+"""Worker: dynamic start_timeline/stop_timeline (reference:
+horovod_start_timeline/horovod_stop_timeline) — trace a window of
+collectives at runtime, on top of / after the env-var timeline."""
+import json
+import os
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+base = os.environ["TL_PATH"]
+
+# Not yet started: stop is an error; untraced collectives run fine.
+try:
+    hvd.stop_timeline()
+except RuntimeError:
+    pass
+else:
+    raise SystemExit("stop before start should fail")
+hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="untraced")
+
+hvd.start_timeline(base, mark_cycles=True)
+try:
+    hvd.start_timeline(base)  # double start is an error
+except RuntimeError:
+    pass
+else:
+    raise SystemExit("double start should fail")
+for i in range(3):
+    hvd.allreduce(np.ones(8, np.float32), op=hvd.Sum, name=f"traced.{i}")
+hvd.stop_timeline()
+
+# After stop: collectives keep working, new events aren't recorded.
+hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="post.stop")
+
+path = base if r == 0 else f"{base}.rank{r}"
+events = json.load(open(path))
+names = {e.get("tid") for e in events}
+assert any("traced." in str(n) for n in names), names
+assert not any("untraced" in str(n) or "post.stop" in str(n)
+               for n in names), names
+assert any(e.get("name") == "CYCLE_START" for e in events), \
+    "mark_cycles did not take effect"
+
+# Restart into a second window: the writer must be reusable.
+hvd.start_timeline(base + ".2")
+hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum, name="window2")
+hvd.stop_timeline()
+path2 = (base + ".2") if r == 0 else f"{base}.2.rank{r}"
+ev2 = json.load(open(path2))
+assert any("window2" in str(e.get("tid")) for e in ev2), ev2
+
+print(f"rank {r}: timeline PASS", flush=True)
+hvd.shutdown()
