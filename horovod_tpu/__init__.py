@@ -140,6 +140,8 @@ stop_timeline = _basics.stop_timeline
 cache_stats = _basics.cache_stats
 autotune_state = _basics.autotune_state
 peer_tx_bytes = _basics.peer_tx_bytes
+op_backends = _basics.op_backends
+backend_uses = _basics.backend_uses
 
 
 def mpi_built():

@@ -37,12 +37,24 @@ def _maybe_build():
             if f.endswith((".cc", ".h", "Makefile"))
         ]
         if srcs:
-            newest = max(os.path.getmtime(f) for f in srcs)
-            if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < newest:
-                subprocess.run(
-                    ["make", "-s"], cwd=_CSRC_DIR, check=True,
-                    stdout=subprocess.DEVNULL,
-                )
+            # Staleness is decided UNDER an exclusive lock: N ranks import
+            # concurrently, and make links straight onto the .so, so an
+            # unlocked mtime check can see a fresh-but-half-written library
+            # while another rank is still relinking and dlopen it (observed
+            # as missing-symbol AttributeErrors under the multi-process
+            # tests). Holding the lock across check+build means we only fall
+            # through to CDLL once any in-flight rebuild has finished.
+            import fcntl
+
+            with open(os.path.join(_CSRC_DIR, ".build.lock"), "w") as lk:
+                fcntl.flock(lk, fcntl.LOCK_EX)
+                newest = max(os.path.getmtime(f) for f in srcs)
+                if (not os.path.exists(_LIB_PATH)
+                        or os.path.getmtime(_LIB_PATH) < newest):
+                    subprocess.run(
+                        ["make", "-s"], cwd=_CSRC_DIR, check=True,
+                        stdout=subprocess.DEVNULL,
+                    )
     if not os.path.exists(_LIB_PATH):
         raise ImportError(
             f"native core not found at {_LIB_PATH}; run `make` in {_CSRC_DIR}"
@@ -130,6 +142,10 @@ _lib.hvd_process_set_members.restype = c_int
 _lib.hvd_process_set_members.argtypes = [c_int, P_int64]
 _lib.hvd_cache_stats.restype = c_int
 _lib.hvd_cache_stats.argtypes = [P_int64, P_int64, P_int64]
+_lib.hvd_op_backends.restype = c_int
+_lib.hvd_op_backends.argtypes = [c_int, ctypes.c_char_p, c_int]
+_lib.hvd_backend_uses.restype = c_int64
+_lib.hvd_backend_uses.argtypes = [c_char_p]
 _lib.hvd_autotune_state.restype = c_int
 _lib.hvd_autotune_state.argtypes = [P_int64, ctypes.POINTER(c_double)]
 _lib.hvd_peer_tx_bytes.restype = c_int64
@@ -203,6 +219,27 @@ class HorovodBasics:
         if rc < 0:
             raise ValueError("horovod_tpu has not been initialized")
         return hits.value, misses.value, entries.value
+
+    def op_backends(self, op_type):
+        """Backends registered for a collective, in priority order — the
+        first whose Enabled() holds for a response executes it (reference:
+        ops/operation_manager.cc op lists). `op_type`: 0=allreduce,
+        1=allgather, 2=broadcast, 3=alltoall, 4=reducescatter."""
+        buf = ctypes.create_string_buffer(512)
+        rc = _lib.hvd_op_backends(int(op_type), buf, len(buf))
+        if rc == -1:
+            raise ValueError("horovod_tpu has not been initialized")
+        if rc < 0:
+            raise RuntimeError(f"hvd_op_backends failed: {rc}")
+        return buf.value.decode().split(",") if buf.value else []
+
+    def backend_uses(self, name):
+        """Responses executed by the named backend since init (e.g.
+        'ring_allreduce', 'hierarchical_allreduce', 'adasum_allreduce')."""
+        v = _lib.hvd_backend_uses(str(name).encode())
+        if v < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        return v
 
     def peer_tx_bytes(self, rank):
         """Data-plane payload bytes this process has sent to `rank` since

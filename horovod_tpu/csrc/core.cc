@@ -30,6 +30,7 @@
 #include "common.h"
 #include "controller.h"
 #include "logging.h"
+#include "operation_manager.h"
 #include "response_cache.h"
 #include "tcp.h"
 #include "tensor_queue.h"
@@ -88,6 +89,7 @@ struct Global {
 
   TensorQueue queue;
   DataPlane data;
+  OperationManager ops;
   ProcessSetTable process_sets;
   Coordinator coordinator;  // used on rank 0 only
   Timeline timeline;
@@ -203,14 +205,40 @@ double EffectivePostscale(const Response& resp, int m) {
   return post;
 }
 
+// A reduce kernel runs one allreduce algorithm on a contiguous host buffer;
+// the OperationManager picks which one by walking its priority list
+// (reference: the allreduce op list in ops/operation_manager.cc). Shared
+// fuse-copy/scale logic stays in ExecAllreduce, like the reference keeps it
+// in the AllreduceOp base class.
+using ReduceKernel = void (*)(void* buf, int64_t n, const Response& resp,
+                              const std::vector<int32_t>& members);
+
+ReduceOp RingOpOf(const Response& resp) {
+  return resp.red_op == ReduceOp::kAverage ? ReduceOp::kSum : resp.red_op;
+}
+
+void AdasumKernel(void* buf, int64_t n, const Response& resp,
+                  const std::vector<int32_t>& members) {
+  AdasumAllreduce(g->data, buf, n, resp.dtype, members);
+}
+
+void HierarchicalKernel(void* buf, int64_t n, const Response& resp,
+                        const std::vector<int32_t>& members) {
+  g->data.HierarchicalAllreduce(buf, n, resp.dtype, RingOpOf(resp), members,
+                                g->local_size);
+}
+
+void RingKernel(void* buf, int64_t n, const Response& resp,
+                const std::vector<int32_t>& members) {
+  g->data.RingAllreduce(buf, n, resp.dtype, RingOpOf(resp), members);
+}
+
 void ExecAllreduce(const Response& resp,
                    std::vector<TensorTableEntry>& entries,
-                   const std::vector<int32_t>& members) {
+                   const std::vector<int32_t>& members, ReduceKernel kernel) {
   int m = (int)members.size();
   size_t esz = DataTypeSize(resp.dtype);
   double post = EffectivePostscale(resp, m);
-  ReduceOp ring_op =
-      resp.red_op == ReduceOp::kAverage ? ReduceOp::kSum : resp.red_op;
 
   if (entries.size() == 1 && resp.names.size() == 1) {
     // Unfused fast path: operate in place on the user's output buffer.
@@ -219,13 +247,7 @@ void ExecAllreduce(const Response& resp,
     if (e.output != e.input) memcpy(e.output, e.input, (size_t)n * esz);
     if (resp.prescale != 1.0) ScaleBuffer(e.output, n, resp.dtype, resp.prescale);
     int64_t t0 = NowUs();
-    if (resp.red_op == ReduceOp::kAdasum)
-      AdasumAllreduce(g->data, e.output, n, resp.dtype, members);
-    else if (UseHierarchical(members))
-      g->data.HierarchicalAllreduce(e.output, n, resp.dtype, ring_op,
-                                    members, g->local_size);
-    else
-      g->data.RingAllreduce(e.output, n, resp.dtype, ring_op, members);
+    kernel(e.output, n, resp, members);
     g->timeline.Record(e.req.name, "TCP_ALLREDUCE", t0, NowUs());
     if (post != 1.0) ScaleBuffer(e.output, n, resp.dtype, post);
     CompleteHandle(e.handle, Status::Ok());
@@ -254,13 +276,7 @@ void ExecAllreduce(const Response& resp,
   }
   int64_t t1 = NowUs();
   if (resp.prescale != 1.0) ScaleBuffer(fb, total, resp.dtype, resp.prescale);
-  if (resp.red_op == ReduceOp::kAdasum)
-    AdasumAllreduce(g->data, fb, total, resp.dtype, members);
-  else if (UseHierarchical(members))
-    g->data.HierarchicalAllreduce(fb, total, resp.dtype, ring_op, members,
-                                  g->local_size);
-  else
-    g->data.RingAllreduce(fb, total, resp.dtype, ring_op, members);
+  kernel(fb, total, resp, members);
   int64_t t2 = NowUs();
   if (post != 1.0) ScaleBuffer(fb, total, resp.dtype, post);
   off = 0;
@@ -391,6 +407,58 @@ void ExecReducescatter(const Response& resp, TensorTableEntry& e,
   CompleteHandle(e.handle, Status::Ok());
 }
 
+// Build the per-collective priority lists (reference: CreateOperationManager
+// in operations.cc — called once at init with the backend lists in priority
+// order). Predicates are evaluated per response, so e.g. flipping red_op or
+// the handshake-validated hierarchical topology picks a different backend
+// without re-registration.
+void RegisterBackends(OperationManager& om) {
+  om.Register(
+      OpType::kAllreduce, "adasum_allreduce",
+      [](const Response& r, const std::vector<int32_t>&) {
+        return r.red_op == ReduceOp::kAdasum;
+      },
+      [](const Response& r, std::vector<TensorTableEntry>& e,
+         const std::vector<int32_t>& m) {
+        ExecAllreduce(r, e, m, AdasumKernel);
+      });
+  om.Register(
+      OpType::kAllreduce, "hierarchical_allreduce",
+      [](const Response&, const std::vector<int32_t>& m) {
+        return UseHierarchical(m);
+      },
+      [](const Response& r, std::vector<TensorTableEntry>& e,
+         const std::vector<int32_t>& m) {
+        ExecAllreduce(r, e, m, HierarchicalKernel);
+      });
+  om.Register(
+      OpType::kAllreduce, "ring_allreduce", nullptr,
+      [](const Response& r, std::vector<TensorTableEntry>& e,
+         const std::vector<int32_t>& m) {
+        ExecAllreduce(r, e, m, RingKernel);
+      });
+  om.Register(
+      OpType::kAllgather, "ring_allgatherv", nullptr,
+      [](const Response& r, std::vector<TensorTableEntry>& e,
+         const std::vector<int32_t>& m) {
+        ExecAllgather(r, e[0], r.per_rank_meta[0], m);
+      });
+  om.Register(
+      OpType::kBroadcast, "binomial_broadcast", nullptr,
+      [](const Response& r, std::vector<TensorTableEntry>& e,
+         const std::vector<int32_t>& m) { ExecBroadcast(r, e[0], m); });
+  om.Register(
+      OpType::kAlltoall, "pairwise_alltoallv", nullptr,
+      [](const Response& r, std::vector<TensorTableEntry>& e,
+         const std::vector<int32_t>& m) {
+        ExecAlltoall(r, e[0], r.per_rank_meta[0], m);
+      });
+  om.Register(
+      OpType::kReducescatter, "ring_reducescatter", nullptr,
+      [](const Response& r, std::vector<TensorTableEntry>& e,
+         const std::vector<int32_t>& m) { ExecReducescatter(r, e[0], m); });
+}
+
 void PerformOperation(const Response& resp) {
   // Process-set table updates apply on every rank (idempotent on rank 0,
   // whose coordinator already updated the shared table).
@@ -448,19 +516,11 @@ void PerformOperation(const Response& resp) {
   try {
     switch (resp.op_type) {
       case OpType::kAllreduce:
-        ExecAllreduce(resp, entries, members);
-        break;
       case OpType::kAllgather:
-        ExecAllgather(resp, entries[0], resp.per_rank_meta[0], members);
-        break;
       case OpType::kBroadcast:
-        ExecBroadcast(resp, entries[0], members);
-        break;
       case OpType::kAlltoall:
-        ExecAlltoall(resp, entries[0], resp.per_rank_meta[0], members);
-        break;
       case OpType::kReducescatter:
-        ExecReducescatter(resp, entries[0], members);
+        g->ops.Execute(resp.op_type, resp, entries, members);
         break;
       case OpType::kJoin: {
         {
@@ -937,6 +997,7 @@ int hvd_init() {
         EnvInt("HVD_FUSION_THRESHOLD", 64 * 1024 * 1024);
     g->cycle_time_ms = EnvDouble("HVD_CYCLE_TIME_MS", 1.0);
     g->process_sets.InitGlobal(g->size);
+    RegisterBackends(g->ops);
     g->cache.Configure(EnvInt("HVD_CACHE_CAPACITY", 1024));
     g->coordinator.Init(g->size, g->fusion_threshold, &g->process_sets,
                         &g->cache);
@@ -1233,6 +1294,22 @@ int hvd_autotune_state(int64_t* fusion_threshold, double* cycle_time_ms) {
   if (cycle_time_ms) *cycle_time_ms = g->cycle_time_ms;
   if (!g->autotune.enabled()) return 0;
   return g->autotune.active() ? 1 : 2;
+}
+
+int hvd_op_backends(int op_type, char* out, int cap) {
+  // Registered backends for a collective, comma-joined in priority order
+  // (reference: the op lists built by CreateOperationManager).
+  if (!g || !g->initialized) return -1;
+  std::string s = g->ops.Registered((OpType)op_type);
+  if ((int)s.size() + 1 > cap) return -2;
+  memcpy(out, s.c_str(), s.size() + 1);
+  return (int)s.size();
+}
+
+int64_t hvd_backend_uses(const char* name) {
+  // How many responses the named backend has executed since init.
+  if (!g || !g->initialized) return -1;
+  return g->ops.Uses(name);
 }
 
 // Response-cache observability: hits = tensors executed via the bit-vector

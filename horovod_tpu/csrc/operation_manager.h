@@ -1,0 +1,98 @@
+#pragma once
+// Priority-ordered collective dispatch (reference:
+// horovod/common/ops/operation_manager.cc `OperationManager::ExecuteOperation`
+// — per-collective ordered op lists where the first op whose Enabled()
+// returns true executes; the allreduce list encodes the backend priority
+// Adasum → NCCL-hierarchical → NCCL → oneCCL → MPI → Gloo).
+//
+// This build's host plane has one transport (the full-duplex TCP ring in
+// collectives.cc), so the lists encode *algorithm* priority instead
+// (adasum → hierarchical → ring) and give future device backends a
+// registration point that does not touch PerformOperation. Per-backend
+// execution counts and the registered priority order are exported through
+// the C API (hvd_op_backends / hvd_backend_uses) for observability and
+// tests — the reference has no such surface; its selection is only visible
+// in timeline phase names.
+
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "tensor_queue.h"
+
+namespace hvd {
+
+class OperationManager {
+ public:
+  // Enabled for this specific response (red_op, member set, env state)?
+  // A null predicate means "always" — the list's terminal fallback.
+  using Enabled =
+      std::function<bool(const Response&, const std::vector<int32_t>&)>;
+  using Exec = std::function<void(const Response&,
+                                  std::vector<TensorTableEntry>&,
+                                  const std::vector<int32_t>&)>;
+
+  void Register(OpType t, std::string name, Enabled enabled, Exec run) {
+    ops_[(int)t].push_back(Backend{std::move(name), std::move(enabled),
+                                   std::move(run)});
+  }
+
+  // Reference semantics: walk the list in registration (priority) order and
+  // execute the first enabled backend. Returns its name.
+  const std::string& Execute(OpType t, const Response& resp,
+                             std::vector<TensorTableEntry>& entries,
+                             const std::vector<int32_t>& members) {
+    auto it = ops_.find((int)t);
+    if (it != ops_.end()) {
+      for (auto& b : it->second) {
+        if (b.enabled && !b.enabled(resp, members)) continue;
+        {
+          // Count BEFORE running: run() completes user handles internally,
+          // so a frontend thread woken by its handle must already see the
+          // selection reflected in Uses().
+          std::lock_guard<std::mutex> l(mu_);
+          uses_[b.name]++;
+        }
+        b.run(resp, entries, members);
+        return b.name;
+      }
+    }
+    throw std::runtime_error("no enabled backend for op type " +
+                             std::to_string((int)t));
+  }
+
+  // Comma-joined backend names in priority order (empty if none).
+  std::string Registered(OpType t) const {
+    std::string out;
+    auto it = ops_.find((int)t);
+    if (it == ops_.end()) return out;
+    for (auto& b : it->second) {
+      if (!out.empty()) out += ",";
+      out += b.name;
+    }
+    return out;
+  }
+
+  int64_t Uses(const std::string& name) const {
+    std::lock_guard<std::mutex> l(mu_);
+    auto it = uses_.find(name);
+    return it == uses_.end() ? 0 : it->second;
+  }
+
+ private:
+  struct Backend {
+    std::string name;
+    Enabled enabled;
+    Exec run;
+  };
+  std::map<int, std::vector<Backend>> ops_;
+  mutable std::mutex mu_;  // uses_ is read from API threads mid-training
+  std::map<std::string, int64_t> uses_;
+};
+
+}  // namespace hvd

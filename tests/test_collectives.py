@@ -17,6 +17,13 @@ def test_adasum_semantics():
     run_worker_job(2, "adasum_worker.py")
 
 
+def test_operation_manager_dispatch():
+    """Priority-ordered backend dispatch (reference: operation_manager.cc):
+    registered lists are observable, selection is per-response (Sum rides
+    the terminal ring backend, Adasum the higher-priority adasum one)."""
+    run_worker_job(2, "dispatch_worker.py")
+
+
 def test_process_sets():
     run_worker_job(4, "process_set_worker.py")
 

@@ -1,0 +1,46 @@
+"""Worker: OperationManager priority dispatch (reference:
+ops/operation_manager.cc — ordered op lists, first Enabled() executes).
+
+Asserts the registered priority order for every collective and that
+selection is response-driven: a Sum allreduce rides the terminal ring
+backend while an Adasum allreduce in the same process picks the
+higher-priority adasum backend.
+"""
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+
+assert hvd.op_backends(0) == [
+    "adasum_allreduce", "hierarchical_allreduce", "ring_allreduce"]
+assert hvd.op_backends(1) == ["ring_allgatherv"]
+assert hvd.op_backends(2) == ["binomial_broadcast"]
+assert hvd.op_backends(3) == ["pairwise_alltoallv"]
+assert hvd.op_backends(4) == ["ring_reducescatter"]
+
+assert hvd.backend_uses("ring_allreduce") == 0
+out = hvd.allreduce(np.full(64, float(r + 1), np.float32), op=hvd.Sum)
+assert np.allclose(out, s * (s + 1) / 2)
+assert hvd.backend_uses("ring_allreduce") == 1
+assert hvd.backend_uses("adasum_allreduce") == 0
+assert hvd.backend_uses("hierarchical_allreduce") == 0
+
+if s & (s - 1) == 0:  # adasum needs a power-of-two member count
+    hvd.allreduce(np.full(16, float(r + 1), np.float32), op=hvd.Adasum)
+    assert hvd.backend_uses("adasum_allreduce") == 1
+    assert hvd.backend_uses("ring_allreduce") == 1
+
+hvd.allgather(np.full((r + 1, 2), r, np.int32))
+assert hvd.backend_uses("ring_allgatherv") == 1
+hvd.broadcast(np.arange(4.0), root_rank=0)
+assert hvd.backend_uses("binomial_broadcast") == 1
+hvd.alltoall(np.zeros(s, np.float32), splits=[1] * s)
+assert hvd.backend_uses("pairwise_alltoallv") == 1
+hvd.reducescatter(np.ones((s, 2), np.float32), op=hvd.Sum)
+assert hvd.backend_uses("ring_reducescatter") == 1
+
+hvd.barrier()
+hvd.shutdown()
+print(f"DISPATCH rank={r} OK", flush=True)

@@ -68,6 +68,13 @@ out = hvd.allreduce(np.full(1, float(r + 1), np.float32), op=hvd.Sum,
                     name="h.tiny")
 assert np.allclose(out, float(SUM)), out
 
+# Dispatch observability: with HVD_HIERARCHICAL_ALLREDUCE the operation
+# manager must have selected the hierarchical backend for every allreduce,
+# and never otherwise (reference: operation_manager.cc priority order).
+hier_on = os.environ.get("HVD_HIERARCHICAL_ALLREDUCE") == "1"
+assert (hvd.backend_uses("hierarchical_allreduce") > 0) == hier_on
+assert (hvd.backend_uses("ring_allreduce") == 0) == hier_on
+
 cross_tx = sum(hvd.peer_tx_bytes(q) for q in range(s) if q // L != host)
 local_tx = sum(hvd.peer_tx_bytes(q) for q in range(s) if q // L == host
                and q != r)
