@@ -1,10 +1,16 @@
-"""horovod_tpu.spark — run ranks inside Spark executors.
+"""horovod_tpu.spark — run ranks inside Spark executors, plus the
+estimator layer.
 
 Reference parity: ``horovod/spark/__init__.py`` (``horovod.spark.run``:
 one rank per Spark task, results collected to the driver). The estimator
-layer (KerasEstimator/TorchEstimator + petastorm) is descoped with
-pyspark unavailability — see the README descope note; :mod:`.store`
-(``Store``/``LocalStore``) is importable without pyspark.
+layer lives in :mod:`.keras` (``KerasEstimator``) and :mod:`.torch`
+(``TorchEstimator``) — ``fit(df)`` materializes the DataFrame to the
+:mod:`.store`, trains N ranks through a backend (negotiated local
+processes by default, barrier Spark tasks via
+:class:`~horovod_tpu.spark.params.SparkBackend`), and returns a
+transformer model. Everything except ``run()`` itself is importable and
+usable without pyspark — see the README descope note for what changes
+without petastorm (``.npz`` shards instead of parquet).
 
 Like the reference, each Spark task becomes one rank of a fresh job. The
 driver hosts the HMAC-signed KV store; rank 0 registers a controller port

@@ -1,0 +1,165 @@
+"""KerasEstimator — estimator-style data-parallel Keras training
+(reference: ``horovod/spark/keras/estimator.py`` ``KerasEstimator`` /
+``KerasModel``).
+
+``fit(df)`` materializes the DataFrame to the store, launches ``num_proc``
+ranks through the backend (local negotiated processes by default, barrier
+Spark tasks with :class:`~horovod_tpu.spark.params.SparkBackend`), trains
+with the Keras binding (``DistributedOptimizer`` +
+``BroadcastGlobalVariablesCallback`` + ``MetricAverageCallback``), has
+rank 0 checkpoint the weights to the store, and returns a
+:class:`KerasModel` whose ``transform`` appends prediction columns.
+"""
+import os
+
+import numpy as np
+
+from .params import EstimatorParams, HorovodModel, load_shard
+
+
+def _train_fn(spec):
+    """Per-rank training body (runs in a fresh process with slot env set)."""
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+    import tensorflow as tf
+
+    import horovod_tpu.keras as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    tf.keras.utils.set_random_seed(spec["seed"] + r)
+
+    X, Y = load_shard(spec["train_path"], r)
+    model = tf.keras.models.model_from_json(
+        spec["model_json"], custom_objects=spec["custom_objects"] or None)
+    model.set_weights(spec["weights"])
+    opt = spec["optimizer"]
+    opt = (tf.keras.optimizers.deserialize(opt) if isinstance(opt, dict)
+           else tf.keras.optimizers.get(opt))
+    model.compile(optimizer=hvd.DistributedOptimizer(opt),
+                  loss=spec["loss"], metrics=list(spec["metrics"]))
+    callbacks = [hvd.BroadcastGlobalVariablesCallback(0),
+                 hvd.MetricAverageCallback()]
+    hist = model.fit(X, Y, batch_size=spec["batch_size"],
+                     epochs=spec["epochs"], shuffle=spec["shuffle"],
+                     verbose=spec["verbose"], callbacks=callbacks)
+
+    # Validation scores averaged across ranks (each rank holds one shard).
+    val = None
+    Xv, Yv = load_shard(spec["val_path"], r)
+    if len(Xv):
+        scores = model.evaluate(Xv, Yv, batch_size=spec["batch_size"],
+                                verbose=0)
+        scores = np.atleast_1d(np.asarray(scores, np.float64))
+        val = [float(hvd.metric_average(s, f"est_val_{i}"))
+               for i, s in enumerate(scores)]
+
+    weights = model.get_weights()
+    if r == 0:
+        np.savez(os.path.join(spec["ckpt_path"], "model_weights.npz"),
+                 *weights)
+    hvd.shutdown()
+    return {
+        "history": {k: [float(x) for x in v]
+                    for k, v in hist.history.items()},
+        "val": val,
+        "weights": weights if r == 0 else None,
+    }
+
+
+class KerasEstimator(EstimatorParams):
+    """Data-parallel Keras estimator (reference: KerasEstimator).
+
+    Usage::
+
+        est = KerasEstimator(model=m, optimizer="adam", loss="mse",
+                             feature_cols=["x0", "x1"], label_cols=["y"],
+                             batch_size=16, epochs=10, num_proc=2,
+                             store=LocalStore("/tmp/store"))
+        keras_model = est.fit(df)           # pandas or pyspark DataFrame
+        out = keras_model.transform(df)     # adds "y__output"
+    """
+
+    def __init__(self, optimizer="adam", metrics=(), custom_objects=None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.optimizer = optimizer
+        self.metrics = list(metrics)
+        self.custom_objects = dict(custom_objects or {})
+
+    def fit(self, df):
+        import tensorflow as tf
+
+        self._check_params()
+        store, run_id = self._prepare_store()
+        train_path, val_path, _ = self._materialize(df, run_id)
+        ckpt_path = store.get_checkpoint_path(run_id)
+
+        opt = self.optimizer
+        if not isinstance(opt, str):
+            opt = tf.keras.optimizers.serialize(opt)
+        spec = {
+            "model_json": self.model.to_json(),
+            "weights": self.model.get_weights(),
+            "optimizer": opt,
+            "loss": self.loss,
+            "metrics": self.metrics,
+            "custom_objects": self.custom_objects,
+            "batch_size": self.batch_size,
+            "epochs": self.epochs,
+            "shuffle": self.shuffle,
+            "seed": self.seed,
+            "verbose": self.verbose,
+            "train_path": train_path,
+            "val_path": val_path,
+            "ckpt_path": ckpt_path,
+        }
+        results = self._run(_train_fn, spec)
+        rank0 = results[0]
+        return KerasModel(
+            model_json=spec["model_json"], weights=rank0["weights"],
+            custom_objects=self.custom_objects,
+            feature_cols=self.feature_cols, label_cols=self.label_cols,
+            history=rank0["history"], val_scores=rank0["val"],
+            checkpoint_path=ckpt_path)
+
+
+class KerasModel(HorovodModel):
+    """Fitted model: a lightweight transformer over the trained weights
+    (reference: KerasModel Spark Transformer)."""
+
+    def __init__(self, model_json, weights, custom_objects, feature_cols,
+                 label_cols, history=None, val_scores=None,
+                 checkpoint_path=None, output_cols=None):
+        super().__init__(feature_cols, label_cols, output_cols)
+        self.model_json = model_json
+        self.weights = weights
+        self.custom_objects = dict(custom_objects or {})
+        self.history = history or {}
+        self.val_scores = val_scores
+        self.checkpoint_path = checkpoint_path
+        self._model = None
+
+    @property
+    def keras_model(self):
+        """The trained tf.keras model (built lazily)."""
+        if self._model is None:
+            import tensorflow as tf
+
+            self._model = tf.keras.models.model_from_json(
+                self.model_json, custom_objects=self.custom_objects or None)
+            self._model.set_weights(self.weights)
+        return self._model
+
+    def _predict(self, X):
+        return self.keras_model.predict(X, verbose=0)
+
+    @classmethod
+    def load(cls, model_json, checkpoint_path, feature_cols, label_cols,
+             custom_objects=None, output_cols=None):
+        """Rebuild a fitted model from a store checkpoint written by fit."""
+        with np.load(os.path.join(checkpoint_path,
+                                  "model_weights.npz")) as z:
+            weights = [z[k] for k in z.files]
+        return cls(model_json, weights, custom_objects, feature_cols,
+                   label_cols, checkpoint_path=checkpoint_path,
+                   output_cols=output_cols)

@@ -1,0 +1,212 @@
+"""Shared estimator machinery (reference: ``horovod/spark/common/params.py``
+``EstimatorParams`` and ``horovod/spark/common/backend.py`` ``SparkBackend``).
+
+The reference materializes the DataFrame to parquet with petastorm and
+launches ranks inside Spark executors. Neither petastorm nor pyspark is
+assumed here: DataFrames are pandas (a pyspark DataFrame is accepted and
+converted via ``toPandas()`` when pyspark is present), shards are written to
+the :class:`~horovod_tpu.spark.store.Store` as ``.npz`` files, and training
+runs through a :class:`Backend` — by default N negotiated local ranks (the
+same launch path ``tpurun`` and :class:`~horovod_tpu.ray.RayExecutor` use),
+or barrier Spark tasks via :func:`horovod_tpu.spark.run` when pyspark is
+available.
+"""
+import os
+import tempfile
+import time
+
+import numpy as np
+
+from .store import LocalStore, Store
+
+
+class Backend:
+    """Where estimator ranks run (reference: common/backend.py)."""
+
+    def run(self, fn, args, num_proc, env, timeout):
+        raise NotImplementedError
+
+
+class LocalBackend(Backend):
+    """N local processes with negotiated slot env (the default here; the
+    reference's default SparkBackend needs a live SparkContext)."""
+
+    def run(self, fn, args, num_proc, env, timeout):
+        from ..ray.runner import RayExecutor
+
+        ex = RayExecutor(num_proc, backend="local", env=env,
+                         timeout=timeout).start()
+        try:
+            return ex.run(fn, args=args)
+        finally:
+            ex.shutdown()
+
+
+class SparkBackend(Backend):
+    """Ranks as barrier Spark tasks (reference: SparkBackend → horovod.spark
+    gloo/mpi run). Requires pyspark."""
+
+    def run(self, fn, args, num_proc, env, timeout):
+        from . import run as spark_run
+
+        return spark_run(fn, args=args, num_proc=num_proc, extra_env=env,
+                         timeout=timeout)
+
+
+class EstimatorParams:
+    """Common estimator parameters (reference: EstimatorParams — model,
+    loss, feature/label cols, batch size, epochs, validation, store,
+    backend, num_proc, shuffle, verbose)."""
+
+    def __init__(self, model=None, loss=None, feature_cols=None,
+                 label_cols=None, batch_size=32, epochs=1, validation=None,
+                 num_proc=2, backend=None, store=None, run_id=None,
+                 shuffle=True, verbose=0, seed=0, timeout=600.0):
+        self.model = model
+        self.loss = loss
+        self.feature_cols = list(feature_cols or [])
+        self.label_cols = list(label_cols or [])
+        self.batch_size = int(batch_size)
+        self.epochs = int(epochs)
+        self.validation = validation
+        self.num_proc = int(num_proc)
+        self.backend = backend or LocalBackend()
+        self.store = store
+        self.run_id = run_id
+        self.shuffle = bool(shuffle)
+        self.verbose = int(verbose)
+        self.seed = int(seed)
+        self.timeout = float(timeout)
+
+    # -- shared fit plumbing ----------------------------------------------
+
+    def _check_params(self):
+        if self.model is None:
+            raise ValueError("model is required")
+        if not self.feature_cols or not self.label_cols:
+            raise ValueError("feature_cols and label_cols are required")
+        if self.num_proc < 1:
+            raise ValueError("num_proc must be >= 1")
+
+    def _prepare_store(self):
+        """Returns ``(store, run_id)``. A fresh run_id is minted per fit()
+        when the user didn't pin one — otherwise a second fit() on the same
+        estimator would overwrite the first run's shards and checkpoint."""
+        if self.store is None:
+            self.store = LocalStore(
+                tempfile.mkdtemp(prefix="hvd-estimator-"))
+        elif not isinstance(self.store, Store):
+            self.store = Store.create(self.store)
+        run_id = self.run_id or f"run-{int(time.time() * 1000)}"
+        return self.store, run_id
+
+    def _materialize(self, df, run_id):
+        """Split ``df`` into train/val and write one ``.npz`` shard per rank
+        under the store's intermediate data paths (reference: petastorm
+        parquet materialization in common/util.py ``prepare_data``).
+
+        Every rank gets exactly the same number of rows (the remainder is
+        dropped, train and val): unequal shards would give ranks different
+        per-epoch step counts and deadlock the per-batch gradient allreduce,
+        and a val set reaching only some ranks would strand the others out
+        of the validation metric_average. Equal shards also mean val is
+        empty on ALL ranks or none, so workers can gate on their own shard.
+
+        Returns ``(train_path, val_path, n_val_rows_per_rank)``.
+        """
+        df = _as_pandas(df)
+        missing = [c for c in self.feature_cols + self.label_cols
+                   if c not in df.columns]
+        if missing:
+            raise ValueError(f"columns not in DataFrame: {missing}")
+
+        X = df[self.feature_cols].to_numpy(dtype=np.float32)
+        Y = df[self.label_cols].to_numpy(dtype=np.float32)
+        n = len(df)
+        rng = np.random.default_rng(self.seed)
+        order = rng.permutation(n) if self.shuffle else np.arange(n)
+
+        # validation: a fraction (random tail of the shuffled order) or a
+        # boolean column naming the validation rows (reference semantics).
+        if isinstance(self.validation, str):
+            mask = df[self.validation].to_numpy().astype(bool)
+            val_idx = order[mask[order]]
+            train_idx = order[~mask[order]]
+        elif self.validation:
+            n_val = int(n * float(self.validation))
+            val_idx, train_idx = order[:n_val], order[n_val:]
+        else:
+            val_idx, train_idx = order[:0], order
+
+        if len(train_idx) < self.num_proc:
+            raise ValueError(
+                f"{len(train_idx)} training rows cannot feed "
+                f"{self.num_proc} ranks")
+        per_rank = len(train_idx) // self.num_proc
+        train_idx = train_idx[:per_rank * self.num_proc]
+        val_per_rank = len(val_idx) // self.num_proc
+        val_idx = val_idx[:val_per_rank * self.num_proc]
+
+        train_path = self.store.get_train_data_path(run_id)
+        val_path = self.store.get_val_data_path(run_id)
+        for r in range(self.num_proc):
+            tr = train_idx[r::self.num_proc]
+            va = val_idx[r::self.num_proc]
+            np.savez(os.path.join(train_path, f"shard-{r}.npz"),
+                     X=X[tr], Y=Y[tr])
+            np.savez(os.path.join(val_path, f"shard-{r}.npz"),
+                     X=X[va], Y=Y[va])
+        return train_path, val_path, val_per_rank
+
+    def _run(self, fn, spec):
+        """Launch the per-rank training fn through the backend."""
+        env = {"JAX_PLATFORMS": "cpu"}  # estimator workers never need a TPU
+        return self.backend.run(fn, (spec,), self.num_proc, env,
+                                self.timeout)
+
+
+def _as_pandas(df):
+    import pandas as pd
+
+    if isinstance(df, pd.DataFrame):
+        return df
+    # pyspark DataFrame (or anything else exposing toPandas()).
+    if hasattr(df, "toPandas"):
+        return df.toPandas()
+    raise TypeError(f"expected a pandas (or pyspark) DataFrame, got "
+                    f"{type(df).__name__}")
+
+
+def load_shard(path, rank):
+    """Read rank's materialized shard → (X, Y) float32 arrays."""
+    with np.load(os.path.join(path, f"shard-{rank}.npz")) as z:
+        return z["X"], z["Y"]
+
+
+class HorovodModel:
+    """Base for fitted models (reference: common/estimator.py
+    ``HorovodModel`` — a Spark Transformer; here ``transform`` appends
+    prediction columns to a pandas DataFrame)."""
+
+    def __init__(self, feature_cols, label_cols, output_cols=None):
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.output_cols = list(
+            output_cols or [f"{c}__output" for c in self.label_cols])
+
+    def _predict(self, X):
+        raise NotImplementedError
+
+    def transform(self, df):
+        df = _as_pandas(df).copy()
+        X = df[self.feature_cols].to_numpy(dtype=np.float32)
+        pred = np.asarray(self._predict(X))
+        if pred.ndim == 1:
+            pred = pred[:, None]
+        if pred.shape[1] != len(self.output_cols):
+            raise ValueError(
+                f"model produced {pred.shape[1]} outputs for "
+                f"{len(self.output_cols)} output_cols")
+        for j, c in enumerate(self.output_cols):
+            df[c] = pred[:, j]
+        return df

@@ -1,0 +1,165 @@
+"""TorchEstimator — estimator-style data-parallel PyTorch training
+(reference: ``horovod/spark/torch/estimator.py`` ``TorchEstimator`` /
+``TorchModel``).
+
+Same shape as :mod:`horovod_tpu.spark.keras`: ``fit(df)`` materializes the
+DataFrame to the store, launches ``num_proc`` ranks through the backend,
+trains with the torch binding (``broadcast_parameters`` +
+``DistributedOptimizer`` gradient hooks), rank 0 checkpoints the
+state_dict to the store, and a :class:`TorchModel` transformer comes back.
+"""
+import os
+
+import cloudpickle
+import numpy as np
+
+from .params import EstimatorParams, HorovodModel, load_shard
+
+
+def _train_fn(spec):
+    """Per-rank training body (fresh process, slot env already set)."""
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    torch.manual_seed(spec["seed"] + r)
+
+    model = cloudpickle.loads(spec["model"])
+    loss_fn = cloudpickle.loads(spec["loss"])
+    opt_class, opt_defaults = cloudpickle.loads(spec["optimizer"])
+    optimizer = opt_class(model.parameters(), **opt_defaults)
+
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+
+    X, Y = load_shard(spec["train_path"], r)
+    X, Y = torch.from_numpy(X), torch.from_numpy(Y)
+    bs, n = spec["batch_size"], len(X)
+
+    history = []
+    for epoch in range(spec["epochs"]):
+        order = torch.randperm(n) if spec["shuffle"] else torch.arange(n)
+        total, seen = 0.0, 0
+        model.train()
+        for i in range(0, n, bs):
+            idx = order[i:i + bs]
+            optimizer.zero_grad()
+            loss = loss_fn(model(X[idx]), Y[idx])
+            loss.backward()
+            optimizer.step()
+            total += float(loss) * len(idx)
+            seen += len(idx)
+        history.append(hvd.metric_average(total / max(seen, 1),
+                                          f"est_loss_{epoch}"))
+
+    val = None
+    Xv, Yv = load_shard(spec["val_path"], r)
+    if len(Xv):
+        model.eval()
+        with torch.no_grad():
+            vloss = float(loss_fn(model(torch.from_numpy(Xv)),
+                                  torch.from_numpy(Yv)))
+        val = hvd.metric_average(vloss, "est_val_loss")
+
+    state = {k: v.cpu() for k, v in model.state_dict().items()}
+    if r == 0:
+        torch.save(state, os.path.join(spec["ckpt_path"], "model.pt"))
+    hvd.shutdown()
+    return {"loss_history": history, "val_loss": val,
+            "state_dict": state if r == 0 else None}
+
+
+class TorchEstimator(EstimatorParams):
+    """Data-parallel PyTorch estimator (reference: TorchEstimator).
+
+    ``optimizer`` is a torch optimizer instance bound to ``model`` (its
+    class + defaults are rebuilt per rank, reference semantics) or a
+    callable ``params -> optimizer``. ``loss`` is a callable, e.g.
+    ``torch.nn.MSELoss()``.
+    """
+
+    def __init__(self, optimizer=None, **kwargs):
+        super().__init__(**kwargs)
+        self.optimizer = optimizer
+
+    def _serialize_optimizer(self):
+        import torch
+
+        opt = self.optimizer
+        if opt is None:
+            return cloudpickle.dumps((torch.optim.SGD, {"lr": 0.01}))
+        if isinstance(opt, torch.optim.Optimizer):
+            return cloudpickle.dumps((type(opt), dict(opt.defaults)))
+        if callable(opt):
+            # Factory: wrap so the worker sees the same (class, kwargs)
+            # calling convention.
+            return cloudpickle.dumps((opt, {}))
+        raise TypeError(f"optimizer must be a torch optimizer instance or "
+                        f"a params->optimizer callable, got {type(opt)}")
+
+    def fit(self, df):
+        self._check_params()
+        store, run_id = self._prepare_store()
+        train_path, val_path, _ = self._materialize(df, run_id)
+        ckpt_path = store.get_checkpoint_path(run_id)
+
+        if self.loss is None or not callable(self.loss):
+            raise ValueError("loss must be a callable (e.g. nn.MSELoss())")
+        spec = {
+            "model": cloudpickle.dumps(self.model),
+            "optimizer": self._serialize_optimizer(),
+            "loss": cloudpickle.dumps(self.loss),
+            "batch_size": self.batch_size,
+            "epochs": self.epochs,
+            "shuffle": self.shuffle,
+            "seed": self.seed,
+            "train_path": train_path,
+            "val_path": val_path,
+            "ckpt_path": ckpt_path,
+        }
+        results = self._run(_train_fn, spec)
+        rank0 = results[0]
+        model = cloudpickle.loads(spec["model"])
+        model.load_state_dict(rank0["state_dict"])
+        return TorchModel(
+            model=model, feature_cols=self.feature_cols,
+            label_cols=self.label_cols, history=rank0["loss_history"],
+            val_loss=rank0["val_loss"], checkpoint_path=ckpt_path)
+
+
+class TorchModel(HorovodModel):
+    """Fitted model over the trained module (reference: TorchModel)."""
+
+    def __init__(self, model, feature_cols, label_cols, history=None,
+                 val_loss=None, checkpoint_path=None, output_cols=None):
+        super().__init__(feature_cols, label_cols, output_cols)
+        self.model = model
+        self.history = history or []
+        self.val_loss = val_loss
+        self.checkpoint_path = checkpoint_path
+
+    def _predict(self, X):
+        import torch
+
+        self.model.eval()
+        with torch.no_grad():
+            # copy: df-backed arrays can be read-only views, which torch
+            # rejects for zero-copy tensor construction.
+            x = torch.from_numpy(np.array(X, dtype=np.float32, copy=True))
+            return self.model(x).numpy()
+
+    @classmethod
+    def load(cls, model, checkpoint_path, feature_cols, label_cols,
+             output_cols=None):
+        """Rebuild a fitted model from a store checkpoint written by fit:
+        ``model`` is an architecture instance to load the state_dict into."""
+        import torch
+
+        state = torch.load(os.path.join(checkpoint_path, "model.pt"),
+                           weights_only=True)
+        model.load_state_dict(state)
+        return cls(model, feature_cols, label_cols,
+                   checkpoint_path=checkpoint_path, output_cols=output_cols)
