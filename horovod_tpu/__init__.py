@@ -163,3 +163,4 @@ def tpu_built():
 
 
 from . import elastic  # noqa: F401,E402  (hvd.elastic.run / State / ObjectState)
+from . import profiler  # noqa: F401,E402  (xplane trace windows + op ranges)

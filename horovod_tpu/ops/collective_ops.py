@@ -467,3 +467,32 @@ def remove_process_set_collective(process_set_id):
     h = _check_handle(
         _lib.hvd_remove_process_set_async(name.encode(), int(process_set_id)))
     synchronize(_register(Handle(h, "remove_process_set", (), None, None, name)))
+
+
+# ---------------------------------------------------------------------------
+# Profiler ranges around the user-facing op calls (reference:
+# horovod/common/nvtx_op_range.h wraps every Enqueue-level API call in an
+# NVTX range for nsys; the TPU mapping is an xplane TraceAnnotation — see
+# horovod_tpu/profiler.py). Applied by rebinding so internal callers
+# (sync wrappers, grouped fan-out) go through the ranges too; a shared
+# no-op context when HVD_PROFILER is off keeps the disabled cost at one
+# flag check per call.
+
+def _profiled(fn, range_name):
+    import functools
+
+    from .. import profiler as _profiler
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with _profiler.op_range(range_name):
+            return fn(*args, **kwargs)
+    return wrapper
+
+
+for _op in ("allreduce_async", "allgather_async", "broadcast_async",
+            "alltoall_async", "reducescatter_async", "join", "barrier",
+            "synchronize"):
+    _name = "hvd." + _op.removesuffix("_async")
+    globals()[_op] = _profiled(globals()[_op], _name)
+del _op, _name

@@ -14,6 +14,7 @@ available.
 import os
 import tempfile
 import time
+import uuid
 
 import numpy as np
 
@@ -83,6 +84,8 @@ class EstimatorParams:
     def _check_params(self):
         if self.model is None:
             raise ValueError("model is required")
+        if self.loss is None:
+            raise ValueError("loss is required")
         if not self.feature_cols or not self.label_cols:
             raise ValueError("feature_cols and label_cols are required")
         if self.num_proc < 1:
@@ -97,7 +100,11 @@ class EstimatorParams:
                 tempfile.mkdtemp(prefix="hvd-estimator-"))
         elif not isinstance(self.store, Store):
             self.store = Store.create(self.store)
-        run_id = self.run_id or f"run-{int(time.time() * 1000)}"
+        # uuid suffix: wall-clock alone collides when two fits share a
+        # store in the same millisecond, silently cross-contaminating
+        # shards and checkpoints.
+        run_id = self.run_id or (f"run-{int(time.time() * 1000)}-"
+                                 f"{uuid.uuid4().hex[:8]}")
         return self.store, run_id
 
     def _materialize(self, df, run_id):

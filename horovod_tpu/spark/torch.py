@@ -100,14 +100,17 @@ class TorchEstimator(EstimatorParams):
         raise TypeError(f"optimizer must be a torch optimizer instance or "
                         f"a params->optimizer callable, got {type(opt)}")
 
+    def _check_params(self):
+        super()._check_params()
+        if not callable(self.loss):
+            raise ValueError("loss must be a callable (e.g. nn.MSELoss())")
+
     def fit(self, df):
         self._check_params()
         store, run_id = self._prepare_store()
         train_path, val_path, _ = self._materialize(df, run_id)
         ckpt_path = store.get_checkpoint_path(run_id)
 
-        if self.loss is None or not callable(self.loss):
-            raise ValueError("loss must be a callable (e.g. nn.MSELoss())")
         spec = {
             "model": cloudpickle.dumps(self.model),
             "optimizer": self._serialize_optimizer(),

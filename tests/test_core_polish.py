@@ -97,6 +97,25 @@ def test_single_rank_shutdown_does_not_hang():
     assert "HorovodInternalError as expected" in out, out
 
 
+def test_profiler_op_ranges_and_trace_window(tmp_path):
+    """Profiler parity (reference: nvtx_op_range.h → TPU xplane mapping,
+    SURVEY §5): with HVD_PROFILER=1 collectives run inside TraceAnnotation
+    ranges, start/stop opens a trace window, and the xplane artifact is
+    written. Off by default: op_range is a shared no-op context."""
+    from horovod_tpu import profiler
+
+    assert not profiler.enabled()
+    import contextlib
+
+    assert isinstance(profiler.op_range("x"), contextlib.nullcontext)
+
+    codes, out = _run_job(2, "profiler_worker.py",
+                          extra_env={"HVD_PROFILER": "1",
+                                     "PROFILE_DIR": str(tmp_path)})
+    assert codes == [0, 0], out
+    assert out.count("OK") == 2, out
+
+
 def test_log_level_consumed():
     """HVD_LOG_LEVEL=info surfaces core init/shutdown logs; the default
     (warn) keeps them silent (reference: logging.cc HOROVOD_LOG_LEVEL)."""
