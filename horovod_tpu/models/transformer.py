@@ -40,7 +40,20 @@ class TransformerConfig:
     d_ff: int = 4096
     max_seq_len: int = 2048
     n_experts: int = 0          # 0 = dense FFN; >0 = MoE every layer
-    attn_impl: str = "gather"   # "gather" (K/V all-gather) | "ring"
+    # "gather" (K/V all-gather, XLA logits) | "ring" (seq-sharded K/V over
+    # ICI) | "flash" (fused pallas kernel, ops/pallas_attention.py)
+    attn_impl: str = "gather"
+    # >0: the loss computes vocab logits + log-softmax in sequence chunks of
+    # this many positions (rematerialized), so the [S, vocab] float32 tensor
+    # never exists — at S=8k x 30k vocab that tensor plus its backward temps
+    # is gigabytes and caps single-chip sequence length before attention
+    # does. 0 = single full-sequence projection.
+    loss_chunk: int = 0
+    # Rematerialize each transformer block in the backward pass
+    # (jax.checkpoint): activation memory drops from O(n_layers * S * d *
+    # intermediates) to O(n_layers * S * d), buying the last 2-4x of
+    # single-chip sequence length for ~1/3 more compute.
+    remat: bool = False
     dtype: str = "bfloat16"
     # mesh axis names (any may be absent from the actual mesh; specs using a
     # missing name are invalid, so axes not in the mesh must be None'd via
@@ -51,9 +64,9 @@ class TransformerConfig:
     expert_axis: str = "expert"
 
     def __post_init__(self):
-        if self.attn_impl not in ("gather", "ring"):
+        if self.attn_impl not in ("gather", "ring", "flash"):
             raise ValueError(
-                f"attn_impl must be 'gather' or 'ring', got "
+                f"attn_impl must be 'gather', 'ring' or 'flash', got "
                 f"{self.attn_impl!r}")
 
     @property
@@ -204,6 +217,44 @@ def _attention_ring(x, layer, cfg, mesh, seq_spec):
     return jax.lax.with_sharding_constraint(out, seq_spec)
 
 
+def _attention_flash(x, layer, cfg, mesh, seq_spec):
+    """Fused pallas flash-attention path (ops/pallas_attention.py): the
+    [B,H,S,S] logits tensor never exists in HBM. Composes with dp (batch
+    over `data`) and tp (heads over `model`) via shard_map; a
+    sequence-sharded mesh needs attn_impl='ring' instead. On non-TPU
+    backends the kernel runs in the Pallas interpreter (numerics identical,
+    speed irrelevant — that path exists for CPU tests)."""
+    from ..ops.pallas_attention import flash_attention
+
+    dt = cfg.compute_dtype
+    qkv = jnp.einsum("bsd,dchk->cbshk", x, layer["wqkv"].astype(dt))
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    interpret = jax.default_backend() == "cpu"
+    attn = lambda q, k, v: flash_attention(  # noqa: E731
+        q, k, v, causal=True, interpret=interpret)
+    if mesh is None:
+        ctx = attn(q, k, v)
+    else:
+        names = set(mesh.axis_names)
+        s_ax = cfg.seq_axis if cfg.seq_axis in names else None
+        if s_ax and mesh.shape[s_ax] > 1:
+            raise ValueError("attn_impl='flash' does not compose with a "
+                             "sequence-sharded mesh; use 'ring'")
+        d = cfg.data_axis if cfg.data_axis in names else None
+        m = cfg.model_axis if cfg.model_axis in names else None
+        if m and cfg.n_heads % mesh.shape[m] != 0:
+            raise ValueError(
+                f"attn_impl='flash' needs n_heads {cfg.n_heads} divisible "
+                f"by the '{m}' axis size {mesh.shape[m]}")
+        spec = P(d, None, m, None)
+        ctx = jax.shard_map(attn, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check_vma=False)(q, k, v)
+    out = jnp.einsum("bshk,hkd->bsd", ctx, layer["wo"].astype(dt))
+    if seq_spec is not None:
+        out = jax.lax.with_sharding_constraint(out, seq_spec)
+    return out
+
+
 def _attention(x, layer, cfg, seq_spec=None, full_spec=None):
     """Causal multi-head attention. With specs given, activations arrive
     seq-sharded and K/V are materialised full-sequence (XLA all-gather over
@@ -255,8 +306,11 @@ def _ffn(x, layer, cfg):
     return jnp.einsum("bsf,fd->bsd", h, layer["w_out"].astype(dt))
 
 
-def forward(params, tokens, cfg: TransformerConfig, mesh=None):
-    """tokens [B, S] int32 → logits [B, S, vocab] (compute dtype).
+def forward(params, tokens, cfg: TransformerConfig, mesh=None,
+            return_hidden=False):
+    """tokens [B, S] int32 → logits [B, S, vocab] (compute dtype), or the
+    final-layernorm hidden states [B, S, d] with ``return_hidden=True``
+    (the chunked loss projects to vocab itself).
 
     When `mesh` is given, activations carry dp/sp sharding constraints; with
     mesh=None it is ordinary single-device JAX.
@@ -279,11 +333,14 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None):
     x = params["embed"].astype(dt)[tokens]
     x = x + params["pos_embed"].astype(dt)[:S][None]
     x = constrain(x, seq_spec)
-    for layer in params["layers"]:
+
+    def block(x, layer):
         h = _layer_norm(x, layer["ln1"])
         if (cfg.attn_impl == "ring" and mesh is not None
                 and cfg.seq_axis in mesh.axis_names):
             x = x + _attention_ring(h, layer, cfg, mesh, seq_spec)
+        elif cfg.attn_impl == "flash":
+            x = x + _attention_flash(h, layer, cfg, mesh, seq_spec)
         else:
             x = x + _attention(h, layer, cfg, seq_spec, full_spec)
         h = _layer_norm(x, layer["ln2"])
@@ -291,17 +348,54 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None):
             x = x + _moe_ffn(h, layer, cfg)
         else:
             x = x + _ffn(h, layer, cfg)
-        x = constrain(x, seq_spec)
+        return constrain(x, seq_spec)
+
+    if cfg.remat:
+        block = jax.checkpoint(block)
+    for layer in params["layers"]:
+        x = block(x, layer)
     x = _layer_norm(x, params["final_ln"])
+    if return_hidden:
+        return x
     logits = jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(dt))
     return logits
 
 
-def loss_fn(params, batch, cfg: TransformerConfig, mesh=None):
-    """Next-token cross-entropy. batch = {"tokens": [B, S+1] int32}."""
-    tokens = batch["tokens"]
-    logits = forward(params, tokens[:, :-1], cfg, mesh=mesh)
-    targets = tokens[:, 1:]
+def _nll(hidden, targets, embed):
+    """-log p(target) per position from pre-projection hidden states."""
+    logits = jnp.einsum("bsd,vd->bsv", hidden, embed.astype(hidden.dtype))
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
-    return jnp.mean(nll)
+    return -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+
+
+def loss_fn(params, batch, cfg: TransformerConfig, mesh=None):
+    """Next-token cross-entropy. batch = {"tokens": [B, S+1] int32}.
+
+    With ``cfg.loss_chunk > 0`` the vocab projection + log-softmax run per
+    sequence chunk under jax.checkpoint inside a scan (see the config
+    field's rationale); the chunked and full losses are identical.
+    """
+    tokens = batch["tokens"]
+    targets = tokens[:, 1:]
+    C = cfg.loss_chunk
+    S = targets.shape[1]
+    if not C or S <= C:
+        hidden = forward(params, tokens[:, :-1], cfg, mesh=mesh,
+                         return_hidden=True)
+        return jnp.mean(_nll(hidden, targets, params["embed"]))
+
+    if S % C != 0:
+        raise ValueError(f"seq len {S} must divide by loss_chunk {C}")
+    hidden = forward(params, tokens[:, :-1], cfg, mesh=mesh,
+                     return_hidden=True)
+    B, _, d = hidden.shape
+    h_chunks = hidden.reshape(B, S // C, C, d).swapaxes(0, 1)
+    t_chunks = targets.reshape(B, S // C, C).swapaxes(0, 1)
+
+    def body(total, xs):
+        h, t = xs
+        return total + jnp.sum(_nll(h, t, params["embed"])), None
+
+    total, _ = jax.lax.scan(jax.checkpoint(body), jnp.float32(0.0),
+                            (h_chunks, t_chunks))
+    return total / (B * S)

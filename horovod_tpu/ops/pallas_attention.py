@@ -1,0 +1,327 @@
+"""Fused causal attention as a Pallas TPU kernel (FlashAttention-style).
+
+The hot op of the transformer bench. The XLA path in
+``models/transformer.py::_attention`` materializes the [B, H, S, S] logits
+tensor in HBM — at S=4k that is 13+ GB of rematerialized temps and the
+step no longer fits a v5e chip; this kernel streams K/V blocks through
+VMEM (online softmax forward, FlashAttention-2 recomputation backward)
+with float32 accumulators in scratch, so memory is O(S·D) and 16k+
+sequences train on one chip.
+
+Structure: every kernel runs on a grid ``(B*H, blocks, blocks)`` whose
+innermost dimension streams the contraction blocks (K blocks for the
+forward/dq kernels, Q blocks for the dk/dv kernel); accumulators live in
+VMEM scratch, initialized on the first inner step and flushed to the
+output refs on the last. Causal skipping is predicated (``@pl.when``), so
+masked-out block pairs cost a prefetch but no MXU time. ``block_q ==
+block_k`` keeps the causal frontier exactly one diagonal block.
+
+No counterpart exists in the reference (its attention lives in user
+scripts / framework libraries); this is the "pallas kernels for the hot
+ops" half of the TPU-native design. Layouts follow the models/ convention
+``[B, S, H, D]``. LSE/delta ride a ``[B*H, nq, 1, block]`` layout so the
+row sits on the 128-lane dim (a ``[S, 1]`` layout pads the unit dim to
+128 lanes — 4 MB per array at S=8k).
+
+``interpret=True`` runs the same kernels on CPU (used by the numerics
+tests, which check fwd + grads against the naive XLA attention).
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU-only hosts too; guard for safety.
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+_NEG_INF = -1e30
+
+
+def _vspec(block, index_map=None):
+    return pl.BlockSpec(block, index_map, memory_space=_VMEM)
+
+
+def _scratch(shape):
+    if pltpu is not None:
+        return pltpu.VMEM(shape, jnp.float32)
+    return pltpu  # pragma: no cover
+
+
+def _causal_mask(s, diag, bq, bk):
+    """Mask the diagonal block; off-diagonal active blocks are fully
+    visible (block_q == block_k)."""
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(jnp.logical_not(diag) | (qpos >= kpos), s, _NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# Forward: grid (B*H, nq, nk) — K/V blocks stream through the inner dim.
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
+                sm_scale, causal):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, _NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    @pl.when(jnp.logical_not(causal) | (kj <= qi))
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * sm_scale        # [bq, D]
+        k = k_ref[0].astype(jnp.float32)                   # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, kj == qi, *s.shape)
+        m_prev, l_prev = m_s[:], l_s[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_s[:] = m_new
+        l_s[:] = l_prev * alpha + jnp.sum(p, -1, keepdims=True)
+        acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _flush():
+        o_ref[0] = (acc_s[:] / l_s[:]).astype(o_ref.dtype)
+        lse_ref[0, 0, 0] = (m_s[:] + jnp.log(l_s[:]))[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# Backward (FlashAttention-2): recompute P per block pair.
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_s, *, sm_scale, causal):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_s[:] = jnp.zeros_like(dq_s)
+
+    @pl.when(jnp.logical_not(causal) | (kj <= qi))
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0, 0][:, None]
+        delta = delta_ref[0, 0, 0][:, None]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, kj == qi, *s.shape)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_s[:] = dq_s[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _flush():
+        dq_ref[0] = (dq_s[:] * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_s, dv_s, *, sm_scale, causal):
+    # Grid (B*H, nk, nq): Q blocks stream through the inner dim.
+    kj, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    @pl.when(jnp.logical_not(causal) | (qi >= kj))
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0, 0][:, None]
+        delta = delta_ref[0, 0, 0][:, None]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, kj == qi, *s.shape)
+        p = jnp.exp(s - lse)                                  # [bq, bk]
+        dv_s[:] = dv_s[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_s[:] = dk_s[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _flush():
+        dk_ref[0] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing over folded [B*H, S, D] arrays.
+
+def _fold(x):
+    # [B, S, H, D] -> [B*H, S, D]
+    B, S, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+
+def _unfold(x, B, H):
+    BH, S, D = x.shape
+    return x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def _compiler_params():
+    if pltpu is None:  # pragma: no cover
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
+def _call_fwd(q, k, v, sm_scale, causal, block, interpret):
+    BH, S, D = q.shape
+    n = S // block
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
+                               causal=causal)
+    flops = 4 * BH * S * S * D // (2 if causal else 1)
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, n, n),
+        in_specs=[
+            _vspec((1, block, D), lambda bh, i, j: (bh, i, 0)),
+            _vspec((1, block, D), lambda bh, i, j: (bh, j, 0)),
+            _vspec((1, block, D), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=[
+            _vspec((1, block, D), lambda bh, i, j: (bh, i, 0)),
+            _vspec((1, 1, 1, block), lambda bh, i, j: (bh, i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, n, 1, block), jnp.float32),
+        ],
+        scratch_shapes=[_scratch((block, 1)), _scratch((block, 1)),
+                        _scratch((block, D))],
+        compiler_params=_compiler_params(),
+        cost_estimate=pl.CostEstimate(
+            flops=flops, transcendentals=BH * S * S,
+            bytes_accessed=3 * BH * S * D * q.dtype.itemsize),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _call_bwd(q, k, v, do, lse, delta, sm_scale, causal, block, interpret):
+    BH, S, D = q.shape
+    n = S // block
+
+    def q_blk(sel):
+        return _vspec((1, block, D), lambda bh, i, j: (bh, sel(i, j), 0))
+
+    def lse_blk(sel):
+        return _vspec((1, 1, 1, block),
+                      lambda bh, i, j: (bh, sel(i, j), 0, 0))
+
+    i_of = lambda i, j: i  # noqa: E731
+    j_of = lambda i, j: j  # noqa: E731
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal),
+        grid=(BH, n, n),
+        in_specs=[q_blk(i_of), q_blk(j_of), q_blk(j_of), q_blk(i_of),
+                  lse_blk(i_of), lse_blk(i_of)],
+        out_specs=q_blk(i_of),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        scratch_shapes=[_scratch((block, D))],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # Grid (BH, nk, nq): the kernel reads K/V at the middle index and
+    # streams Q/dO/lse/delta along the inner one.
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal),
+        grid=(BH, n, n),
+        in_specs=[q_blk(j_of), q_blk(i_of), q_blk(i_of), q_blk(j_of),
+                  lse_blk(j_of), lse_blk(j_of)],
+        out_specs=[q_blk(i_of), q_blk(i_of)],
+        out_shape=[jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((BH, S, D), v.dtype)],
+        scratch_shapes=[_scratch((block, D)), _scratch((block, D))],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, sm_scale, block, interpret):
+    o, _ = _flash_fwd(q, k, v, causal, sm_scale, block, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block, interpret):
+    o, lse = _call_fwd(q, k, v, sm_scale, causal, block, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, sm_scale, block, interpret, res, do):
+    q, k, v, o, lse = res
+    BH, S, _ = q.shape
+    # delta_i = rowsum(dO_i * O_i) — the FA2 softmax-jacobian correction;
+    # packed to the same [BH, nq, 1, block] layout as lse.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), -1)
+    delta = delta.reshape(BH, S // block, 1, block)
+    return _call_bwd(q, k, v, do, lse, delta, sm_scale, causal, block,
+                     interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal=True, sm_scale=None, block=128,
+                    interpret=False):
+    """Fused multi-head attention. q, k, v: ``[B, S, H, D]`` (same S for q
+    and k/v). Returns ``[B, S, H, D]`` in the input dtype; softmax and
+    accumulation run in float32 on-chip.
+
+    ``block`` is both the query and key block size (S must divide by it);
+    ``interpret=True`` runs the kernels in the Pallas interpreter (CPU).
+    """
+    B, S, H, D = q.shape
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(f"q/k/v shapes must match, got {q.shape} "
+                         f"{k.shape} {v.shape}")
+    block = min(block, S)
+    if S % block != 0:
+        raise ValueError(f"seq len {S} must be divisible by block {block}")
+    if block % 8 != 0:
+        # Mosaic's sublane tiling would reject this later with an opaque
+        # compile error; fail at the API boundary instead.
+        raise ValueError(f"block size {block} must be a multiple of 8")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    out = _flash(_fold(q), _fold(k), _fold(v), bool(causal),
+                 float(sm_scale), int(block), bool(interpret))
+    return _unfold(out, B, H)
