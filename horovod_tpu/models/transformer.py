@@ -229,7 +229,7 @@ def _attention_flash(x, layer, cfg, mesh, seq_spec):
     dt = cfg.compute_dtype
     qkv = jnp.einsum("bsd,dchk->cbshk", x, layer["wqkv"].astype(dt))
     q, k, v = qkv[0], qkv[1], qkv[2]
-    interpret = jax.default_backend() == "cpu"
+    interpret = jax.default_backend() != "tpu"  # kernel is TPU-targeted
     attn = lambda q, k, v: flash_attention(  # noqa: E731
         q, k, v, causal=True, interpret=interpret)
     if mesh is None:

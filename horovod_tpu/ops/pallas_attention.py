@@ -54,19 +54,34 @@ def _scratch(shape):
     return pltpu  # pragma: no cover
 
 
-def _causal_mask(s, diag, bq, bk):
-    """Mask the diagonal block; off-diagonal active blocks are fully
-    visible (block_q == block_k)."""
+def _diag_keep(diag, mode, bq, bk):
+    """Visibility mask for the diagonal block; off-diagonal active blocks
+    are fully visible (block_q == block_k). mode: "diag" = q >= k
+    (ordinary causal); "strict" = q > k (the half-open masks ring
+    attention's striped layout needs for cross-shard blocks).
+
+    Callers must BOTH mask s with it AND zero p with it after the exp:
+    the -1e30 sentinel is finite, so on a fully-masked row
+    exp(s - max(s)) = exp(0) = 1 would silently un-mask everything."""
     qpos = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     kpos = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return jnp.where(jnp.logical_not(diag) | (qpos >= kpos), s, _NEG_INF)
+    keep = (qpos > kpos) if mode == "strict" else (qpos >= kpos)
+    return jnp.logical_not(diag) | keep
+
+
+def _active(mode, qi, kj):
+    """Block-level causal frontier: with any causal mode, key blocks past
+    the diagonal contribute nothing."""
+    if mode == "none":
+        return jnp.bool_(True)
+    return kj <= qi
 
 
 # ---------------------------------------------------------------------------
 # Forward: grid (B*H, nq, nk) — K/V blocks stream through the inner dim.
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
-                sm_scale, causal):
+                sm_scale, mode):
     qi, kj = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -76,18 +91,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
         l_s[:] = jnp.zeros_like(l_s)
         acc_s[:] = jnp.zeros_like(acc_s)
 
-    @pl.when(jnp.logical_not(causal) | (kj <= qi))
+    @pl.when(_active(mode, qi, kj))
     def _step():
         q = q_ref[0].astype(jnp.float32) * sm_scale        # [bq, D]
         k = k_ref[0].astype(jnp.float32)                   # [bk, D]
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
-            s = _causal_mask(s, kj == qi, *s.shape)
+        if mode != "none":
+            keep = _diag_keep(kj == qi, mode, *s.shape)
+            s = jnp.where(keep, s, _NEG_INF)
         m_prev, l_prev = m_s[:], l_s[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
         p = jnp.exp(s - m_new)
+        if mode != "none":
+            p = jnp.where(keep, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         m_s[:] = m_new
         l_s[:] = l_prev * alpha + jnp.sum(p, -1, keepdims=True)
@@ -97,15 +115,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
 
     @pl.when(kj == nk - 1)
     def _flush():
-        o_ref[0] = (acc_s[:] / l_s[:]).astype(o_ref.dtype)
-        lse_ref[0, 0, 0] = (m_s[:] + jnp.log(l_s[:]))[:, 0]
+        # Fully-masked rows (row 0 under mode="strict") have l == 0: emit
+        # o = 0 and lse = -inf-ish instead of NaN so downstream online
+        # merges (ring attention) treat them as "no contribution".
+        l_safe = jnp.where(l_s[:] > 0, l_s[:], 1.0)
+        o_ref[0] = (acc_s[:] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(l_s[:] > 0, m_s[:] + jnp.log(l_safe), _NEG_INF)
+        lse_ref[0, 0, 0] = lse[:, 0]
 
 
 # ---------------------------------------------------------------------------
 # Backward (FlashAttention-2): recompute P per block pair.
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_s, *, sm_scale, causal):
+                   dq_s, *, sm_scale, mode):
     qi, kj = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -113,7 +136,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_s[:] = jnp.zeros_like(dq_s)
 
-    @pl.when(jnp.logical_not(causal) | (kj <= qi))
+    @pl.when(_active(mode, qi, kj))
     def _step():
         q = q_ref[0].astype(jnp.float32) * sm_scale
         do = do_ref[0].astype(jnp.float32)
@@ -123,9 +146,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
-            s = _causal_mask(s, kj == qi, *s.shape)
         p = jnp.exp(s - lse)
+        if mode != "none":
+            # explicit zero, not just s = -1e30: a fully-masked row's
+            # sentinel lse would cancel the sentinel s in the exp.
+            p = jnp.where(_diag_keep(kj == qi, mode, *s.shape), p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
@@ -139,7 +164,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_s, dv_s, *, sm_scale, causal):
+                    dk_ref, dv_ref, dk_s, dv_s, *, sm_scale, mode):
     # Grid (B*H, nk, nq): Q blocks stream through the inner dim.
     kj, qi = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
@@ -149,7 +174,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_s[:] = jnp.zeros_like(dk_s)
         dv_s[:] = jnp.zeros_like(dv_s)
 
-    @pl.when(jnp.logical_not(causal) | (qi >= kj))
+    @pl.when(_active(mode, qi, kj))
     def _step():
         q = q_ref[0].astype(jnp.float32) * sm_scale
         do = do_ref[0].astype(jnp.float32)
@@ -159,9 +184,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
-            s = _causal_mask(s, kj == qi, *s.shape)
         p = jnp.exp(s - lse)                                  # [bq, bk]
+        if mode != "none":
+            p = jnp.where(_diag_keep(kj == qi, mode, *s.shape), p, 0.0)
         dv_s[:] = dv_s[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -199,12 +224,11 @@ def _compiler_params():
         dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
-def _call_fwd(q, k, v, sm_scale, causal, block, interpret):
+def _call_fwd(q, k, v, sm_scale, mode, block, interpret):
     BH, S, D = q.shape
     n = S // block
-    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
-                               causal=causal)
-    flops = 4 * BH * S * S * D // (2 if causal else 1)
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, mode=mode)
+    flops = 4 * BH * S * S * D // (1 if mode == "none" else 2)
     return pl.pallas_call(
         kernel,
         grid=(BH, n, n),
@@ -231,7 +255,7 @@ def _call_fwd(q, k, v, sm_scale, causal, block, interpret):
     )(q, k, v)
 
 
-def _call_bwd(q, k, v, do, lse, delta, sm_scale, causal, block, interpret):
+def _call_bwd(q, k, v, do, lse, delta, sm_scale, mode, block, interpret):
     BH, S, D = q.shape
     n = S // block
 
@@ -246,7 +270,7 @@ def _call_bwd(q, k, v, do, lse, delta, sm_scale, causal, block, interpret):
     j_of = lambda i, j: j  # noqa: E731
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal),
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, mode=mode),
         grid=(BH, n, n),
         in_specs=[q_blk(i_of), q_blk(j_of), q_blk(j_of), q_blk(i_of),
                   lse_blk(i_of), lse_blk(i_of)],
@@ -260,8 +284,7 @@ def _call_bwd(q, k, v, do, lse, delta, sm_scale, causal, block, interpret):
     # Grid (BH, nk, nq): the kernel reads K/V at the middle index and
     # streams Q/dO/lse/delta along the inner one.
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale,
-                          causal=causal),
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, mode=mode),
         grid=(BH, n, n),
         in_specs=[q_blk(j_of), q_blk(i_of), q_blk(i_of), q_blk(j_of),
                   lse_blk(j_of), lse_blk(j_of)],
@@ -276,39 +299,38 @@ def _call_bwd(q, k, v, do, lse, delta, sm_scale, causal, block, interpret):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, sm_scale, block, interpret):
-    o, _ = _flash_fwd(q, k, v, causal, sm_scale, block, interpret)
-    return o
+def _flash(q, k, v, mode, sm_scale, block, interpret):
+    """Returns (o [BH,S,D], lse [BH,nq,1,block]). lse is a real output
+    with its own cotangent: ring attention merges per-shard partials by
+    lse, so gradients flow through it."""
+    o, lse = _call_fwd(q, k, v, sm_scale, mode, block, interpret)
+    return o, lse
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block, interpret):
-    o, lse = _call_fwd(q, k, v, sm_scale, causal, block, interpret)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, mode, sm_scale, block, interpret):
+    o, lse = _call_fwd(q, k, v, sm_scale, mode, block, interpret)
+    return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, sm_scale, block, interpret, res, do):
+def _flash_bwd(mode, sm_scale, block, interpret, res, cts):
     q, k, v, o, lse = res
+    do, dlse = cts
     BH, S, _ = q.shape
-    # delta_i = rowsum(dO_i * O_i) — the FA2 softmax-jacobian correction;
-    # packed to the same [BH, nq, 1, block] layout as lse.
+    # delta_i = rowsum(dO_i * O_i) — the FA2 softmax-jacobian correction —
+    # packed to the same [BH, nq, 1, block] layout as lse. A cotangent on
+    # lse adds p * dlse to dS (d lse / d s_j = p_j), which folds into the
+    # same kernel as delta -> delta - dlse.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), -1)
     delta = delta.reshape(BH, S // block, 1, block)
-    return _call_bwd(q, k, v, do, lse, delta, sm_scale, causal, block,
+    delta = delta - dlse.astype(jnp.float32)
+    return _call_bwd(q, k, v, do, lse, delta, sm_scale, mode, block,
                      interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, *, causal=True, sm_scale=None, block=128,
-                    interpret=False):
-    """Fused multi-head attention. q, k, v: ``[B, S, H, D]`` (same S for q
-    and k/v). Returns ``[B, S, H, D]`` in the input dtype; softmax and
-    accumulation run in float32 on-chip.
-
-    ``block`` is both the query and key block size (S must divide by it);
-    ``interpret=True`` runs the kernels in the Pallas interpreter (CPU).
-    """
+def _validate(q, k, v, block):
     B, S, H, D = q.shape
     if k.shape != q.shape or v.shape != q.shape:
         raise ValueError(f"q/k/v shapes must match, got {q.shape} "
@@ -320,8 +342,39 @@ def flash_attention(q, k, v, *, causal=True, sm_scale=None, block=128,
         # Mosaic's sublane tiling would reject this later with an opaque
         # compile error; fail at the API boundary instead.
         raise ValueError(f"block size {block} must be a multiple of 8")
+    return block
+
+
+def flash_attention_lse(q, k, v, *, mode="diag", sm_scale=None, block=128,
+                        interpret=False):
+    """Like :func:`flash_attention` but also returns the per-row
+    log-sum-exp ``[B, H, S]`` (float32, ``-1e30`` on fully-masked rows) —
+    the statistic ring attention needs to merge per-shard partial
+    attentions. mode: "diag" (causal, q >= k), "strict" (q > k), "none"
+    (full attention). Differentiable in (q, k, v) including through lse.
+    """
+    if mode not in ("none", "diag", "strict"):
+        raise ValueError(f"unknown mode: {mode!r}")
+    B, S, H, D = q.shape
+    block = _validate(q, k, v, block)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
-    out = _flash(_fold(q), _fold(k), _fold(v), bool(causal),
-                 float(sm_scale), int(block), bool(interpret))
-    return _unfold(out, B, H)
+    o, lse = _flash(_fold(q), _fold(k), _fold(v), mode, float(sm_scale),
+                    int(block), bool(interpret))
+    return _unfold(o, B, H), lse.reshape(B, H, S)
+
+
+def flash_attention(q, k, v, *, causal=True, sm_scale=None, block=128,
+                    interpret=False):
+    """Fused multi-head attention. q, k, v: ``[B, S, H, D]`` (same S for q
+    and k/v). Returns ``[B, S, H, D]`` in the input dtype; softmax and
+    accumulation run in float32 on-chip.
+
+    ``block`` is both the query and key block size (S must divide by it);
+    ``interpret=True`` runs the kernels in the Pallas interpreter (CPU).
+    """
+    o, _ = flash_attention_lse(q, k, v,
+                               mode="diag" if causal else "none",
+                               sm_scale=sm_scale, block=block,
+                               interpret=interpret)
+    return o

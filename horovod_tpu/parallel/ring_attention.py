@@ -39,8 +39,20 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _merge_partials(o1, lse1, o2, lse2):
+    """Merge two normalized partial attentions by their log-sum-exp:
+    o = o1*exp(lse1-lse) + o2*exp(lse2-lse), lse = logaddexp(lse1, lse2).
+    o*: [B, S, H, D] f32; lse*: [B, H, S] f32 (-1e30 sentinel = empty —
+    finite, so the exp/logaddexp algebra never produces inf-inf NaNs)."""
+    lse = jnp.logaddexp(lse1, lse2)
+    w1 = jnp.exp(lse1 - lse).transpose(0, 2, 1)[..., None]
+    w2 = jnp.exp(lse2 - lse).transpose(0, 2, 1)[..., None]
+    return o1 * w1 + o2 * w2, lse
+
+
 def ring_attention(q, k, v, axis, causal=True, scale=None,
-                   layout="contiguous"):
+                   layout="contiguous", inner="einsum",
+                   inner_interpret=None, inner_block=128):
     """Blockwise ring attention over mesh axis `axis`.
 
     q, k, v: [B, S_blk, H, D] — the local sequence block of each shard.
@@ -52,15 +64,29 @@ def ring_attention(q, k, v, axis, causal=True, scale=None,
     ``"striped"`` — shard i holds {i, i+p, i+2p, ...} (striped/zig-zag
     attention: equal causal work on every device; see
     :func:`stripe_sequence`).
+
+    ``inner`` picks how each (q-shard, k-shard) block pair is computed:
+    ``"einsum"`` — XLA matmuls with an [S_blk, S_blk] logits tensor;
+    ``"flash"`` — the fused pallas kernel
+    (:func:`horovod_tpu.ops.pallas_attention.flash_attention_lse`), which
+    keeps per-pair memory at O(S_blk·D) so the LOCAL block can itself be
+    many thousands of tokens; partials are merged by log-sum-exp. The
+    cross-shard causal masks map onto the kernel's modes exactly:
+    contiguous → full/"diag"/skip, striped → "diag" vs "strict" (q > k).
     """
     if layout not in ("contiguous", "striped"):
         raise ValueError(f"unknown layout: {layout!r}")
+    if inner not in ("einsum", "flash"):
+        raise ValueError(f"unknown inner: {inner!r}")
     p = lax.psum(1, axis)
     my = lax.axis_index(axis)
     B, S, H, D = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(D)
     dt = q.dtype
+    if inner == "flash":
+        return _ring_attention_flash(q, k, v, axis, causal, scale, layout,
+                                     p, my, inner_interpret, inner_block)
 
     q32 = q.astype(jnp.float32)
     perm = [(i, (i + 1) % p) for i in range(p)]  # ring: pass K/V to right
@@ -124,6 +150,63 @@ def ring_attention(q, k, v, axis, causal=True, scale=None,
     return out.astype(dt)
 
 
+def _ring_attention_flash(q, k, v, axis, causal, scale, layout, p, my,
+                          interpret, block):
+    """Flash-kernel ring body: each block pair runs the fused kernel
+    locally, partials merge by log-sum-exp, K/V rotate on ppermute.
+
+    interpret=None auto-selects: native Mosaic on TPU, the Pallas
+    interpreter elsewhere (the kernel is TPU-targeted)."""
+    from ..ops.pallas_attention import flash_attention_lse
+
+    B, S, H, D = q.shape
+    dt = q.dtype
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def fl(mode):
+        def f(acc, k_blk, v_blk):
+            o_p, lse_p = flash_attention_lse(
+                q, k_blk, v_blk, mode=mode, sm_scale=scale,
+                block=block, interpret=interpret)
+            return _merge_partials(acc[0], acc[1],
+                                   o_p.astype(jnp.float32), lse_p)
+        return f
+
+    def skip(acc, k_blk, v_blk):
+        return acc
+
+    def accumulate(acc, k_blk, v_blk, src):
+        if not causal:
+            return fl("none")(acc, k_blk, v_blk)
+        if layout == "striped":
+            # striped: q_pos = my + p*i, k_pos = src + p*j →  visible iff
+            # i > j, plus the diagonal j == i when my >= src.
+            return lax.cond(my >= src, fl("diag"), fl("strict"),
+                            acc, k_blk, v_blk)
+        # contiguous: earlier shards fully visible, own shard causal,
+        # later shards fully masked.
+        return lax.cond(src == my, fl("diag"),
+                        lambda a, kb, vb: lax.cond(src < my, fl("none"),
+                                                   skip, a, kb, vb),
+                        acc, k_blk, v_blk)
+
+    acc = (jnp.zeros((B, S, H, D), jnp.float32),
+           jnp.full((B, H, S), -1e30, jnp.float32))
+    acc = accumulate(acc, k, v, my)
+
+    def body(carry, i):
+        acc, k_blk, v_blk = carry
+        k_blk = lax.ppermute(k_blk, axis, perm)
+        v_blk = lax.ppermute(v_blk, axis, perm)
+        src = (my - i) % p
+        return (accumulate(acc, k_blk, v_blk, src), k_blk, v_blk), None
+
+    (acc, _, _), _ = lax.scan(body, (acc, k, v), jnp.arange(1, p))
+    return acc[0].astype(dt)
+
+
 def stripe_sequence(x, p, seq_dim=1):
     """Permute a contiguous global sequence into striped order: after
     sharding dim `seq_dim` into p equal blocks, shard i holds global
@@ -142,7 +225,9 @@ def unstripe_sequence(x, p, seq_dim=1):
 
 
 def make_ring_attention(mesh, axis="seq", causal=True, batch_axis=None,
-                        head_axis=None, jit=True, layout="contiguous"):
+                        head_axis=None, jit=True, layout="contiguous",
+                        inner="einsum", inner_interpret=None,
+                        inner_block=128):
     """Wrap ring_attention in shard_map over `mesh`: takes/returns global
     [B, S, H, D] arrays sequence-sharded on `axis`, optionally
     batch-sharded on `batch_axis` and head-sharded on `head_axis` (tensor
@@ -168,7 +253,9 @@ def make_ring_attention(mesh, axis="seq", causal=True, batch_axis=None,
                        out_specs=spec, check_vma=False)
     def fn(q, k, v):
         return ring_attention(q, k, v, axis=axis, causal=causal,
-                              layout="striped" if striped else "contiguous")
+                              layout="striped" if striped else "contiguous",
+                              inner=inner, inner_interpret=inner_interpret,
+                              inner_block=inner_block)
 
     def wrapped(q, k, v):
         if striped:

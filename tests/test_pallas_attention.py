@@ -110,6 +110,40 @@ def test_transformer_flash_impl_matches_gather():
                                atol=2e-2, rtol=2e-2)
 
 
+def test_strict_mode_and_masked_rows():
+    """mode="strict" (q > k, ring striped cross-shard mask): row 0 is
+    fully masked and must return o = 0, lse = sentinel, and ZERO
+    gradients — the -1e30 sentinel must not cancel in exp(s - m)."""
+    from horovod_tpu.ops.pallas_attention import flash_attention_lse
+
+    rng = np.random.default_rng(6)
+    B, S, H, D = 1, 128, 2, 32
+    q, k, v = (jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+               for _ in range(3))
+    o, lse = flash_attention_lse(q, k, v, mode="strict", block=64,
+                                 interpret=True)
+    # reference: strict lower-triangular mask
+    scale = 1.0 / np.sqrt(D)
+    s = jnp.einsum("bshk,bthk->bhst", q, k) * scale
+    mask = jnp.tril(jnp.ones((S, S), bool), k=-1)
+    s = jnp.where(mask[None, None], s, -np.inf)
+    w = jax.nn.softmax(s, -1)
+    ref = jnp.einsum("bhst,bthk->bshk", jnp.where(jnp.isnan(w), 0, w), v)
+    assert np.allclose(np.asarray(o[:, 0]), 0.0), o[:, 0]
+    assert np.all(np.asarray(lse[:, :, 0]) < -1e29)
+    np.testing.assert_allclose(np.asarray(o[:, 1:]),
+                               np.asarray(ref[:, 1:]), atol=2e-5, rtol=2e-5)
+
+    # gradients of a loss touching every row: row 0 contributes nothing.
+    g = jax.grad(lambda q, k, v: jnp.sum(
+        flash_attention_lse(q, k, v, mode="strict", block=64,
+                            interpret=True)[0] ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    assert np.allclose(np.asarray(g[0][:, 0]), 0.0), g[0][:, 0]
+    assert np.all(np.isfinite(np.asarray(g[1]))) \
+        and np.all(np.isfinite(np.asarray(g[2])))
+
+
 def test_chunked_loss_matches_full():
     """cfg.loss_chunk computes the identical cross-entropy without ever
     materializing the [S, vocab] float32 tensor (value and gradients)."""

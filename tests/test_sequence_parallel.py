@@ -54,6 +54,44 @@ def test_ring_attention_matches_reference(seq_mesh, causal):
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("layout", ["contiguous", "striped"])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_flash_inner_matches_reference(seq_mesh, causal,
+                                                      layout):
+    """inner="flash" runs the fused pallas kernel per block pair and
+    merges partials by log-sum-exp; must equal the dense reference for
+    both layouts (striped exercises the kernel's "strict" mode)."""
+    q, k, v = _qkv(S=64, D=16, seed=11)
+    fn = make_ring_attention(seq_mesh, axis="seq", causal=causal,
+                             batch_axis="data", layout=layout,
+                             inner="flash")
+    out = fn(q, k, v)
+    ref = _ref_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=3e-4, atol=3e-4)
+
+
+@pytest.mark.parametrize("layout", ["contiguous", "striped"])
+def test_ring_attention_flash_inner_gradients(seq_mesh, layout):
+    """Gradients flow through the kernel's custom vjp AND the lse-based
+    partial merge (the lse cotangent path): must match the einsum ring.
+    striped exercises the "strict" mode backward (masked-row hazard)."""
+    q, k, v = _qkv(B=2, S=32, H=2, D=8, seed=12)
+    fns = {inner: make_ring_attention(seq_mesh, axis="seq", causal=True,
+                                      batch_axis="data", layout=layout,
+                                      inner=inner)
+           for inner in ("einsum", "flash")}
+
+    grads = {}
+    for inner, fn in fns.items():
+        grads[inner] = jax.grad(
+            lambda q, k, v, fn=fn: jnp.sum(fn(q, k, v) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+    for ge, gf, name in zip(grads["einsum"], grads["flash"], "qkv"):
+        np.testing.assert_allclose(np.asarray(ge), np.asarray(gf),
+                                   rtol=1e-3, atol=1e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_ulysses_attention_matches_reference(seq_mesh, causal):
     q, k, v = _qkv()
