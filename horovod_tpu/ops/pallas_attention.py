@@ -336,16 +336,21 @@ def _validate(q, k, v, block):
         raise ValueError(f"q/k/v shapes must match, got {q.shape} "
                          f"{k.shape} {v.shape}")
     block = min(block, S)
-    if S % block != 0:
-        raise ValueError(f"seq len {S} must be divisible by block {block}")
-    if block % 8 != 0:
-        # Mosaic's sublane tiling would reject this later with an opaque
-        # compile error; fail at the API boundary instead.
-        raise ValueError(f"block size {block} must be a multiple of 8")
+    if S % block != 0 or block % 8 != 0:
+        # Largest multiple-of-8 divisor of S that fits: callers shouldn't
+        # have to tune the perf knob just to run S=384 (and Mosaic's
+        # sublane tiling would reject a non-multiple-of-8 block later with
+        # an opaque compile error).
+        block = next((b for b in range(block - (block % 8 or 8), 7, -8)
+                      if S % b == 0), 0)
+        if not block:
+            raise ValueError(
+                f"seq len {S} must be divisible by some multiple-of-8 "
+                f"block size")
     return block
 
 
-def flash_attention_lse(q, k, v, *, mode="diag", sm_scale=None, block=128,
+def flash_attention_lse(q, k, v, *, mode="diag", sm_scale=None, block=256,
                         interpret=False):
     """Like :func:`flash_attention` but also returns the per-row
     log-sum-exp ``[B, H, S]`` (float32, ``-1e30`` on fully-masked rows) —
@@ -364,7 +369,7 @@ def flash_attention_lse(q, k, v, *, mode="diag", sm_scale=None, block=128,
     return _unfold(o, B, H), lse.reshape(B, H, S)
 
 
-def flash_attention(q, k, v, *, causal=True, sm_scale=None, block=128,
+def flash_attention(q, k, v, *, causal=True, sm_scale=None, block=256,
                     interpret=False):
     """Fused multi-head attention. q, k, v: ``[B, S, H, D]`` (same S for q
     and k/v). Returns ``[B, S, H, D]`` in the input dtype; softmax and

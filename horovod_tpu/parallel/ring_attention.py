@@ -52,7 +52,7 @@ def _merge_partials(o1, lse1, o2, lse2):
 
 def ring_attention(q, k, v, axis, causal=True, scale=None,
                    layout="contiguous", inner="einsum",
-                   inner_interpret=None, inner_block=128):
+                   inner_interpret=None, inner_block=256):
     """Blockwise ring attention over mesh axis `axis`.
 
     q, k, v: [B, S_blk, H, D] — the local sequence block of each shard.
@@ -227,7 +227,7 @@ def unstripe_sequence(x, p, seq_dim=1):
 def make_ring_attention(mesh, axis="seq", causal=True, batch_axis=None,
                         head_axis=None, jit=True, layout="contiguous",
                         inner="einsum", inner_interpret=None,
-                        inner_block=128):
+                        inner_block=256):
     """Wrap ring_attention in shard_map over `mesh`: takes/returns global
     [B, S, H, D] arrays sequence-sharded on `axis`, optionally
     batch-sharded on `batch_axis` and head-sharded on `head_axis` (tensor
