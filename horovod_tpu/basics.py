@@ -35,6 +35,10 @@ def _maybe_build():
             os.path.join(_CSRC_DIR, f)
             for f in os.listdir(_CSRC_DIR)
             if f.endswith((".cc", ".h", "Makefile"))
+            # tf_ops.cc builds a SEPARATE library (make tf, driven by
+            # tensorflow/native_ops.py); counting it here would make the
+            # core look stale forever and spawn make on every import.
+            and f != "tf_ops.cc"
         ]
         if srcs:
             # Staleness is decided UNDER an exclusive lock: N ranks import

@@ -1,0 +1,269 @@
+// tf_ops.cc — native TensorFlow custom ops over the shared core runtime.
+//
+// TPU-native counterpart of the reference's horovod/tensorflow/mpi_ops.cc
+// (`HorovodAllreduceOp`, `HorovodAllgatherOp`, `HorovodBroadcastOp` —
+// AsyncOpKernels that enqueue into the core and fire `done` from the
+// completion callback). Here the kernels call the same C API the ctypes
+// binding uses (core.cc `hvd_*_async` / `hvd_wait`), so graph-mode TF
+// programs enqueue straight into the background negotiation thread with
+// no tf.py_function Python hop; completion waits run on TF's closure
+// threads, never blocking the executor.
+//
+// Built separately from the core (`make tf` — needs TF headers); loaded
+// by horovod_tpu/tensorflow/native_ops.py via tf.load_op_library, with
+// the py_function bridge as the fallback when the library is absent.
+
+#include <cstring>
+#include <string>
+
+#include "tensorflow/core/framework/op.h"
+#include "tensorflow/core/framework/op_kernel.h"
+#include "tensorflow/core/framework/shape_inference.h"
+
+// C API of libhvd_tpu.so (signatures mirror horovod_tpu/basics.py).
+extern "C" {
+int hvd_allreduce_async(const char* name, const void* in, void* out,
+                        const long long* shape, int ndim, int dtype,
+                        int red_op, double prescale, double postscale,
+                        int process_set, int group_id, int group_size);
+int hvd_allgather_async(const char* name, const void* in,
+                        const long long* shape, int ndim, int dtype,
+                        int process_set, int group_id, int group_size);
+int hvd_broadcast_async(const char* name, const void* in, void* out,
+                        const long long* shape, int ndim, int dtype,
+                        int root, int process_set);
+int hvd_wait(int handle);
+void hvd_release(int handle);
+int hvd_output_ndim(int handle);
+int hvd_output_shape(int handle, long long* out);
+void* hvd_output_ptr(int handle);
+const char* hvd_last_error();
+}
+
+namespace {
+
+using ::tensorflow::AsyncOpKernel;
+using ::tensorflow::DataType;
+using ::tensorflow::OpKernel;
+using ::tensorflow::OpKernelConstruction;
+using ::tensorflow::OpKernelContext;
+using ::tensorflow::Tensor;
+using ::tensorflow::TensorShape;
+using ::tensorflow::errors::Internal;
+
+int DtypeCode(DataType dt) {
+  // Must match horovod_tpu/ops/collective_ops.py _DT_MAP.
+  switch (dt) {
+    case ::tensorflow::DT_UINT8: return 0;
+    case ::tensorflow::DT_INT8: return 1;
+    case ::tensorflow::DT_INT32: return 2;
+    case ::tensorflow::DT_INT64: return 3;
+    case ::tensorflow::DT_HALF: return 4;
+    case ::tensorflow::DT_FLOAT: return 5;
+    case ::tensorflow::DT_DOUBLE: return 6;
+    case ::tensorflow::DT_BOOL: return 7;
+    case ::tensorflow::DT_BFLOAT16: return 8;
+    default: return -1;
+  }
+}
+
+constexpr int kMaxDims = 8;
+
+bool ShapeOf(const Tensor& t, long long* dims, int* ndim) {
+  if (t.dims() > kMaxDims) return false;
+  *ndim = t.dims();
+  for (int i = 0; i < t.dims(); i++) dims[i] = t.dim_size(i);
+  return true;
+}
+
+const void* DataOf(const Tensor& t) { return t.tensor_data().data(); }
+void* DataOf(Tensor* t) {
+  return const_cast<char*>(t->tensor_data().data());
+}
+
+// Wait for `handle` on a TF closure thread, then finish the async op.
+// `finish(ok)` runs after hvd_wait; it must set outputs/status and must
+// NOT call done (we do).
+template <typename F>
+void WaitThen(OpKernelContext* ctx, AsyncOpKernel::DoneCallback done,
+              int handle, F finish) {
+  auto* env = ::tensorflow::Env::Default();
+  env->SchedClosure([ctx, done, handle, finish]() {
+    int rc = hvd_wait(handle);
+    if (rc != 1) {
+      const char* e = hvd_last_error();
+      ctx->SetStatus(Internal("horovod_tpu collective failed: ",
+                              e ? e : "unknown"));
+    } else {
+      finish();
+    }
+    hvd_release(handle);
+    done();
+  });
+}
+
+class HvdTpuAllreduceOp : public AsyncOpKernel {
+ public:
+  explicit HvdTpuAllreduceOp(OpKernelConstruction* c) : AsyncOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("reduce_op", &red_op_));
+    OP_REQUIRES_OK(c, c->GetAttr("prescale", &prescale_));
+    OP_REQUIRES_OK(c, c->GetAttr("postscale", &postscale_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set", &process_set_));
+  }
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    const Tensor& input = ctx->input(0);
+    Tensor* output = nullptr;
+    OP_REQUIRES_OK_ASYNC(
+        ctx, ctx->allocate_output(0, input.shape(), &output), done);
+    long long dims[kMaxDims];
+    int ndim;
+    OP_REQUIRES_ASYNC(ctx, ShapeOf(input, dims, &ndim),
+                      Internal("tensors with >8 dims are unsupported"),
+                      done);
+    int h = hvd_allreduce_async(
+        name_.c_str(), DataOf(input), DataOf(output), dims, ndim,
+        DtypeCode(input.dtype()), red_op_, prescale_, postscale_,
+        process_set_, -1, 0);
+    OP_REQUIRES_ASYNC(ctx, h >= 0,
+                      Internal("enqueue failed: ", hvd_last_error()), done);
+    WaitThen(ctx, done, h, []() {});
+  }
+
+ private:
+  std::string name_;
+  int red_op_, process_set_;
+  float prescale_, postscale_;
+};
+
+class HvdTpuAllgatherOp : public AsyncOpKernel {
+ public:
+  explicit HvdTpuAllgatherOp(OpKernelConstruction* c) : AsyncOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set", &process_set_));
+  }
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    const Tensor& input = ctx->input(0);
+    long long dims[kMaxDims];
+    int ndim;
+    OP_REQUIRES_ASYNC(ctx, ShapeOf(input, dims, &ndim),
+                      Internal("tensors with >8 dims are unsupported"),
+                      done);
+    int h = hvd_allgather_async(name_.c_str(), DataOf(input), dims, ndim,
+                                DtypeCode(input.dtype()), process_set_, -1,
+                                0);
+    OP_REQUIRES_ASYNC(ctx, h >= 0,
+                      Internal("enqueue failed: ", hvd_last_error()), done);
+    // Output rows = sum over ranks, known only after completion: allocate
+    // and copy from the core-owned buffer inside the closure (reference:
+    // HorovodAllgatherOp allocates from the response).
+    WaitThen(ctx, done, h, [ctx, h]() {
+      int ondim = hvd_output_ndim(h);
+      long long oshape[8];
+      hvd_output_shape(h, oshape);
+      TensorShape shape;
+      for (int i = 0; i < ondim; i++) shape.AddDim(oshape[i]);
+      Tensor* output = nullptr;
+      auto st = ctx->allocate_output(0, shape, &output);
+      if (!st.ok()) {
+        ctx->SetStatus(st);
+        return;
+      }
+      size_t bytes = output->tensor_data().size();
+      if (bytes) std::memcpy(DataOf(output), hvd_output_ptr(h), bytes);
+    });
+  }
+
+ private:
+  std::string name_;
+  int process_set_;
+};
+
+class HvdTpuBroadcastOp : public AsyncOpKernel {
+ public:
+  explicit HvdTpuBroadcastOp(OpKernelConstruction* c) : AsyncOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("root_rank", &root_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set", &process_set_));
+  }
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    const Tensor& input = ctx->input(0);
+    Tensor* output = nullptr;
+    OP_REQUIRES_OK_ASYNC(
+        ctx, ctx->allocate_output(0, input.shape(), &output), done);
+    long long dims[kMaxDims];
+    int ndim;
+    OP_REQUIRES_ASYNC(ctx, ShapeOf(input, dims, &ndim),
+                      Internal("tensors with >8 dims are unsupported"),
+                      done);
+    int h = hvd_broadcast_async(name_.c_str(), DataOf(input),
+                                DataOf(output), dims, ndim,
+                                DtypeCode(input.dtype()), root_,
+                                process_set_);
+    OP_REQUIRES_ASYNC(ctx, h >= 0,
+                      Internal("enqueue failed: ", hvd_last_error()), done);
+    WaitThen(ctx, done, h, []() {});
+  }
+
+ private:
+  std::string name_;
+  int root_, process_set_;
+};
+
+using ::tensorflow::shape_inference::InferenceContext;
+
+REGISTER_OP("HvdTpuAllreduce")
+    .Attr("T: {uint8, int8, int32, int64, float16, bfloat16, float32, "
+          "float64}")
+    .Attr("tensor_name: string")
+    .Attr("reduce_op: int")
+    .Attr("prescale: float = 1.0")
+    .Attr("postscale: float = 1.0")
+    .Attr("process_set: int = 0")
+    .Input("tensor: T")
+    .Output("output: T")
+    .SetShapeFn([](InferenceContext* c) {
+      c->set_output(0, c->input(0));
+      return ::tensorflow::OkStatus();
+    });
+
+REGISTER_OP("HvdTpuAllgather")
+    .Attr("T: {uint8, int8, int32, int64, float16, bfloat16, float32, "
+          "float64, bool}")
+    .Attr("tensor_name: string")
+    .Attr("process_set: int = 0")
+    .Input("tensor: T")
+    .Output("output: T")
+    .SetShapeFn([](InferenceContext* c) {
+      // dim0 becomes the cross-rank sum: unknown until runtime.
+      ::tensorflow::shape_inference::ShapeHandle out;
+      TF_RETURN_IF_ERROR(c->ReplaceDim(c->input(0), 0, c->UnknownDim(),
+                                       &out));
+      c->set_output(0, out);
+      return ::tensorflow::OkStatus();
+    });
+
+REGISTER_OP("HvdTpuBroadcast")
+    .Attr("T: {uint8, int8, int32, int64, float16, bfloat16, float32, "
+          "float64, bool}")
+    .Attr("tensor_name: string")
+    .Attr("root_rank: int")
+    .Attr("process_set: int = 0")
+    .Input("tensor: T")
+    .Output("output: T")
+    .SetShapeFn([](InferenceContext* c) {
+      c->set_output(0, c->input(0));
+      return ::tensorflow::OkStatus();
+    });
+
+REGISTER_KERNEL_BUILDER(Name("HvdTpuAllreduce").Device(::tensorflow::DEVICE_CPU),
+                        HvdTpuAllreduceOp);
+REGISTER_KERNEL_BUILDER(Name("HvdTpuAllgather").Device(::tensorflow::DEVICE_CPU),
+                        HvdTpuAllgatherOp);
+REGISTER_KERNEL_BUILDER(Name("HvdTpuBroadcast").Device(::tensorflow::DEVICE_CPU),
+                        HvdTpuBroadcastOp);
+
+}  // namespace

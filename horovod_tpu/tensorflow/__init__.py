@@ -3,11 +3,15 @@
 Reference parity: `horovod/tensorflow/__init__.py` + `mpi_ops.py` —
 collectives on tf.Tensors, `DistributedGradientTape` wrapping
 `tape.gradient`, `DistributedOptimizer` wrapping Keras optimizers,
-`broadcast_variables`. The reference registers custom C++ ops
-(`tensorflow/mpi_ops.cc`); here eager tensors bridge to the same native
-core via numpy, and graph/`tf.function` contexts lower through
-`tf.py_function` (the analog of the reference's AsyncOpKernel enqueue —
-the collective still executes on the core's background thread).
+`broadcast_variables`. The standalone `allreduce`/`allgather`/`broadcast`
+APIs run as native custom C++ ops (`csrc/tf_ops.cc` AsyncOpKernels — the
+`tensorflow/mpi_ops.cc` analog — loaded via :mod:`.native_ops`): graph
+and eager programs enqueue straight into the core's background thread
+with no Python hop. The tape/optimizer gradient path uses the grouped
+(atomically negotiated, fused) collectives, which ride the numpy bridge /
+`tf.py_function` — group ids are allocated per execution, which fixed op
+attrs can't express. When the op library can't be built (no TF headers)
+everything falls back to the bridge; `HVD_TF_NATIVE_OPS=0` forces that.
 """
 
 import numpy as np
@@ -70,6 +74,23 @@ def _run_op(np_fn, x, out_dtype=None):
                           out_dtype or t.dtype)
 
 
+def _native_for(dtype, with_bool=False):
+    """The native custom-op module (csrc/tf_ops.cc AsyncOpKernels — the
+    reference's mpi_ops.cc analog) if it loaded and supports `dtype`,
+    else None (py_function fallback)."""
+    from . import native_ops
+
+    mod = native_ops.lib()
+    if mod is None:
+        return None
+    tf = _tf()
+    ok = {tf.uint8, tf.int8, tf.int32, tf.int64, tf.float16, tf.bfloat16,
+          tf.float32, tf.float64}
+    if with_bool:
+        ok.add(tf.bool)
+    return mod if tf.as_dtype(dtype) in ok else None
+
+
 def allreduce(tensor, op=Average, name=None, process_set=0,
               prescale_factor=1.0, postscale_factor=1.0, compression=None):
     """Differentiable allreduce (reference: horovod/tensorflow/mpi_ops.py
@@ -91,7 +112,16 @@ def allreduce(tensor, op=Average, name=None, process_set=0,
 
     @tf.custom_gradient
     def _op(x):
-        y = _run_op(fn, x)
+        x = tf.convert_to_tensor(x)  # custom_gradient passes raw args
+        nat = None if compression is not None else _native_for(x.dtype)
+        if nat is not None:
+            y = nat.hvd_tpu_allreduce(
+                x, tensor_name=name or _core._auto_name("allreduce", None),
+                reduce_op=int(op), prescale=float(prescale_factor),
+                postscale=float(postscale_factor),
+                process_set=int(process_set))
+        else:
+            y = _run_op(fn, x)
 
         def grad(dy):
             return allreduce(dy, op=op,
@@ -126,8 +156,15 @@ def allgather(tensor, name=None, process_set=0):
 
     @tf.custom_gradient
     def _op(x):
-        y = _run_op(lambda a: _core.allgather(a, name=name,
-                                              process_set=process_set), x)
+        nat = _native_for(x.dtype, with_bool=True)
+        if nat is not None:
+            y = nat.hvd_tpu_allgather(
+                x, tensor_name=name or _core._auto_name("allgather", None),
+                process_set=int(process_set))
+        else:
+            y = _run_op(lambda a: _core.allgather(a, name=name,
+                                                  process_set=process_set),
+                        x)
 
         def grad(dy):
             my_rows = int(x.shape[0])
@@ -161,9 +198,17 @@ def broadcast(tensor, root_rank=0, name=None, process_set=0):
 
     @tf.custom_gradient
     def _op(x):
-        y = _run_op(lambda a: _core.broadcast(a, root_rank=root_rank,
-                                              name=name,
-                                              process_set=process_set), x)
+        x = tf.convert_to_tensor(x)  # custom_gradient passes raw args
+        nat = _native_for(x.dtype, with_bool=True)
+        if nat is not None:
+            y = nat.hvd_tpu_broadcast(
+                x, tensor_name=name or _core._auto_name("broadcast", None),
+                root_rank=int(root_rank), process_set=int(process_set))
+        else:
+            y = _run_op(lambda a: _core.broadcast(a, root_rank=root_rank,
+                                                  name=name,
+                                                  process_set=process_set),
+                        x)
 
         def grad(dy):
             summed = allreduce(dy, op=Sum,

@@ -1,0 +1,56 @@
+"""Loader for the native TF custom ops (csrc/tf_ops.cc — the
+`horovod/tensorflow/mpi_ops.cc` analog).
+
+`lib()` builds (``make tf``, serialized under the same build lock the core
+uses) and loads ``libhvd_tf_ops.so`` once per process; returns None when
+the library can't be built/loaded (no TF headers, unexpected TF ABI), in
+which case the binding falls back to the tf.py_function bridge. Set
+``HVD_TF_NATIVE_OPS=0`` to force the fallback.
+"""
+import os
+import subprocess
+
+_PKG = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LIB = os.path.join(_PKG, "lib", "libhvd_tf_ops.so")
+_CSRC = os.path.join(_PKG, "csrc")
+
+_loaded = False
+_mod = None
+
+
+def lib():
+    """The loaded op module (has hvd_tpu_allreduce / hvd_tpu_allgather /
+    hvd_tpu_broadcast), or None if native ops are unavailable."""
+    global _loaded, _mod
+    if _loaded:
+        return _mod
+    _loaded = True
+    if os.environ.get("HVD_TF_NATIVE_OPS", "1") == "0":
+        return None
+    # HVD_LIB pointing at a different core build (e.g. the TSAN library):
+    # our .so's rpath would resolve to the DEFAULT core — a second,
+    # uninitialized Global in-process. Fall back to the bridge, which goes
+    # through the ctypes handle of the overridden library.
+    override = os.environ.get("HVD_LIB")
+    if override and (os.path.realpath(override)
+                     != os.path.realpath(os.path.join(_PKG, "lib",
+                                                      "libhvd_tpu.so"))):
+        return None
+    try:
+        import fcntl
+
+        import tensorflow as tf
+
+        src = os.path.join(_CSRC, "tf_ops.cc")
+        if os.path.isdir(_CSRC) and os.path.exists(src):
+            with open(os.path.join(_CSRC, ".build.lock"), "w") as lk:
+                fcntl.flock(lk, fcntl.LOCK_EX)
+                if (not os.path.exists(_LIB)
+                        or os.path.getmtime(_LIB) < os.path.getmtime(src)):
+                    subprocess.run(["make", "-s", "tf"], cwd=_CSRC,
+                                   check=True, stdout=subprocess.DEVNULL,
+                                   stderr=subprocess.DEVNULL)
+        _mod = tf.load_op_library(_LIB)
+    except Exception:  # noqa: BLE001 — any failure → py_function fallback
+        _mod = None
+    return _mod

@@ -18,8 +18,19 @@ def test_torch_binding_4proc():
 
 
 def test_tf_binding_2proc():
+    """Default path: the native custom-op library (csrc/tf_ops.cc
+    AsyncOpKernels, the reference's tensorflow/mpi_ops.cc analog) carries
+    allreduce/allgather/broadcast; the worker asserts it loaded."""
     pytest.importorskip("tensorflow")
     run_worker_job(2, "tf_worker.py", timeout=300)
+
+
+def test_tf_binding_pyfunc_fallback():
+    """HVD_TF_NATIVE_OPS=0: the whole matrix must still pass through the
+    tf.py_function bridge (the no-TF-headers fallback)."""
+    pytest.importorskip("tensorflow")
+    run_worker_job(2, "tf_worker.py", timeout=300,
+                   extra_env={"HVD_TF_NATIVE_OPS": "0"})
 
 
 def test_mxnet_binding_import_surface():

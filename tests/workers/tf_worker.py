@@ -14,6 +14,15 @@ import horovod_tpu.tensorflow as hvd  # noqa: E402
 hvd.init()
 r, s = hvd.rank(), hvd.size()
 
+# The native custom-op library (csrc/tf_ops.cc) must have built and
+# loaded in this environment — otherwise everything below would silently
+# test only the py_function fallback. HVD_TF_NATIVE_OPS=0 runs get the
+# fallback on purpose (test_tf_binding_pyfunc_fallback).
+from horovod_tpu.tensorflow import native_ops  # noqa: E402
+
+expect_native = os.environ.get("HVD_TF_NATIVE_OPS", "1") == "1"
+assert (native_ops.lib() is not None) == expect_native, "native ops state"
+
 # collectives (eager)
 out = hvd.allreduce(tf.fill([8], float(r + 1)), op=hvd.Sum)
 assert np.allclose(out.numpy(), s * (s + 1) / 2.0)
