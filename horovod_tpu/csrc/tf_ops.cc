@@ -32,6 +32,15 @@ int hvd_allgather_async(const char* name, const void* in,
 int hvd_broadcast_async(const char* name, const void* in, void* out,
                         const long long* shape, int ndim, int dtype,
                         int root, int process_set);
+int hvd_alltoall_async(const char* name, const void* in,
+                       const long long* shape, int ndim, int dtype,
+                       const long long* splits, int nsplits,
+                       int process_set);
+int hvd_reducescatter_async(const char* name, const void* in,
+                            const long long* shape, int ndim, int dtype,
+                            int red_op, double prescale, double postscale,
+                            int process_set, int group_id, int group_size);
+int hvd_output_meta(int handle, long long* out);
 int hvd_wait(int handle);
 void hvd_release(int handle);
 int hvd_output_ndim(int handle);
@@ -102,6 +111,26 @@ void WaitThen(OpKernelContext* ctx, AsyncOpKernel::DoneCallback done,
   });
 }
 
+// Allocate output `idx` from the completed handle's core-owned buffer and
+// copy it over (allgather/alltoall/reducescatter outputs whose shape is
+// known only after the collective). Returns false after setting status.
+bool CopyOutputFromHandle(OpKernelContext* ctx, int h, int idx) {
+  int ondim = hvd_output_ndim(h);
+  long long oshape[kMaxDims];
+  hvd_output_shape(h, oshape);
+  TensorShape shape;
+  for (int i = 0; i < ondim; i++) shape.AddDim(oshape[i]);
+  Tensor* output = nullptr;
+  auto st = ctx->allocate_output(idx, shape, &output);
+  if (!st.ok()) {
+    ctx->SetStatus(st);
+    return false;
+  }
+  size_t bytes = output->tensor_data().size();
+  if (bytes) std::memcpy(DataOf(output), hvd_output_ptr(h), bytes);
+  return true;
+}
+
 class HvdTpuAllreduceOp : public AsyncOpKernel {
  public:
   explicit HvdTpuAllreduceOp(OpKernelConstruction* c) : AsyncOpKernel(c) {
@@ -159,21 +188,8 @@ class HvdTpuAllgatherOp : public AsyncOpKernel {
     // Output rows = sum over ranks, known only after completion: allocate
     // and copy from the core-owned buffer inside the closure (reference:
     // HorovodAllgatherOp allocates from the response).
-    WaitThen(ctx, done, h, [ctx, h]() {
-      int ondim = hvd_output_ndim(h);
-      long long oshape[8];
-      hvd_output_shape(h, oshape);
-      TensorShape shape;
-      for (int i = 0; i < ondim; i++) shape.AddDim(oshape[i]);
-      Tensor* output = nullptr;
-      auto st = ctx->allocate_output(0, shape, &output);
-      if (!st.ok()) {
-        ctx->SetStatus(st);
-        return;
-      }
-      size_t bytes = output->tensor_data().size();
-      if (bytes) std::memcpy(DataOf(output), hvd_output_ptr(h), bytes);
-    });
+    WaitThen(ctx, done, h,
+             [ctx, h]() { CopyOutputFromHandle(ctx, h, 0); });
   }
 
  private:
@@ -211,6 +227,82 @@ class HvdTpuBroadcastOp : public AsyncOpKernel {
  private:
   std::string name_;
   int root_, process_set_;
+};
+
+class HvdTpuAlltoallOp : public AsyncOpKernel {
+ public:
+  explicit HvdTpuAlltoallOp(OpKernelConstruction* c) : AsyncOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set", &process_set_));
+  }
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    const Tensor& input = ctx->input(0);
+    const Tensor& splits = ctx->input(1);  // int64 [n_members]
+    long long dims[kMaxDims];
+    int ndim;
+    OP_REQUIRES_ASYNC(ctx, ShapeOf(input, dims, &ndim),
+                      Internal("tensors with >8 dims are unsupported"),
+                      done);
+    int h = hvd_alltoall_async(
+        name_.c_str(), DataOf(input), dims, ndim,
+        DtypeCode(input.dtype()),
+        reinterpret_cast<const long long*>(DataOf(splits)),
+        (int)splits.NumElements(), process_set_);
+    OP_REQUIRES_ASYNC(ctx, h >= 0,
+                      Internal("enqueue failed: ", hvd_last_error()), done);
+    WaitThen(ctx, done, h, [ctx, h]() {
+      if (!CopyOutputFromHandle(ctx, h, 0)) return;
+      // second output: rows received from each member
+      int mlen = hvd_output_meta(h, nullptr);
+      Tensor* rs = nullptr;
+      auto st = ctx->allocate_output(1, TensorShape({mlen}), &rs);
+      if (!st.ok()) {
+        ctx->SetStatus(st);
+        return;
+      }
+      if (mlen)
+        hvd_output_meta(h, reinterpret_cast<long long*>(DataOf(rs)));
+    });
+  }
+
+ private:
+  std::string name_;
+  int process_set_;
+};
+
+class HvdTpuReducescatterOp : public AsyncOpKernel {
+ public:
+  explicit HvdTpuReducescatterOp(OpKernelConstruction* c)
+      : AsyncOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("reduce_op", &red_op_));
+    OP_REQUIRES_OK(c, c->GetAttr("prescale", &prescale_));
+    OP_REQUIRES_OK(c, c->GetAttr("postscale", &postscale_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set", &process_set_));
+  }
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    const Tensor& input = ctx->input(0);
+    long long dims[kMaxDims];
+    int ndim;
+    OP_REQUIRES_ASYNC(ctx, ShapeOf(input, dims, &ndim),
+                      Internal("tensors with >8 dims are unsupported"),
+                      done);
+    int h = hvd_reducescatter_async(
+        name_.c_str(), DataOf(input), dims, ndim,
+        DtypeCode(input.dtype()), red_op_, prescale_, postscale_,
+        process_set_, -1, 0);
+    OP_REQUIRES_ASYNC(ctx, h >= 0,
+                      Internal("enqueue failed: ", hvd_last_error()), done);
+    WaitThen(ctx, done, h,
+             [ctx, h]() { CopyOutputFromHandle(ctx, h, 0); });
+  }
+
+ private:
+  std::string name_;
+  int red_op_, process_set_;
+  float prescale_, postscale_;
 };
 
 using ::tensorflow::shape_inference::InferenceContext;
@@ -259,8 +351,48 @@ REGISTER_OP("HvdTpuBroadcast")
       return ::tensorflow::OkStatus();
     });
 
+REGISTER_OP("HvdTpuAlltoall")
+    .Attr("T: {uint8, int8, int32, int64, float16, bfloat16, float32, "
+          "float64, bool}")
+    .Attr("tensor_name: string")
+    .Attr("process_set: int = 0")
+    .Input("tensor: T")
+    .Input("splits: int64")
+    .Output("output: T")
+    .Output("recv_splits: int64")
+    .SetShapeFn([](InferenceContext* c) {
+      ::tensorflow::shape_inference::ShapeHandle out;
+      TF_RETURN_IF_ERROR(c->ReplaceDim(c->input(0), 0, c->UnknownDim(),
+                                       &out));
+      c->set_output(0, out);
+      c->set_output(1, c->Vector(InferenceContext::kUnknownDim));
+      return ::tensorflow::OkStatus();
+    });
+
+REGISTER_OP("HvdTpuReducescatter")
+    .Attr("T: {uint8, int8, int32, int64, float16, bfloat16, float32, "
+          "float64}")
+    .Attr("tensor_name: string")
+    .Attr("reduce_op: int")
+    .Attr("prescale: float = 1.0")
+    .Attr("postscale: float = 1.0")
+    .Attr("process_set: int = 0")
+    .Input("tensor: T")
+    .Output("output: T")
+    .SetShapeFn([](InferenceContext* c) {
+      ::tensorflow::shape_inference::ShapeHandle out;
+      TF_RETURN_IF_ERROR(c->ReplaceDim(c->input(0), 0, c->UnknownDim(),
+                                       &out));
+      c->set_output(0, out);
+      return ::tensorflow::OkStatus();
+    });
+
 REGISTER_KERNEL_BUILDER(Name("HvdTpuAllreduce").Device(::tensorflow::DEVICE_CPU),
                         HvdTpuAllreduceOp);
+REGISTER_KERNEL_BUILDER(Name("HvdTpuAlltoall").Device(::tensorflow::DEVICE_CPU),
+                        HvdTpuAlltoallOp);
+REGISTER_KERNEL_BUILDER(Name("HvdTpuReducescatter").Device(::tensorflow::DEVICE_CPU),
+                        HvdTpuReducescatterOp);
 REGISTER_KERNEL_BUILDER(Name("HvdTpuAllgather").Device(::tensorflow::DEVICE_CPU),
                         HvdTpuAllgatherOp);
 REGISTER_KERNEL_BUILDER(Name("HvdTpuBroadcast").Device(::tensorflow::DEVICE_CPU),

@@ -191,6 +191,17 @@ def run_fn(func, reset):
                     reset_required = True
                 except HostsUpdatedInterrupt:
                     reset_required = True
+                except Exception as e:  # noqa: BLE001
+                    # The native TF custom ops (csrc/tf_ops.cc) surface a
+                    # failed collective as tf.errors.InternalError carrying
+                    # the core's message; map it back to the elastic signal
+                    # (reference: horovod/tensorflow/elastic.py does the
+                    # same for its op errors).
+                    if "horovod_tpu collective failed" not in str(e) \
+                            and "HorovodInternalError" not in str(e):
+                        raise
+                    state.restore()
+                    reset_required = True
         finally:
             _worker.notification_manager.remove_listener(state)
 

@@ -227,6 +227,15 @@ def alltoall(tensor, splits=None, name=None, process_set=0):
     tf = _tf()
     t = tf.convert_to_tensor(tensor)
 
+    nat = _native_for(t.dtype, with_bool=True) if splits is not None \
+        else None  # splits=None derives even splits core-side (bridge)
+    if nat is not None:
+        data, rs = nat.hvd_tpu_alltoall(
+            t, tf.convert_to_tensor(np.asarray(splits, np.int64)),
+            tensor_name=name or _core._auto_name("alltoall", None),
+            process_set=int(process_set))
+        return data, rs
+
     def np_fn(a):
         out = _core.alltoall(a, splits=splits, name=name,
                              process_set=process_set)
@@ -247,9 +256,16 @@ def alltoall(tensor, splits=None, name=None, process_set=0):
 
 
 def reducescatter(tensor, op=Average, name=None, process_set=0):
+    tf = _tf()
+    t = tf.convert_to_tensor(tensor)
+    nat = _native_for(t.dtype)
+    if nat is not None:
+        return nat.hvd_tpu_reducescatter(
+            t, tensor_name=name or _core._auto_name("reducescatter", None),
+            reduce_op=int(op), process_set=int(process_set))
     return _run_op(lambda a: _core.reducescatter(a, op=op, name=name,
                                                  process_set=process_set),
-                   tensor)
+                   t)
 
 
 def broadcast_object(obj, root_rank=0, name=None, process_set=0):

@@ -38,18 +38,21 @@ def lib():
         return None
     try:
         import fcntl
+        import sys
 
         import tensorflow as tf
 
-        src = os.path.join(_CSRC, "tf_ops.cc")
-        if os.path.isdir(_CSRC) and os.path.exists(src):
+        if os.path.isdir(_CSRC):
+            # Always invoke make under the lock: its dependency graph
+            # (tf_ops.cc AND the core library) decides staleness — a
+            # Python-side mtime check against tf_ops.cc alone would miss
+            # core rebuilds and run old kernels against a new C ABI.
             with open(os.path.join(_CSRC, ".build.lock"), "w") as lk:
                 fcntl.flock(lk, fcntl.LOCK_EX)
-                if (not os.path.exists(_LIB)
-                        or os.path.getmtime(_LIB) < os.path.getmtime(src)):
-                    subprocess.run(["make", "-s", "tf"], cwd=_CSRC,
-                                   check=True, stdout=subprocess.DEVNULL,
-                                   stderr=subprocess.DEVNULL)
+                subprocess.run(
+                    ["make", "-s", "tf", f"PYTHON={sys.executable}"],
+                    cwd=_CSRC, check=True, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL)
         _mod = tf.load_op_library(_LIB)
     except Exception:  # noqa: BLE001 — any failure → py_function fallback
         _mod = None

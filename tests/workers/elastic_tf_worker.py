@@ -56,6 +56,13 @@ def train(state):
         tape = hvd.DistributedGradientTape(t)
         grads = tape.gradient(loss, model.trainable_variables)
         opt.apply_gradients(zip(grads, model.trainable_variables))
+        # A standalone collective rides the NATIVE custom-op path
+        # (csrc/tf_ops.cc): when a peer dies here, the failure surfaces as
+        # tf.errors.InternalError, and elastic.run must map it back to the
+        # restore-and-rendezvous flow (not crash this worker).
+        metric = hvd.allreduce(loss, op=hvd.Average,
+                               name=f"elastic.metric.{state.iteration}")
+        assert np.isfinite(float(metric))
         state.iteration += 1
         state.commit()
         time.sleep(SLEEP)

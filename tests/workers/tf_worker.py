@@ -32,6 +32,16 @@ b = hvd.broadcast(tf.range(4, dtype=tf.float32) * float(r + 1),
                   root_rank=0)
 assert np.allclose(b.numpy(), np.arange(4))
 
+# alltoall with explicit splits + reducescatter (native kernels when the
+# op library is loaded; bridge under HVD_TF_NATIVE_OPS=0)
+a2a, rs = hvd.alltoall(tf.fill([s * 2], float(r)), splits=[2] * s)
+assert np.allclose(rs.numpy(), 2), rs.numpy()
+exp = np.repeat(np.arange(s, dtype=np.float32), 2)
+assert np.allclose(a2a.numpy(), exp), a2a.numpy()
+rsc = hvd.reducescatter(tf.ones([s * 2, 3]) * float(r + 1), op=hvd.Sum)
+assert rsc.shape == (2, 3)
+assert np.allclose(rsc.numpy(), s * (s + 1) / 2.0), rsc.numpy()
+
 # grouped allreduce
 outs = hvd.grouped_allreduce([tf.fill([4], float(r)),
                               tf.fill([6], 2.0 * r)], op=hvd.Sum)
