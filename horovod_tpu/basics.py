@@ -35,10 +35,10 @@ def _maybe_build():
             os.path.join(_CSRC_DIR, f)
             for f in os.listdir(_CSRC_DIR)
             if f.endswith((".cc", ".h", "Makefile"))
-            # tf_ops.cc builds a SEPARATE library (make tf, driven by
-            # tensorflow/native_ops.py); counting it here would make the
-            # core look stale forever and spawn make on every import.
-            and f != "tf_ops.cc"
+            # tf_ops.cc / torch_ops.cc build SEPARATE libraries (lazy,
+            # driven by their binding loaders); counting them here would
+            # make the core look stale forever and spawn make per import.
+            and f not in ("tf_ops.cc", "torch_ops.cc")
         ]
         if srcs:
             # Staleness is decided UNDER an exclusive lock: N ranks import

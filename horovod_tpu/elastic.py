@@ -196,9 +196,12 @@ def run_fn(func, reset):
                     # failed collective as tf.errors.InternalError carrying
                     # the core's message; map it back to the elastic signal
                     # (reference: horovod/tensorflow/elastic.py does the
-                    # same for its op errors).
-                    if "horovod_tpu collective failed" not in str(e) \
-                            and "HorovodInternalError" not in str(e):
+                    # same for its op errors). Only the core's INTERNAL
+                    # markers qualify — deterministic validation errors
+                    # ("mismatched shape", "unknown process set") must
+                    # surface, not loop through restore/rendezvous forever.
+                    if "HorovodInternalError" not in str(e) \
+                            and "shutdown" not in str(e):
                         raise
                     state.restore()
                     reset_required = True

@@ -46,13 +46,18 @@ def lib():
             # Always invoke make under the lock: its dependency graph
             # (tf_ops.cc AND the core library) decides staleness — a
             # Python-side mtime check against tf_ops.cc alone would miss
-            # core rebuilds and run old kernels against a new C ABI.
-            with open(os.path.join(_CSRC, ".build.lock"), "w") as lk:
-                fcntl.flock(lk, fcntl.LOCK_EX)
-                subprocess.run(
-                    ["make", "-s", "tf", f"PYTHON={sys.executable}"],
-                    cwd=_CSRC, check=True, stdout=subprocess.DEVNULL,
-                    stderr=subprocess.DEVNULL)
+            # core rebuilds and run old kernels against a new C ABI. A
+            # failed make (no compiler in the image) is not fatal if a
+            # prebuilt library shipped.
+            try:
+                with open(os.path.join(_CSRC, ".build.lock"), "w") as lk:
+                    fcntl.flock(lk, fcntl.LOCK_EX)
+                    subprocess.run(
+                        ["make", "-s", "tf", f"PYTHON={sys.executable}"],
+                        cwd=_CSRC, check=True, stdout=subprocess.DEVNULL,
+                        stderr=subprocess.DEVNULL)
+            except Exception:  # noqa: BLE001
+                pass
         _mod = tf.load_op_library(_LIB)
     except Exception:  # noqa: BLE001 — any failure → py_function fallback
         _mod = None

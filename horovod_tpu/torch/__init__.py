@@ -4,10 +4,13 @@ Reference parity: `horovod/torch/__init__.py` + `mpi_ops.py` +
 `mpi_ops_v2.cc` — async collectives returning integer handles,
 hook-based `DistributedOptimizer` overlapping gradient allreduce with the
 backward pass, `broadcast_parameters` / `broadcast_optimizer_state`,
-`SyncBatchNorm`. The reference needs a C++ torch extension because its
-tensors live on CUDA streams; here torch tensors are host memory (TPU
-compute goes through JAX), so the binding adapts `torch.Tensor` ↔ the same
-native core the other frontends use (zero-copy via numpy views).
+`SyncBatchNorm`. Like the reference, the collectives run through a native
+C++ torch extension (`csrc/torch_ops.cc`, JIT-built by
+:mod:`.native_ext` — the `mpi_ops_v2.cc` analog) that hands the core aten
+data pointers directly; unsupported cases (non-CPU/exotic dtypes,
+compression, the grouped hook plumbing) and environments without a
+toolchain fall back to the numpy bridge (`HVD_TORCH_NATIVE_OPS=0`
+forces it).
 """
 
 import numpy as np
@@ -59,13 +62,52 @@ def _to_numpy(t):
 
 
 def _from_numpy(a, like):
-    return torch.from_numpy(np.ascontiguousarray(a)).to(like.dtype)
+    # reshape: ascontiguousarray silently promotes 0-d to 1-d, which would
+    # turn scalar collectives into shape-(1,) results.
+    return torch.from_numpy(np.ascontiguousarray(a)).to(like.dtype) \
+        .reshape(np.shape(a))
+
+
+_NATIVE_DTYPES = {torch.uint8, torch.int8, torch.int32, torch.int64,
+                  torch.float16, torch.float32, torch.float64, torch.bool,
+                  torch.bfloat16}
+
+
+def _native_for(tensor, inplace=False):
+    """The native extension (csrc/torch_ops.cc — the reference's
+    mpi_ops_v2.cc analog) when it can serve this tensor directly:
+    CPU, supported dtype, and (for in-place ops) already contiguous.
+    None → numpy-bridge fallback."""
+    if tensor.device.type != "cpu" or tensor.dtype not in _NATIVE_DTYPES:
+        return None
+    if tensor.dim() == 0:
+        # the bridge promotes 0-d to 1-d before enqueue and restores the
+        # shape after; keep scalars on that path so native and fallback
+        # ranks always submit identical shapes.
+        return None
+    if inplace and not tensor.is_contiguous():
+        return None
+    from . import native_ext
+
+    return native_ext.lib()
+
+
+# torch dtype → core dtype code (must match collective_ops._DT_MAP /
+# csrc dtype tables); used to rebuild gather-type results natively.
+_DT_CODE = {torch.uint8: 0, torch.int8: 1, torch.int32: 2, torch.int64: 3,
+            torch.float16: 4, torch.float32: 5, torch.float64: 6,
+            torch.bool: 7, torch.bfloat16: 8}
 
 
 # -- sync collectives -------------------------------------------------------
 
 def allreduce(tensor, op=Average, name=None, process_set=0,
               prescale_factor=1.0, postscale_factor=1.0, compression=None):
+    if compression is None and _native_for(tensor) is not None:
+        return synchronize(allreduce_async(
+            tensor, op=op, name=name, process_set=process_set,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor))
     a = _to_numpy(tensor)
     ctx = None
     if compression is not None:
@@ -85,12 +127,21 @@ def allreduce_(tensor, **kw):
 
 
 def allgather(tensor, name=None, process_set=0):
+    if _native_for(tensor) is not None:
+        return synchronize(allgather_async(tensor, name=name,
+                                           process_set=process_set))
     return torch.from_numpy(np.ascontiguousarray(
         _core.allgather(_to_numpy(tensor), name=name,
                         process_set=process_set)))
 
 
 def broadcast(tensor, root_rank, name=None, process_set=0):
+    nat = _native_for(tensor)
+    if nat is not None:
+        # out-of-place: broadcast a contiguous copy in place.
+        x = tensor.detach().clone().contiguous()
+        return synchronize(broadcast_async_(x, root_rank, name=name,
+                                            process_set=process_set))
     return _from_numpy(
         _core.broadcast(_to_numpy(tensor), root_rank=root_rank, name=name,
                         process_set=process_set), tensor)
@@ -102,6 +153,14 @@ def broadcast_(tensor, root_rank, **kw):
 
 
 def alltoall(tensor, splits=None, name=None, process_set=0):
+    nat = _native_for(tensor) if splits is not None else None
+    if nat is not None:
+        x = tensor.detach().contiguous()
+        h = nat.alltoall_async(x, [int(s) for s in splits],
+                               name or _core._auto_name("alltoall", None),
+                               int(process_set))
+        return synchronize(TorchHandle(h, native=nat, kind="alltoall",
+                                       out=_DT_CODE[x.dtype], keep=(x,)))
     out = _core.alltoall(_to_numpy(tensor), splits=splits, name=name,
                          process_set=process_set)
     if isinstance(out, tuple):
@@ -113,6 +172,16 @@ def alltoall(tensor, splits=None, name=None, process_set=0):
 
 
 def reducescatter(tensor, op=Average, name=None, process_set=0):
+    nat = _native_for(tensor)
+    if nat is not None:
+        x = tensor.detach().contiguous()
+        h = nat.reducescatter_async(
+            x, name or _core._auto_name("reducescatter", None), int(op),
+            int(process_set))
+        # red_op rides to the core, which applies the Average postscale
+        # itself (ExecReducescatter) — same semantics as the bridge.
+        return synchronize(TorchHandle(h, native=nat, kind="gather",
+                                       out=_DT_CODE[x.dtype], keep=(x,)))
     return torch.from_numpy(np.ascontiguousarray(
         _core.reducescatter(_to_numpy(tensor), op=op, name=name,
                             process_set=process_set)))
@@ -132,41 +201,113 @@ def allgather_object(obj, name=None, process_set=0):
 class TorchHandle:
     """Core handle + optional in-place target tensor (reference:
     handle_manager.cc handles are ints; the in-place variants remember the
-    destination)."""
+    destination). Native-extension handles additionally pin the aten
+    buffers the core reads/writes (`keep`) until synchronize()."""
 
-    __slots__ = ("core", "target")
+    __slots__ = ("core", "target", "native", "kind", "out", "keep")
 
-    def __init__(self, core_handle, target=None):
+    def __init__(self, core_handle, target=None, native=None, kind=None,
+                 out=None, keep=()):
         self.core = core_handle
         self.target = target
+        self.native = native
+        self.kind = kind
+        self.out = out
+        self.keep = keep
 
 
-def allreduce_async(tensor, op=Average, name=None, process_set=0):
+def allreduce_async(tensor, op=Average, name=None, process_set=0,
+                    prescale_factor=1.0, postscale_factor=1.0):
+    nat = _native_for(tensor)
+    if nat is not None:
+        x = tensor.detach().contiguous()
+        out = torch.empty_like(x)
+        h = nat.allreduce_async(x, out,
+                                name or _core._auto_name("allreduce", None),
+                                int(op), float(prescale_factor),
+                                float(postscale_factor), int(process_set))
+        return TorchHandle(h, native=nat, out=out, keep=(x, out))
     return TorchHandle(_core.allreduce_async(
-        _to_numpy(tensor), op=op, name=name, process_set=process_set))
+        _to_numpy(tensor), op=op, name=name, process_set=process_set,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor))
 
 
 def allreduce_async_(tensor, op=Average, name=None, process_set=0):
-    """Async in-place allreduce; synchronize() copies the result back."""
+    """Async in-place allreduce; synchronize() returns the tensor."""
+    nat = _native_for(tensor, inplace=True)
+    if nat is not None:
+        h = nat.allreduce_async(tensor, tensor,
+                                name or _core._auto_name("allreduce", None),
+                                int(op), 1.0, 1.0, int(process_set))
+        return TorchHandle(h, target=tensor, native=nat, keep=(tensor,))
     return TorchHandle(_core.allreduce_async(
         _to_numpy(tensor), op=op, name=name, process_set=process_set),
         target=tensor)
 
 
+def allgather_async(tensor, name=None, process_set=0):
+    nat = _native_for(tensor)
+    if nat is not None:
+        x = tensor.detach().contiguous()
+        h = nat.allgather_async(x,
+                                name or _core._auto_name("allgather", None),
+                                int(process_set))
+        return TorchHandle(h, native=nat, kind="gather",
+                           out=_DT_CODE[x.dtype], keep=(x,))
+    return TorchHandle(_core.allgather_async(
+        _to_numpy(tensor), name=name, process_set=process_set))
+
+
 def broadcast_async_(tensor, root_rank, name=None, process_set=0):
+    nat = _native_for(tensor, inplace=True)
+    if nat is not None:
+        h = nat.broadcast_async_(tensor, int(root_rank),
+                                 name or _core._auto_name("broadcast", None),
+                                 int(process_set))
+        return TorchHandle(h, target=tensor, native=nat, keep=(tensor,))
     return TorchHandle(_core.broadcast_async(
         _to_numpy(tensor), root_rank=root_rank, name=name,
         process_set=process_set), target=tensor)
 
 
 def poll(handle):
-    return _core.poll(handle.core if isinstance(handle, TorchHandle)
-                      else handle)
+    if isinstance(handle, TorchHandle):
+        if handle.native is not None:
+            return handle.native.poll(handle.core)
+        handle = handle.core
+    return _core.poll(handle)
+
+
+def _native_synchronize(handle):
+    nat = handle.native
+    try:
+        nat.wait(handle.core)  # releases the handle itself on failure
+    except RuntimeError as e:
+        # Same classification as the bridge (collective_ops.synchronize):
+        # peer-death/shutdown → the elastic signal; deterministic
+        # validation errors stay plain RuntimeErrors.
+        if "HorovodInternalError" in str(e) or "shutdown" in str(e):
+            raise HorovodInternalError(str(e)) from None
+        raise RuntimeError(
+            f"collective '{handle.core}' failed: {e}") from None
+    try:
+        if handle.kind == "gather":
+            return nat.result(handle.core, handle.out)
+        if handle.kind == "alltoall":
+            out = nat.result(handle.core, handle.out)
+            rs = nat.recv_splits(handle.core)
+            return out, torch.tensor(rs, dtype=torch.int64)
+        return handle.target if handle.target is not None else handle.out
+    finally:
+        nat.release(handle.core)
 
 
 def synchronize(handle):
     target = None
     if isinstance(handle, TorchHandle):
+        if handle.native is not None:
+            return _native_synchronize(handle)
         target = handle.target
         handle = handle.core
     out = _core.synchronize(handle)

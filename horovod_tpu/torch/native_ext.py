@@ -1,0 +1,58 @@
+"""Loader for the native torch extension (csrc/torch_ops.cc — the
+`horovod/torch/mpi_ops_v2.cc` analog).
+
+`lib()` JIT-builds the extension once per machine via
+``torch.utils.cpp_extension.load`` (torch vendors pybind11; ninja does
+the build under the shared csrc build lock, cached in /tmp so later
+processes just dlopen) and returns the module, or None when unavailable —
+the numpy bridge remains the fallback. ``HVD_TORCH_NATIVE_OPS=0`` forces
+the fallback; an ``HVD_LIB`` core override also falls back, because the
+extension links the default core library and would otherwise run against
+a second, uninitialized global state.
+"""
+import os
+import sys
+
+_PKG = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CSRC = os.path.join(_PKG, "csrc")
+_LIBDIR = os.path.join(_PKG, "lib")
+
+_loaded = False
+_mod = None
+
+
+def lib():
+    global _loaded, _mod
+    if _loaded:
+        return _mod
+    _loaded = True
+    if os.environ.get("HVD_TORCH_NATIVE_OPS", "1") == "0":
+        return None
+    override = os.environ.get("HVD_LIB")
+    if override and (os.path.realpath(override) != os.path.realpath(
+            os.path.join(_LIBDIR, "libhvd_tpu.so"))):
+        return None
+    src = os.path.join(_CSRC, "torch_ops.cc")
+    if not (os.path.exists(src)
+            and os.path.exists(os.path.join(_LIBDIR, "libhvd_tpu.so"))):
+        return None
+    try:
+        import fcntl
+
+        from torch.utils import cpp_extension
+
+        build_dir = os.path.join(
+            "/tmp", f"hvd-torch-ext-{os.getuid()}-"
+            f"py{sys.version_info[0]}{sys.version_info[1]}")
+        os.makedirs(build_dir, exist_ok=True)
+        with open(os.path.join(_CSRC, ".build.lock"), "w") as lk:
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            _mod = cpp_extension.load(
+                name="hvd_torch_ops", sources=[src],
+                build_directory=build_dir,
+                extra_ldflags=[f"-L{_LIBDIR}", "-l:libhvd_tpu.so",
+                               f"-Wl,-rpath,{_LIBDIR}"],
+                verbose=False)
+    except Exception:  # noqa: BLE001 — any failure → numpy-bridge fallback
+        _mod = None
+    return _mod

@@ -17,6 +17,14 @@ def test_torch_binding_4proc():
     run_worker_job(4, "torch_worker.py", timeout=240)
 
 
+def test_torch_binding_numpy_fallback():
+    """HVD_TORCH_NATIVE_OPS=0: the whole matrix must still pass through
+    the numpy bridge (the no-toolchain fallback)."""
+    pytest.importorskip("torch")
+    run_worker_job(2, "torch_worker.py", timeout=240,
+                   extra_env={"HVD_TORCH_NATIVE_OPS": "0"})
+
+
 def test_tf_binding_2proc():
     """Default path: the native custom-op library (csrc/tf_ops.cc
     AsyncOpKernels, the reference's tensorflow/mpi_ops.cc analog) carries

@@ -12,6 +12,14 @@ hvd.init()
 r, s = hvd.rank(), hvd.size()
 torch.manual_seed(1234 + r)  # intentionally different per rank
 
+# The native extension (csrc/torch_ops.cc) must carry the collectives in
+# this environment unless the fallback was requested
+# (HVD_TORCH_NATIVE_OPS=0 — test_torch_binding_numpy_fallback).
+from horovod_tpu.torch import native_ext  # noqa: E402
+
+expect_native = os.environ.get("HVD_TORCH_NATIVE_OPS", "1") == "1"
+assert (native_ext.lib() is not None) == expect_native, "native ext state"
+
 # collectives
 t = torch.full((10,), float(r + 1))
 out = hvd.allreduce(t, op=hvd.Sum)
@@ -21,6 +29,30 @@ assert g.shape == (2 * s, 2)
 b = hvd.broadcast(torch.arange(4, dtype=torch.float32) * (r + 1),
                   root_rank=0)
 assert torch.allclose(b, torch.arange(4, dtype=torch.float32))
+
+# alltoall with splits + reducescatter (native kernels when loaded)
+a2a, rs = hvd.alltoall(torch.full((2 * s,), float(r)), splits=[2] * s)
+assert torch.allclose(a2a, torch.arange(s, dtype=torch.float32)
+                      .repeat_interleave(2)), a2a
+assert torch.all(rs == 2), rs
+rsc = hvd.reducescatter(torch.ones(2 * s, 3) * float(r + 1), op=hvd.Sum)
+assert rsc.shape == (2, 3)
+assert torch.allclose(rsc, torch.full((2, 3), s * (s + 1) / 2.0)), rsc
+ravg = hvd.reducescatter(torch.ones(2 * s, 3) * float(r + 1),
+                         op=hvd.Average)
+assert torch.allclose(ravg, torch.full((2, 3), (s + 1) / 2.0)), ravg
+
+# 0-d scalars keep their shape (they ride the bridge, which promotes to
+# 1-d for the wire and restores — native submits true shapes only)
+sc = hvd.allreduce(torch.tensor(float(r + 1)), op=hvd.Sum)
+assert sc.shape == () and float(sc) == s * (s + 1) / 2.0, sc
+
+# non-contiguous input is handled (native path copies to contiguous;
+# in-place variants fall back to the bridge)
+nc = (torch.arange(16, dtype=torch.float32).reshape(4, 4).T)[1:3]
+assert not nc.is_contiguous()
+out_nc = hvd.allreduce(nc, op=hvd.Sum)
+assert torch.allclose(out_nc, nc * s), out_nc
 
 # model sync + hook-based DistributedOptimizer
 model = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.ReLU(),
