@@ -233,17 +233,25 @@ def allreduce_async(tensor, op=Average, name=None, process_set=0,
         postscale_factor=postscale_factor))
 
 
-def allreduce_async_(tensor, op=Average, name=None, process_set=0):
-    """Async in-place allreduce; synchronize() returns the tensor."""
+def allreduce_async_(tensor, op=Average, name=None, process_set=0,
+                     prescale_factor=1.0, postscale_factor=1.0):
+    """Async in-place allreduce; synchronize() returns the tensor.
+
+    Both legs hand the scale factors to the core, so mixed native/bridge
+    jobs submit identical requests (the coordinator does not
+    consistency-check prescale — divergent values would silently win by
+    rank order)."""
     nat = _native_for(tensor, inplace=True)
     if nat is not None:
         h = nat.allreduce_async(tensor, tensor,
                                 name or _core._auto_name("allreduce", None),
-                                int(op), 1.0, 1.0, int(process_set))
+                                int(op), float(prescale_factor),
+                                float(postscale_factor), int(process_set))
         return TorchHandle(h, target=tensor, native=nat, keep=(tensor,))
     return TorchHandle(_core.allreduce_async(
-        _to_numpy(tensor), op=op, name=name, process_set=process_set),
-        target=tensor)
+        _to_numpy(tensor), op=op, name=name, process_set=process_set,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor), target=tensor)
 
 
 def allgather_async(tensor, name=None, process_set=0):
@@ -379,25 +387,37 @@ class _DistributedOptimizerMixin:
             return
         if p in self._hvd_handles:
             return
-        a = p.grad.detach().cpu().numpy()
-        ctx = None
-        if self._hvd_compression is not None:
-            a, ctx = self._hvd_compression.compress(a)
-        if self._hvd_bpps > 1:
-            a = a / self._hvd_bpps
         # Execution-time factors (shared helper): elastic resizes are
         # honored and an unknown process set fails loudly.
         op, pre, post = _core.predivide_factors(
             self._hvd_op, self._hvd_predivide, self._hvd_process_set)
+        name = f"allreduce.{self._hvd_names.get(p, id(p))}"
+        if self._hvd_compression is None:
+            # Hot path: in-place allreduce on the grad buffer via
+            # allreduce_async_ (native extension when available, bridge
+            # otherwise — both submit the SAME prescale, with the bpps
+            # local-accumulation average folded in).
+            h = allreduce_async_(
+                p.grad, op=op, name=name,
+                process_set=self._hvd_process_set,
+                prescale_factor=pre / self._hvd_bpps,
+                postscale_factor=post)
+            self._hvd_handles[p] = (h, None)
+            return
+        a = self._hvd_compression.compress(p.grad.detach().cpu().numpy())
+        a, ctx = a
+        if self._hvd_bpps > 1:
+            a = a / self._hvd_bpps
         h = _core.allreduce_async(
-            a, op=op,
-            name=f"allreduce.{self._hvd_names.get(p, id(p))}",
-            process_set=self._hvd_process_set,
+            a, op=op, name=name, process_set=self._hvd_process_set,
             prescale_factor=pre, postscale_factor=post)
         self._hvd_handles[p] = (h, ctx)
 
     def synchronize(self):
         for p, (h, ctx) in list(self._hvd_handles.items()):
+            if isinstance(h, TorchHandle):
+                synchronize(h)  # in place on p.grad (native or bridge)
+                continue
             out = _core.synchronize(h)
             if self._hvd_compression is not None:
                 out = self._hvd_compression.decompress(out, ctx)
