@@ -229,13 +229,18 @@ class HorovodBasics:
         first whose Enabled() holds for a response executes it (reference:
         ops/operation_manager.cc op lists). `op_type`: 0=allreduce,
         1=allgather, 2=broadcast, 3=alltoall, 4=reducescatter."""
-        buf = ctypes.create_string_buffer(512)
-        rc = _lib.hvd_op_backends(int(op_type), buf, len(buf))
-        if rc == -1:
-            raise ValueError("horovod_tpu has not been initialized")
-        if rc < 0:
-            raise RuntimeError(f"hvd_op_backends failed: {rc}")
-        return buf.value.decode().split(",") if buf.value else []
+        size = 512
+        while True:
+            buf = ctypes.create_string_buffer(size)
+            rc = _lib.hvd_op_backends(int(op_type), buf, len(buf))
+            if rc == -1:
+                raise ValueError("horovod_tpu has not been initialized")
+            if rc == -2:  # buffer too small — grow and retry
+                size *= 2
+                continue
+            if rc < 0:
+                raise RuntimeError(f"hvd_op_backends failed: {rc}")
+            return buf.value.decode().split(",") if buf.value else []
 
     def backend_uses(self, name):
         """Responses executed by the named backend since init (e.g.

@@ -1,0 +1,305 @@
+// tf_xla_ops.cc — collectives inside XLA-compiled TensorFlow graphs.
+//
+// TPU-native counterpart of the reference's horovod/tensorflow/xla_mpi_ops.cc
+// (`HVDAllreduceOp` — an XlaOpKernel emitting a CustomCall so hvd.allreduce
+// works under `tf.function(jit_compile=True)`, gated by
+// HOROVOD_ENABLE_XLA_OPS). The reference routes the GPU custom call through
+// a ready-event table; here the call target runs on the XLA:CPU execute
+// thread and synchronously rides the shared core (enqueue → background
+// negotiation thread → fused TCP plane → wait), exactly like the
+// AsyncOpKernels in tf_ops.cc do from their closure threads.
+//
+// Coverage is allreduce + broadcast: the shape-preserving collectives (XLA
+// needs static shapes; allgather/alltoall are dynamically shaped by design
+// and stay eager/graph-mode — the reference's XLA file covers allreduce
+// only). Metadata (name, op, scales, process set) is serialized into a
+// trailing u8 constant operand because XLA:CPU's legacy custom-call ABI
+// does not deliver the `opaque` string (the thunk calls
+// `target(out, ins, status)`).
+//
+// Built as a separate library (`make tfxla`) and loaded by
+// tensorflow/native_ops.py only when HVD_ENABLE_XLA_OPS=1, mirroring the
+// reference's build/runtime gate. It must be loaded after
+// libhvd_tf_ops.so, which owns the REGISTER_OP definitions.
+//
+// Note: XLA:CPU logs a deprecation E-line for API_VERSION_STATUS_RETURNING
+// custom calls (slated post-TF-2.21 for the typed FFI); the call executes
+// correctly, and this tree pins TF 2.21.
+
+#include <cstdint>
+#include <cstring>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "tensorflow/compiler/tf2xla/xla_op_kernel.h"
+#include "tensorflow/compiler/tf2xla/xla_op_registry.h"
+#include "tensorflow/core/framework/op_kernel.h"
+#include "xla/hlo/builder/xla_builder.h"
+#include "xla/service/custom_call_status.h"
+#include "xla/service/custom_call_target_registry.h"
+
+// C API of libhvd_tpu.so (signatures mirror horovod_tpu/basics.py).
+extern "C" {
+int hvd_allreduce_async(const char* name, const void* in, void* out,
+                        const long long* shape, int ndim, int dtype,
+                        int red_op, double prescale, double postscale,
+                        int process_set, int group_id, int group_size);
+int hvd_broadcast_async(const char* name, const void* in, void* out,
+                        const long long* shape, int ndim, int dtype,
+                        int root, int process_set);
+int hvd_wait(int handle);
+void hvd_release(int handle);
+const char* hvd_last_error();
+}
+
+// The C status setter is declared in custom_call_status.h but not exported
+// from libtensorflow_cc; define it locally against the same layout XLA's
+// custom_call_status.cc uses (the thunk reads the message back through the
+// exported CustomCallStatusGetMessage, so only the struct layout must
+// match: an optional<string>).
+struct XlaCustomCallStatus_ {
+  std::optional<std::string> message;
+};
+extern "C" void XlaCustomCallStatusSetFailure(XlaCustomCallStatus* status,
+                                              const char* message,
+                                              size_t message_len) {
+  status->message = std::string(message, strnlen(message, message_len));
+}
+
+namespace {
+
+using ::tensorflow::DataType;
+using ::tensorflow::OpKernelConstruction;
+using ::tensorflow::TensorShape;
+using ::tensorflow::XlaOpKernel;
+using ::tensorflow::XlaOpKernelContext;
+
+int DtypeCode(DataType dt) {
+  // Must match horovod_tpu/ops/collective_ops.py _DT_MAP.
+  switch (dt) {
+    case ::tensorflow::DT_UINT8: return 0;
+    case ::tensorflow::DT_INT8: return 1;
+    case ::tensorflow::DT_INT32: return 2;
+    case ::tensorflow::DT_INT64: return 3;
+    case ::tensorflow::DT_HALF: return 4;
+    case ::tensorflow::DT_FLOAT: return 5;
+    case ::tensorflow::DT_DOUBLE: return 6;
+    case ::tensorflow::DT_BOOL: return 7;
+    case ::tensorflow::DT_BFLOAT16: return 8;
+    default: return -1;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Metadata blob: compile-time op parameters serialized into a u8[] constant
+// operand (XLA:CPU drops `opaque`; shapes are static under XLA so they can
+// ride the blob). Layout, little-endian, no padding:
+//   i32 kind (0=allreduce 1=broadcast), i32 dtype, i32 ndim,
+//   i64 dims[ndim], i32 red_op_or_root, f64 prescale, f64 postscale,
+//   i32 process_set, i32 name_len, char name[name_len]
+
+constexpr int kAllreduce = 0;
+constexpr int kBroadcast = 1;
+
+void AppendRaw(std::vector<uint8_t>* buf, const void* p, size_t n) {
+  const uint8_t* b = reinterpret_cast<const uint8_t*>(p);
+  buf->insert(buf->end(), b, b + n);
+}
+void AppendI32(std::vector<uint8_t>* buf, int32_t v) {
+  AppendRaw(buf, &v, sizeof v);
+}
+void AppendI64(std::vector<uint8_t>* buf, int64_t v) {
+  AppendRaw(buf, &v, sizeof v);
+}
+void AppendF64(std::vector<uint8_t>* buf, double v) {
+  AppendRaw(buf, &v, sizeof v);
+}
+
+struct Meta {
+  int32_t kind = 0;
+  int32_t dtype = 0;
+  std::vector<long long> dims;
+  int32_t red_op_or_root = 0;
+  double prescale = 1.0, postscale = 1.0;
+  int32_t process_set = 0;
+  std::string name;
+};
+
+std::vector<uint8_t> PackMeta(const Meta& m) {
+  std::vector<uint8_t> buf;
+  AppendI32(&buf, m.kind);
+  AppendI32(&buf, m.dtype);
+  AppendI32(&buf, (int32_t)m.dims.size());
+  for (long long d : m.dims) AppendI64(&buf, d);
+  AppendI32(&buf, m.red_op_or_root);
+  AppendF64(&buf, m.prescale);
+  AppendF64(&buf, m.postscale);
+  AppendI32(&buf, m.process_set);
+  AppendI32(&buf, (int32_t)m.name.size());
+  AppendRaw(&buf, m.name.data(), m.name.size());
+  return buf;
+}
+
+class MetaReader {
+ public:
+  explicit MetaReader(const uint8_t* p) : p_(p) {}
+  int32_t I32() { int32_t v; memcpy(&v, p_, sizeof v); p_ += sizeof v; return v; }
+  int64_t I64() { int64_t v; memcpy(&v, p_, sizeof v); p_ += sizeof v; return v; }
+  double F64() { double v; memcpy(&v, p_, sizeof v); p_ += sizeof v; return v; }
+  std::string Str(size_t n) {
+    std::string s(reinterpret_cast<const char*>(p_), n);
+    p_ += n;
+    return s;
+  }
+
+ private:
+  const uint8_t* p_;
+};
+
+Meta UnpackMeta(const uint8_t* p) {
+  MetaReader r(p);
+  Meta m;
+  m.kind = r.I32();
+  m.dtype = r.I32();
+  int32_t ndim = r.I32();
+  for (int i = 0; i < ndim; ++i) m.dims.push_back(r.I64());
+  m.red_op_or_root = r.I32();
+  m.prescale = r.F64();
+  m.postscale = r.F64();
+  m.process_set = r.I32();
+  int32_t nlen = r.I32();
+  m.name = r.Str(nlen);
+  return m;
+}
+
+// ---------------------------------------------------------------------------
+// Custom-call target (XLA:CPU, API_VERSION_STATUS_RETURNING):
+// target(out, ins, status). ins[0] = data, ins[1] = metadata blob.
+
+void Fail(XlaCustomCallStatus* status, const std::string& msg) {
+  // "horovod_tpu collective failed" matches tf_ops.cc's wording; the core's
+  // shutdown/HorovodInternalError markers inside `msg` are what
+  // elastic._is_native_op_failure keys on.
+  std::string full = "horovod_tpu collective failed: " + msg;
+  XlaCustomCallStatusSetFailure(status, full.c_str(), full.size());
+}
+
+extern "C" void hvd_tpu_xla_collective(void* out, const void** ins,
+                                       XlaCustomCallStatus* status) {
+  Meta m = UnpackMeta(reinterpret_cast<const uint8_t*>(ins[1]));
+  int h = -1;
+  if (m.kind == kAllreduce) {
+    h = hvd_allreduce_async(m.name.c_str(), ins[0], out, m.dims.data(),
+                            (int)m.dims.size(), m.dtype, m.red_op_or_root,
+                            m.prescale, m.postscale, m.process_set, -1, 0);
+  } else if (m.kind == kBroadcast) {
+    h = hvd_broadcast_async(m.name.c_str(), ins[0], out, m.dims.data(),
+                            (int)m.dims.size(), m.dtype, m.red_op_or_root,
+                            m.process_set);
+  }
+  if (h < 0) {
+    const char* e = hvd_last_error();
+    Fail(status, std::string("enqueue failed: ") + (e ? e : "unknown"));
+    return;
+  }
+  int rc = hvd_wait(h);
+  if (rc != 1) {
+    const char* e = hvd_last_error();
+    Fail(status, e ? e : "unknown");
+  }
+  hvd_release(h);
+}
+
+struct TargetRegisterer {
+  TargetRegisterer() {
+    xla::CustomCallTargetRegistry::Global()->Register(
+        "hvd_tpu_xla_collective",
+        reinterpret_cast<void*>(&hvd_tpu_xla_collective), "Host");
+  }
+};
+TargetRegisterer target_registerer;
+
+// ---------------------------------------------------------------------------
+// XlaOpKernels. Registered for the SAME op names tf_ops.cc defines, so
+// call-sites are unchanged; with this library loaded tf2xla compiles them
+// instead of rejecting the graph (reference: REGISTER_XLA_OP(
+// Name("HorovodAllreduce"), HVDAllreduceOp) in xla_mpi_ops.cc).
+
+xla::XlaOp EmitCollective(XlaOpKernelContext* ctx, const Meta& m) {
+  xla::XlaBuilder* b = ctx->builder();
+  xla::XlaOp x = ctx->Input(0);
+  xla::XlaOp meta = xla::ConstantR1<uint8_t>(b, PackMeta(m));
+  xla::Shape out_shape = b->GetShape(x).value();
+  // has_side_effect: a collective must not be CSE'd or dead-code-eliminated
+  // — every rank's program must enqueue it exactly once.
+  return xla::CustomCall(
+      b, "hvd_tpu_xla_collective", {x, meta}, out_shape, /*opaque=*/"",
+      /*has_side_effect=*/true, /*output_operand_aliasing=*/{},
+      /*literal=*/nullptr, xla::CustomCallSchedule::SCHEDULE_NONE,
+      xla::CustomCallApiVersion::API_VERSION_STATUS_RETURNING);
+}
+
+class HvdTpuAllreduceXlaOp : public XlaOpKernel {
+ public:
+  explicit HvdTpuAllreduceXlaOp(OpKernelConstruction* c) : XlaOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("reduce_op", &red_op_));
+    OP_REQUIRES_OK(c, c->GetAttr("prescale", &prescale_));
+    OP_REQUIRES_OK(c, c->GetAttr("postscale", &postscale_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set", &process_set_));
+  }
+
+  void Compile(XlaOpKernelContext* ctx) override {
+    Meta m;
+    m.kind = kAllreduce;
+    m.dtype = DtypeCode(ctx->input_type(0));
+    OP_REQUIRES(ctx, m.dtype >= 0,
+                ::tensorflow::errors::Internal("unsupported dtype"));
+    TensorShape shape = ctx->InputShape(0);
+    for (int i = 0; i < shape.dims(); ++i) m.dims.push_back(shape.dim_size(i));
+    m.red_op_or_root = red_op_;
+    m.prescale = prescale_;
+    m.postscale = postscale_;
+    m.process_set = process_set_;
+    m.name = name_;
+    ctx->SetOutput(0, EmitCollective(ctx, m));
+  }
+
+ private:
+  std::string name_;
+  int red_op_, process_set_;
+  float prescale_, postscale_;
+};
+
+class HvdTpuBroadcastXlaOp : public XlaOpKernel {
+ public:
+  explicit HvdTpuBroadcastXlaOp(OpKernelConstruction* c) : XlaOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("root_rank", &root_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set", &process_set_));
+  }
+
+  void Compile(XlaOpKernelContext* ctx) override {
+    Meta m;
+    m.kind = kBroadcast;
+    m.dtype = DtypeCode(ctx->input_type(0));
+    OP_REQUIRES(ctx, m.dtype >= 0,
+                ::tensorflow::errors::Internal("unsupported dtype"));
+    TensorShape shape = ctx->InputShape(0);
+    for (int i = 0; i < shape.dims(); ++i) m.dims.push_back(shape.dim_size(i));
+    m.red_op_or_root = root_;
+    m.process_set = process_set_;
+    m.name = name_;
+    ctx->SetOutput(0, EmitCollective(ctx, m));
+  }
+
+ private:
+  std::string name_;
+  int root_, process_set_;
+};
+
+REGISTER_XLA_OP(Name("HvdTpuAllreduce"), HvdTpuAllreduceXlaOp);
+REGISTER_XLA_OP(Name("HvdTpuBroadcast"), HvdTpuBroadcastXlaOp);
+
+}  // namespace

@@ -163,6 +163,35 @@ class JaxState(ObjectState):
         self.save()
 
 
+def _is_native_op_failure(e):
+    """True iff `e` is a framework runtime error wrapping the core's
+    elastic failure signal: a TF op error from the native kernels
+    (csrc/tf_ops.cc / tf_xla_ops.cc re-raise the core's message through
+    tf.errors machinery) or a JAX runtime error from an in-jit io_callback
+    collective (jax re-surfaces the callback's HorovodInternalError as
+    XlaRuntimeError). Restricting to those types keeps unrelated
+    exceptions that merely mention 'shutdown' from being swallowed into
+    the restore loop; torch needs no entry here — its binding remaps to
+    HorovodInternalError itself (torch/__init__.py)."""
+    import sys
+
+    # sys.modules, not import: `e` can only be a framework error type if
+    # that framework is already loaded, and this runs mid-recovery — a
+    # cold `import tensorflow` in a jax-only process would be seconds of
+    # side-effectful initialization inside the restore loop.
+    wrapper_types = []
+    tf = sys.modules.get("tensorflow")
+    if tf is not None:
+        wrapper_types.append(tf.errors.OpError)
+    jax = sys.modules.get("jax")
+    if jax is not None and hasattr(jax, "errors"):
+        wrapper_types.append(jax.errors.JaxRuntimeError)
+    if not isinstance(e, tuple(wrapper_types)):
+        return False
+    msg = str(e)
+    return "HorovodInternalError" in msg or "shutdown" in msg
+
+
 def run_fn(func, reset):
     """Build the elastic retry wrapper around `func(state, ...)`.
 
@@ -196,12 +225,12 @@ def run_fn(func, reset):
                     # failed collective as tf.errors.InternalError carrying
                     # the core's message; map it back to the elastic signal
                     # (reference: horovod/tensorflow/elastic.py does the
-                    # same for its op errors). Only the core's INTERNAL
-                    # markers qualify — deterministic validation errors
-                    # ("mismatched shape", "unknown process set") must
-                    # surface, not loop through restore/rendezvous forever.
-                    if "HorovodInternalError" not in str(e) \
-                            and "shutdown" not in str(e):
+                    # same for its op errors). Only tf.errors.OpError
+                    # carrying the core's INTERNAL markers qualifies —
+                    # anything else (including deterministic validation
+                    # errors) must surface, not loop through
+                    # restore/rendezvous forever.
+                    if not _is_native_op_failure(e):
                         raise
                     state.restore()
                     reset_required = True

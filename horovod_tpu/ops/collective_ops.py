@@ -161,6 +161,14 @@ def _collect_result(handle):
 # ---------------------------------------------------------------------------
 # Allreduce
 
+def _f32(x):
+    """Round a scale factor through float32 so bridge ranks submit the same
+    bits as native TF/torch ranks, whose op attrs are float32 (tf_ops.cc
+    'prescale: float'). Mixed-precision factors across ranks would reduce
+    to slightly different values."""
+    return float(np.float32(x))
+
+
 def allreduce_async(tensor, op=Average, name=None, prescale_factor=1.0,
                     postscale_factor=1.0, process_set=0, _group=(-1, 0)):
     # np.ascontiguousarray promotes 0-d to 1-d; hand the caller back a 0-d
@@ -172,7 +180,7 @@ def allreduce_async(tensor, op=Average, name=None, prescale_factor=1.0,
     shape, ndim = _shape_arg(arr)
     h = _check_handle(_lib.hvd_allreduce_async(
         name.encode(), _ptr(arr), _ptr(out), shape, ndim, _dtype_code(arr),
-        int(op), float(prescale_factor), float(postscale_factor),
+        int(op), _f32(prescale_factor), _f32(postscale_factor),
         int(process_set), _group[0], _group[1]))
     return _register(Handle(h, "allreduce", (arr,), out.reshape(orig_shape),
                             arr.dtype, name))
@@ -395,7 +403,7 @@ def reducescatter_async(tensor, op=Average, name=None, prescale_factor=1.0,
     shape, ndim = _shape_arg(arr)
     h = _check_handle(_lib.hvd_reducescatter_async(
         name.encode(), _ptr(arr), shape, ndim, _dtype_code(arr), int(op),
-        float(prescale_factor), float(postscale_factor), int(process_set),
+        _f32(prescale_factor), _f32(postscale_factor), int(process_set),
         _group[0], _group[1]))
     return _register(Handle(h, "reducescatter", (arr,), None, arr.dtype, name))
 

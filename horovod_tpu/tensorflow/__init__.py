@@ -47,6 +47,18 @@ def init():
     return _pkg.init()
 
 
+# Load the native op libraries at IMPORT time, not lazily on first op:
+# TF's XlaOpRegistry materializes compilation kernels once, on the first
+# XLA compile — XlaOpKernels registered after that (e.g. by a lib() call
+# inside a jit_compile trace) would never become kernels, and the graph
+# would be rejected. Import time also covers users who initialize via
+# package-level horovod_tpu.init(). (The reference likewise loads its op
+# library when horovod.tensorflow is imported.)
+from . import native_ops as _native_ops  # noqa: E402
+
+_native_ops.lib()
+
+
 shutdown = _basics.shutdown
 is_initialized = _basics.is_initialized
 rank = _basics.rank
@@ -364,10 +376,80 @@ def _grouped_np(tensors, op, name, process_set, compression,
 
     if tf.executing_eagerly():
         return [tf.convert_to_tensor(o) for o in np_fn(*tensors)]
+    from . import native_ops
+
+    if native_ops.xla_enabled() \
+            and _xla_compression_cast(compression) is not ...:
+        return _xla_per_tensor(tensors, op, name, process_set, compression,
+                               gradient_predivide_factor)
+    # Unknown (custom) compressors can't be expressed as in-graph casts:
+    # stay on the py_function path, which XLA then rejects LOUDLY instead
+    # of this branch silently skipping the user's compressor.
     outs = tf.py_function(np_fn, tensors, [t.dtype for t in tensors])
     # py_function loses static shapes; restore them for downstream ops
     for o, t in zip(outs, tensors):
         o.set_shape(t.shape)
+    return outs
+
+
+def _xla_compression_cast(compression):
+    """The tf dtype implementing `compression` as an in-graph cast, None
+    for no compression, or ``...`` when the compressor has no in-graph
+    equivalent (custom subclass) and the XLA branch must not be taken."""
+    if compression is None:
+        return None
+    from ..compression import (BF16Compressor, FP16Compressor,
+                               NoneCompressor)
+
+    cls = compression if isinstance(compression, type) \
+        else type(compression)
+    tf = _tf()
+    # Exact-class match only: a SUBCLASS may override compress/decompress
+    # (e.g. error feedback) that a bare cast would silently skip.
+    if cls is FP16Compressor:
+        return tf.float16
+    if cls is BF16Compressor:
+        return tf.bfloat16
+    if cls is NoneCompressor:
+        return None
+    return ...
+
+
+def _xla_per_tensor(tensors, op, name, process_set, compression,
+                    gradient_predivide_factor):
+    """Gradient reduction as per-tensor native ops so the whole train step
+    compiles under tf.function(jit_compile=True) (csrc/tf_xla_ops.cc; the
+    reference's xla_mpi_ops.cc path is likewise per-tensor HVDAllreduce).
+
+    Taken for EVERY non-eager trace while HVD_ENABLE_XLA_OPS=1 — TF gives
+    a trace no reliable signal of whether it will be jit-compiled, so the
+    env gate opts the whole process in (the reference's
+    HOROVOD_ENABLE_XLA_OPS is likewise process-global). The atomic-group
+    fusion of the py_function path is traded for XLA compilability; the
+    core's fusion buffer still packs the resulting small messages per
+    cycle. Predivide factors are computed at TRACE time here — same
+    contract as the reference's XLA op attrs; Average's 1/size itself
+    stays execution-time inside the core, so plain averaging remains
+    elastic-safe."""
+    from . import native_ops
+
+    tf = _tf()
+    nat = native_ops.lib()
+    eff_op, pre, post = _core.predivide_factors(
+        op, gradient_predivide_factor, process_set)
+    cast_to = _xla_compression_cast(compression)
+    outs = []
+    for i, t in enumerate(tensors):
+        orig = t.dtype
+        if cast_to is not None and orig in (tf.float32, tf.float64):
+            t = tf.cast(t, cast_to)
+        y = nat.hvd_tpu_allreduce(
+            t, tensor_name=f"{name}.{i}", reduce_op=int(eff_op),
+            prescale=float(pre), postscale=float(post),
+            process_set=int(process_set))
+        if y.dtype != orig:
+            y = tf.cast(y, orig)
+        outs.append(y)
     return outs
 
 

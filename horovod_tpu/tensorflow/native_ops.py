@@ -6,6 +6,11 @@ uses) and loads ``libhvd_tf_ops.so`` once per process; returns None when
 the library can't be built/loaded (no TF headers, unexpected TF ABI), in
 which case the binding falls back to the tf.py_function bridge. Set
 ``HVD_TF_NATIVE_OPS=0`` to force the fallback.
+
+With ``HVD_ENABLE_XLA_OPS=1`` it additionally loads
+``libhvd_tf_xla_ops.so`` (csrc/tf_xla_ops.cc — the
+`tensorflow/xla_mpi_ops.cc` analog) so collectives compile inside
+``tf.function(jit_compile=True)``.
 """
 import os
 import subprocess
@@ -16,6 +21,60 @@ _CSRC = os.path.join(_PKG, "csrc")
 
 _loaded = False
 _mod = None
+_xla_loaded = False
+_xla_ok = False
+
+
+def _make_under_lock(target):
+    """Run ``make -s <target>`` in csrc under the shared build lock.
+
+    Always invoked: make's dependency graph (the op sources AND the core
+    library) decides staleness — a Python-side mtime check against one
+    source alone would miss core rebuilds and run old kernels against a
+    new C ABI. A failed make (no compiler in the image) is not fatal if a
+    prebuilt library shipped.
+    """
+    if not os.path.isdir(_CSRC):
+        return
+    try:
+        import fcntl
+        import sys
+
+        with open(os.path.join(_CSRC, ".build.lock"), "w") as lk:
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            subprocess.run(
+                ["make", "-s", target, f"PYTHON={sys.executable}"],
+                cwd=_CSRC, check=True, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def xla_enabled():
+    """Whether the in-XLA-graph collective kernels were requested AND loaded
+    (reference: HOROVOD_ENABLE_XLA_OPS gating xla_mpi_ops.cc)."""
+    return _xla_ok
+
+
+def _load_xla(tf):
+    """Load libhvd_tf_xla_ops.so (XlaOpKernels + custom-call target for the
+    ops libhvd_tf_ops.so registered) when HVD_ENABLE_XLA_OPS=1. With it
+    loaded, hvd.allreduce/broadcast compile inside
+    tf.function(jit_compile=True); without it, XLA rejects the graph and the
+    op stays eager/graph-mode — same contract as the reference."""
+    global _xla_loaded, _xla_ok
+    if _xla_loaded:
+        return
+    _xla_loaded = True
+    if os.environ.get("HVD_ENABLE_XLA_OPS", "0") != "1":
+        return
+    try:
+        _make_under_lock("tfxla")
+        tf.load_op_library(os.path.join(_PKG, "lib",
+                                        "libhvd_tf_xla_ops.so"))
+        _xla_ok = True
+    except Exception:  # noqa: BLE001 — XLA kernels stay unavailable
+        _xla_ok = False
 
 
 def lib():
@@ -37,28 +96,11 @@ def lib():
                                                       "libhvd_tpu.so"))):
         return None
     try:
-        import fcntl
-        import sys
-
         import tensorflow as tf
 
-        if os.path.isdir(_CSRC):
-            # Always invoke make under the lock: its dependency graph
-            # (tf_ops.cc AND the core library) decides staleness — a
-            # Python-side mtime check against tf_ops.cc alone would miss
-            # core rebuilds and run old kernels against a new C ABI. A
-            # failed make (no compiler in the image) is not fatal if a
-            # prebuilt library shipped.
-            try:
-                with open(os.path.join(_CSRC, ".build.lock"), "w") as lk:
-                    fcntl.flock(lk, fcntl.LOCK_EX)
-                    subprocess.run(
-                        ["make", "-s", "tf", f"PYTHON={sys.executable}"],
-                        cwd=_CSRC, check=True, stdout=subprocess.DEVNULL,
-                        stderr=subprocess.DEVNULL)
-            except Exception:  # noqa: BLE001
-                pass
+        _make_under_lock("tf")
         _mod = tf.load_op_library(_LIB)
+        _load_xla(tf)  # base lib owns REGISTER_OP; XLA kernels load after
     except Exception:  # noqa: BLE001 — any failure → py_function fallback
         _mod = None
     return _mod

@@ -41,6 +41,24 @@ def test_tf_binding_pyfunc_fallback():
                    extra_env={"HVD_TF_NATIVE_OPS": "0"})
 
 
+def test_tf_xla_ops_2proc():
+    """HVD_ENABLE_XLA_OPS=1: collectives compile INSIDE
+    tf.function(jit_compile=True) via csrc/tf_xla_ops.cc (XlaOpKernel +
+    CPU CustomCall riding the shared core — the reference's
+    tensorflow/xla_mpi_ops.cc HVDAllreduceOp analog). The worker trains a
+    DistributedGradientTape model in a fully XLA-compiled step."""
+    pytest.importorskip("tensorflow")
+    run_worker_job(2, "tf_xla_worker.py", timeout=300,
+                   extra_env={"HVD_ENABLE_XLA_OPS": "1"})
+
+
+def test_tf_xla_ops_fallback():
+    """Without the gate, jit_compile=True must reject the graph (no silent
+    wrong answers); eager/graph-mode remains the supported path."""
+    pytest.importorskip("tensorflow")
+    run_worker_job(2, "tf_xla_worker.py", timeout=300)
+
+
 def test_mxnet_binding_import_surface():
     """MXNet is absent in this environment (README descope note): the
     binding must fail with a clear, actionable ImportError — and import
