@@ -216,7 +216,9 @@ def _bench_transformer():
 
     dev = jax.devices()[0]
     on_cpu = dev.platform == "cpu"
-    attn = os.environ.get("BENCH_ATTN", "gather")
+    # "auto" = the framework's per-config kernel selection (resolve_attn);
+    # BENCH_ATTN pins an impl for A/B runs.
+    attn = os.environ.get("BENCH_ATTN", "auto")
     if on_cpu:
         cfg = tfm.tiny()
         batch, seq, steps, warmup = 4, 64, 3, 1
@@ -231,7 +233,8 @@ def _bench_transformer():
     peak = _peak_tflops(dev)
     out = {"metric": "bert_large_scale_train_throughput",
            "value": round(tps, 1), "unit": "tokens/sec/chip",
-           "batch": batch, "seq": seq, "attn": cfg.attn_impl}
+           "batch": batch, "seq": seq, "attn": cfg.attn_impl,
+           "attn_resolved": tfm.resolve_attn(cfg, seq)}
     if xla_flops > 0:
         tfl = xla_flops * steps / dt / 1e12
         out["xla_tflops_per_sec"] = round(tfl, 1)
@@ -279,16 +282,18 @@ def _bench_longctx():
 def _bench_allreduce():
     """Gradient-sized allreduce bandwidth through the in-mesh data plane.
 
-    The iteration loop lives INSIDE one jit (lax.fori_loop of pmean) and the
-    program returns a scalar, so one dispatch amortizes host overhead and the
-    device→host transfer ships 4 bytes. (The previous eager-loop version
-    returned the 97 MB buffer each step; on a relay-attached chip that
-    measured the host tunnel's D2H path — ~0.7 GB/s — not the chip.)
-
-    On one chip the collective is the identity, so this is the sustained
-    HBM streaming floor over a ResNet-50 sized gradient set (~97 MB fp32);
-    on a real multi-chip mesh the same program measures ICI allreduce bus
-    bandwidth (reference target: BASELINE.md "≥90% of ICI peak")."""
+    Methodology (round 4 — replaces the single wall-clock figure): the
+    loop lives inside one jit (lax.fori_loop of pmean) and the program is
+    timed at TWO iteration counts; bandwidth comes from the marginal time
+    nbytes*(I2-I1)/(t2-t1). On the relay-attached chip here a single
+    dispatch costs a fluctuating 60–130 ms — the round-3 figure (43 GB/s)
+    was that latency, not data movement: measured per-iteration device
+    time of this loop is ~16 µs at 97 MB (the working set is chip-resident;
+    a 512 MB set streams at ~334 GB/s algbw ≈ 82% of HBM peak — see
+    PERF.md). The two-point form cancels the dispatch constant on one chip
+    and on a real mesh, where per-iteration ICI time (~ms at 97 MB) makes
+    the marginal figure the honest ring bus bandwidth (reference target:
+    BASELINE.md "≥90% of ICI peak")."""
     import functools
 
     import jax
@@ -297,38 +302,57 @@ def _bench_allreduce():
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     devices = jax.devices()
+    on_cpu = devices[0].platform == "cpu"
     mesh = Mesh(np.asarray(devices), ("data",))
     nbytes = 97 * 1024 * 1024
     n = nbytes // 4
     x = jnp.arange(n, dtype=jnp.float32)
     x = jax.device_put(x, NamedSharding(mesh, P()))
-    iters = 50
+    i1, i2 = (2, 10) if on_cpu else (200, 3000)
+    reps = 2 if on_cpu else 6
 
-    @jax.jit
-    @functools.partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P(),
-                       check_vma=False)
-    def ar_loop(x):
-        def body(i, v):
-            # The affine perturbation keeps the single-device identity
-            # pmean from being folded away; on multi-chip the collective
-            # dominates it.
-            return jax.lax.pmean(v, "data") * 0.9999999 + 1e-7
-        v = lax.fori_loop(0, iters, body, x)
-        return jnp.sum(v)[None]
+    def make(iters):
+        @jax.jit
+        @functools.partial(shard_map, mesh=mesh, in_specs=P(),
+                           out_specs=P(), check_vma=False)
+        def ar_loop(x):
+            def body(i, v):
+                # The affine perturbation keeps the single-device identity
+                # pmean from being folded away; on multi-chip the
+                # collective dominates it.
+                return jax.lax.pmean(v, "data") * 0.9999999 + 1e-7
+            v = lax.fori_loop(0, iters, body, x)
+            return jnp.sum(v)[None]
+        return ar_loop
 
-    _sync(ar_loop(x))  # compile + warm
-    t0 = time.perf_counter()
-    _sync(ar_loop(x))
-    dt = time.perf_counter() - t0
-    n = len(devices)
-    alg_gbps = nbytes * iters / dt / 1e9
+    f1, f2 = make(i1), make(i2)
+    _sync(f1(x))  # compile + warm
+    _sync(f2(x))
+    t1 = min_t2 = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _sync(f1(x))
+        t1 = min(t1, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        _sync(f2(x))
+        min_t2 = min(min_t2, time.perf_counter() - t0)
+    nd = len(devices)
+    delta = min_t2 - t1
+    # The dispatch constant fluctuates tens of ms on the relay; if the
+    # min-over-reps estimates didn't separate by clearly more than that
+    # noise, say so instead of printing an absurd marginal figure.
+    noise_dominated = delta < 0.005
+    alg_gbps = nbytes * (i2 - i1) / max(delta, 0.005) / 1e9
     # Ring-allreduce bus bandwidth = algbw * 2(n-1)/n — the figure the
     # "≥90% of ICI peak" target speaks in. Zero on one chip (no wire).
-    bus_gbps = alg_gbps * 2.0 * (n - 1) / n
+    bus_gbps = alg_gbps * 2.0 * (nd - 1) / nd
     return {"metric": "allreduce_bus_bandwidth_97MB",
-            "value": round(alg_gbps, 2), "unit": "GB/s (algorithm bw)",
+            "value": round(alg_gbps, 2),
+            "unit": "GB/s (marginal algorithm bw)",
             "bus_gbps": round(bus_gbps, 2),
-            "iters_in_jit": iters, "n_devices": n,
+            "iters_in_jit": [i1, i2], "n_devices": nd,
+            "dispatch_floor_ms": round(t1 * 1e3, 1),
+            "noise_dominated": noise_dominated,
             "vs_baseline": 1.0}
 
 

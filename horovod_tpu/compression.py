@@ -70,3 +70,23 @@ class Compression:
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+
+
+def wire_cast_dtype(compression):
+    """The wire dtype name implementing `compression` as a bare cast on a
+    fast path ("float16" / "bfloat16"), None for no compression, or
+    ``...`` when the compressor has no cast equivalent and callers must
+    run its compress/decompress (custom compressors). Exact-class match
+    only: a SUBCLASS may override compress/decompress logic a bare cast
+    would silently skip. Single source of truth for the TF-XLA and torch
+    native fast paths — keep per-binding dtype translation thin."""
+    if compression is None:
+        return None
+    cls = compression if isinstance(compression, type) else type(compression)
+    if cls is FP16Compressor:
+        return "float16"
+    if cls is BF16Compressor:
+        return "bfloat16"
+    if cls is NoneCompressor:
+        return None
+    return ...

@@ -35,17 +35,40 @@ constexpr int kNumWarmup = sizeof(kWarmup) / sizeof(kWarmup[0]);
 void ParameterManager::Configure(bool enabled, const std::string& log_path,
                                  int64_t init_fusion, double init_cycle_ms,
                                  int64_t cycles_per_sample,
-                                 int64_t max_samples) {
+                                 int64_t max_samples, bool init_cache,
+                                 bool init_hier, bool can_toggle_cache,
+                                 bool can_toggle_hier) {
   enabled_ = enabled;
   if (!enabled_) return;
   cycles_per_sample_ = cycles_per_sample;
   max_samples_ = max_samples;
   best_fusion_ = init_fusion;
   best_cycle_ms_ = init_cycle_ms;
+  // Arm order: the job's initial configuration first (the baseline every
+  // later score competes against), then the other combinations — but only
+  // over dims that can actually take effect (a capacity-0 cache or a
+  // non-uniform topology makes that toggle a no-op; sweeping it would
+  // burn windows measuring a config that never engaged).
+  int n = 0;
+  for (int c = 0; c < (can_toggle_cache ? 2 : 1); c++) {
+    for (int h = 0; h < (can_toggle_hier ? 2 : 1); h++) {
+      arm_cache_[n] = can_toggle_cache ? (c == 0 ? init_cache : !init_cache)
+                                       : init_cache;
+      arm_hier_[n] = can_toggle_hier ? (h == 0 ? init_hier : !init_hier)
+                                     : init_hier;
+      n++;
+    }
+  }
+  arm_count_ = n;
+  cur_cache_ = init_cache;
+  cur_hier_ = init_hier;
+  // With fewer than arms+warmup samples budgeted (or nothing to sweep),
+  // skip the arm phase and tune numerics only under the initial config.
+  if (arm_count_ < 2 || max_samples_ < arm_count_ + 3) arm_idx_ = arm_count_;
   if (!log_path.empty()) {
     log_ = fopen(log_path.c_str(), "w");
     if (log_)
-      fprintf(log_, "sample,fusion_kb,cycle_ms,score_mbps\n");
+      fprintf(log_, "sample,fusion_kb,cycle_ms,cache,hier,score_mbps\n");
   }
   // First sample point = warmup[0]; adopted on the first Record proposal.
   memcpy(cur_x_, kWarmup[0], sizeof(cur_x_));
@@ -151,7 +174,8 @@ void ParameterManager::Propose(double out[2]) {
 }
 
 bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
-                              double* cycle_ms) {
+                              double* cycle_ms, int* cache_on,
+                              int* hier_on) {
   if (!active()) return false;
   if (bytes <= 0 && acc_cycles_ == 0) {
     // Idle before the window opens: keep re-stamping the start so a pause
@@ -162,8 +186,11 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
   }
   if (window_start_us_ < 0) {
     window_start_us_ = now_us;
-    // Adopt the first sample point right away.
+    // Adopt the first sample point (arm 0 = the job's initial categorical
+    // config, numeric point = warmup[0]) right away.
     ToParams(cur_x_, fusion, cycle_ms);
+    *cache_on = cur_cache_ ? 1 : 0;
+    *hier_on = cur_hier_ ? 1 : 0;
     warmup_idx_ = 1;
     return true;
   }
@@ -177,39 +204,74 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
 
   double secs = (now_us - window_start_us_) / 1e6;
   double score = secs > 0 ? (double)acc_bytes_ / secs : 0.0;
-  xs_.push_back({cur_x_[0], cur_x_[1]});
-  ys_.push_back(score);
-  if (score > best_score_) {
-    best_score_ = score;
-    ToParams(cur_x_, &best_fusion_, &best_cycle_ms_);
-  }
+  n_samples_++;
   if (log_) {
     int64_t f;
     double c;
     ToParams(cur_x_, &f, &c);
-    fprintf(log_, "%zu,%.1f,%.3f,%.3f\n", xs_.size(), f / 1024.0, c,
+    fprintf(log_, "%lld,%.1f,%.3f,%d,%d,%.3f\n", (long long)n_samples_,
+            f / 1024.0, c, cur_cache_ ? 1 : 0, cur_hier_ ? 1 : 0,
             score / 1e6);
     fflush(log_);
   }
-
+  if (score > best_score_) {
+    best_score_ = score;
+    ToParams(cur_x_, &best_fusion_, &best_cycle_ms_);
+  }
   acc_bytes_ = 0;
   acc_cycles_ = 0;
   window_start_us_ = now_us;
 
-  if ((int64_t)xs_.size() >= max_samples_) {
-    // Search done: lock in the best observed point.
+  bool budget_done = n_samples_ >= max_samples_;
+  if (arm_idx_ < arm_count_ && !budget_done) {
+    // Categorical phase: score this arm, move to the next (numeric point
+    // pinned at warmup[0] so arm scores are comparable), or lock the
+    // winner and hand over to the numeric search.
+    arm_score_[arm_idx_] = score;
+    arm_idx_++;
+    if (arm_idx_ < arm_count_) {
+      cur_cache_ = arm_cache_[arm_idx_];
+      cur_hier_ = arm_hier_[arm_idx_];
+    } else {
+      best_arm_ = 0;
+      for (int i = 1; i < arm_count_; i++)
+        if (arm_score_[i] > arm_score_[best_arm_]) best_arm_ = i;
+      cur_cache_ = arm_cache_[best_arm_];
+      cur_hier_ = arm_hier_[best_arm_];
+      // Seed the GP with the winning arm's observation at warmup[0]: the
+      // numeric phase continues from warmup[1] under the locked arm.
+      xs_.push_back({cur_x_[0], cur_x_[1]});
+      ys_.push_back(arm_score_[best_arm_]);
+      Propose(cur_x_);  // advance to warmup[1]
+    }
+    ToParams(cur_x_, fusion, cycle_ms);
+    *cache_on = cur_cache_ ? 1 : 0;
+    *hier_on = cur_hier_ ? 1 : 0;
+    return true;
+  }
+
+  xs_.push_back({cur_x_[0], cur_x_[1]});
+  ys_.push_back(score);
+
+  if (budget_done) {
+    // Search done: lock in the best observed point under the locked arm.
     done_ = true;
     *fusion = best_fusion_;
     *cycle_ms = best_cycle_ms_;
+    *cache_on = cur_cache_ ? 1 : 0;
+    *hier_on = cur_hier_ ? 1 : 0;
     if (log_) {
-      fprintf(log_, "# final,%.1f,%.3f,%.3f\n", best_fusion_ / 1024.0,
-              best_cycle_ms_, best_score_ / 1e6);
+      fprintf(log_, "# final,%.1f,%.3f,%d,%d,%.3f\n",
+              best_fusion_ / 1024.0, best_cycle_ms_, cur_cache_ ? 1 : 0,
+              cur_hier_ ? 1 : 0, best_score_ / 1e6);
       fflush(log_);
     }
     return true;
   }
   Propose(cur_x_);
   ToParams(cur_x_, fusion, cycle_ms);
+  *cache_on = cur_cache_ ? 1 : 0;
+  *hier_on = cur_hier_ ? 1 : 0;
   return true;
 }
 

@@ -343,6 +343,11 @@ struct ResponseList {
   // coordinator broadcast).
   int64_t tuned_fusion = -1;
   double tuned_cycle_ms = -1.0;
+  // Categorical arms (reference: parameter_manager.cc also tunes the
+  // response cache and hierarchical-allreduce toggles): -1 = unchanged,
+  // 0/1 = every rank flips the feature on this cycle.
+  int8_t tuned_cache = -1;
+  int8_t tuned_hier = -1;
   bool tuned_locked = false;  // coordinator's search finished
 
   void serialize(Writer& w) const {
@@ -354,6 +359,8 @@ struct ResponseList {
     w.u32vec(evict_bits);
     w.i64(tuned_fusion);
     w.f64(tuned_cycle_ms);
+    w.u8((uint8_t)(tuned_cache + 1));  // -1..1 -> 0..2
+    w.u8((uint8_t)(tuned_hier + 1));
     w.u8(tuned_locked ? 1 : 0);
   }
   static ResponseList deserialize(Reader& r) {
@@ -368,6 +375,8 @@ struct ResponseList {
     l.evict_bits = r.u32vec();
     l.tuned_fusion = r.i64();
     l.tuned_cycle_ms = r.f64();
+    l.tuned_cache = (int8_t)r.u8() - 1;
+    l.tuned_hier = (int8_t)r.u8() - 1;
     l.tuned_locked = r.u8() != 0;
     return l;
   }

@@ -102,6 +102,10 @@ struct Global {
   std::map<uint32_t, std::pair<int32_t, std::string>> local_bits;
   std::atomic<int64_t> cache_hits_total{0};
   std::atomic<int64_t> cache_misses_total{0};
+  // Autotune's cache arm: bypass (don't consult/fill) the cache without
+  // touching its lockstep replica state, so re-enabling is cheap and every
+  // rank flips on the same cycle (the toggle rides the ResponseList).
+  bool cache_bypass = false;
 
   // Autotune (reference: parameter_manager.cc). Coordinator-only state;
   // proposals reach other ranks via ResponseList.tuned_*.
@@ -573,8 +577,10 @@ bool CacheableOp(OpType t) {
 
 // Replace cache-known requests with bit positions before uplink. Called on
 // every rank (including 0, whose list feeds the coordinator directly).
+bool CacheOn() { return g->cache.enabled() && !g->cache_bypass; }
+
 void CacheFilterRequests(RequestList& mine) {
-  if (!g->cache.enabled()) return;
+  if (!CacheOn()) return;
   std::vector<Request> keep;
   for (auto& q : mine.requests) {
     uint32_t pos = 0;
@@ -639,9 +645,13 @@ void AutotuneCycle(ResponseList& rl) {
   if (g->autotune.active()) {
     int64_t fusion;
     double cycle_ms;
-    if (g->autotune.Record(PayloadBytes(rl), NowUs(), &fusion, &cycle_ms)) {
+    int cache_on, hier_on;
+    if (g->autotune.Record(PayloadBytes(rl), NowUs(), &fusion, &cycle_ms,
+                           &cache_on, &hier_on)) {
       rl.tuned_fusion = fusion;
       rl.tuned_cycle_ms = cycle_ms;
+      rl.tuned_cache = (int8_t)cache_on;
+      rl.tuned_hier = (int8_t)hier_on;
     }
   }
   rl.tuned_locked = !g->autotune.active();
@@ -655,8 +665,9 @@ void ProcessResponseList(ResponseList& rl) {
     g->coordinator.set_fusion_threshold(rl.tuned_fusion);
   }
   if (rl.tuned_cycle_ms > 0) g->cycle_time_ms = rl.tuned_cycle_ms;
+  if (rl.tuned_hier >= 0) g->hierarchical = rl.tuned_hier != 0;
   if (rl.tuned_locked && g->autotune.enabled()) g->autotune.SetDone();
-  if (g->cache.enabled()) {
+  if (CacheOn()) {
     for (uint32_t b : rl.evict_bits) {
       RepostIfSignaling(b);
       g->cache.Evict(b);
@@ -677,7 +688,7 @@ void ProcessResponseList(ResponseList& rl) {
     // resp.grouped: group members never enter the cache (see
     // CacheFilterRequests) — the flag rides the wire so every replica,
     // including joined ranks with no local Request, skips identically.
-    if (g->cache.enabled() && CacheableOp(resp.op_type) &&
+    if (CacheOn() && CacheableOp(resp.op_type) &&
         resp.error.empty() && !resp.grouped) {
       for (size_t i = 0; i < resp.names.size(); i++) {
         Response sub = SubResponse(resp, i);
@@ -688,6 +699,20 @@ void ProcessResponseList(ResponseList& rl) {
       }
     }
     PerformOperation(resp);
+  }
+  // The cache arm toggles LAST: this cycle's hits/inserts ran under the
+  // state they were negotiated with (a toggle suppressing its own cycle's
+  // hit expansions would strand those tensors); the new state governs the
+  // next cycle's filtering, identically on every rank.
+  if (rl.tuned_cache >= 0) {
+    bool want_bypass = rl.tuned_cache == 0;
+    if (want_bypass && !g->cache_bypass) {
+      // Any tensor still bit-signaling must fall back to full negotiation.
+      std::vector<uint32_t> pending;
+      for (auto& kv : g->local_bits) pending.push_back(kv.first);
+      for (uint32_t b : pending) RepostIfSignaling(b);
+    }
+    g->cache_bypass = want_bypass;
   }
 }
 
@@ -1001,16 +1026,23 @@ int hvd_init() {
     g->cache.Configure(EnvInt("HVD_CACHE_CAPACITY", 1024));
     g->coordinator.Init(g->size, g->fusion_threshold, &g->process_sets,
                         &g->cache);
+    g->coordinator.stall().Configure(
+        EnvDouble("HVD_STALL_CHECK_TIME_SECONDS", 60.0),
+        EnvDouble("HVD_STALL_SHUTDOWN_TIME_SECONDS", -1.0));
+    if (g->size > 1) EstablishMesh();
+    // After EstablishMesh: the categorical arms must know which toggles
+    // can actually take effect — a cache arm with capacity 0 or a
+    // hierarchical arm on a non-uniform topology would burn sample
+    // windows measuring (and logging) a configuration that never engaged.
     g->autotune.Configure(
         EnvInt("HVD_AUTOTUNE", 0) != 0,
         g->rank == 0 ? EnvStr("HVD_AUTOTUNE_LOG", "") : "",
         g->fusion_threshold, g->cycle_time_ms,
         EnvInt("HVD_AUTOTUNE_CYCLES_PER_SAMPLE", 20),
-        EnvInt("HVD_AUTOTUNE_MAX_SAMPLES", 30));
-    g->coordinator.stall().Configure(
-        EnvDouble("HVD_STALL_CHECK_TIME_SECONDS", 60.0),
-        EnvDouble("HVD_STALL_SHUTDOWN_TIME_SECONDS", -1.0));
-    if (g->size > 1) EstablishMesh();
+        EnvInt("HVD_AUTOTUNE_MAX_SAMPLES", 30),
+        g->cache.enabled(), g->hierarchical,
+        /*can_toggle_cache=*/g->cache.enabled(),
+        /*can_toggle_hier=*/g->hier_ok && g->size > 1);
     g->data.set_timeout_ms(
         (int)(EnvDouble("HVD_DATA_TIMEOUT_SECONDS", 300.0) * 1000.0));
     LogF(LogLevel::kInfo,

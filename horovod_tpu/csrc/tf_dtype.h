@@ -1,0 +1,31 @@
+// tf_dtype.h — shared TF DataType -> core dtype-code map for the native
+// TF op libraries (tf_ops.cc eager/graph kernels, tf_xla_ops.cc XLA
+// kernels). One table instead of per-file copies: the codes MUST match
+// horovod_tpu/ops/collective_ops.py _DT_MAP, and a skew between two
+// compiled-together files would reinterpret wire buffers as the wrong
+// dtype. (torch_ops.cc keeps its own table — it maps at::ScalarType,
+// a different type system, and builds against torch headers only.)
+#pragma once
+
+#include "tensorflow/core/framework/types.pb.h"
+
+namespace hvd_tf {
+
+constexpr int kMaxDims = 8;
+
+inline int DtypeCode(::tensorflow::DataType dt) {
+  switch (dt) {
+    case ::tensorflow::DT_UINT8: return 0;
+    case ::tensorflow::DT_INT8: return 1;
+    case ::tensorflow::DT_INT32: return 2;
+    case ::tensorflow::DT_INT64: return 3;
+    case ::tensorflow::DT_HALF: return 4;
+    case ::tensorflow::DT_FLOAT: return 5;
+    case ::tensorflow::DT_DOUBLE: return 6;
+    case ::tensorflow::DT_BOOL: return 7;
+    case ::tensorflow::DT_BFLOAT16: return 8;
+    default: return -1;
+  }
+}
+
+}  // namespace hvd_tf

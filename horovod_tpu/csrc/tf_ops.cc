@@ -20,6 +20,8 @@
 #include "tensorflow/core/framework/op_kernel.h"
 #include "tensorflow/core/framework/shape_inference.h"
 
+#include "tf_dtype.h"
+
 // C API of libhvd_tpu.so (signatures mirror horovod_tpu/basics.py).
 extern "C" {
 int hvd_allreduce_async(const char* name, const void* in, void* out,
@@ -60,23 +62,8 @@ using ::tensorflow::Tensor;
 using ::tensorflow::TensorShape;
 using ::tensorflow::errors::Internal;
 
-int DtypeCode(DataType dt) {
-  // Must match horovod_tpu/ops/collective_ops.py _DT_MAP.
-  switch (dt) {
-    case ::tensorflow::DT_UINT8: return 0;
-    case ::tensorflow::DT_INT8: return 1;
-    case ::tensorflow::DT_INT32: return 2;
-    case ::tensorflow::DT_INT64: return 3;
-    case ::tensorflow::DT_HALF: return 4;
-    case ::tensorflow::DT_FLOAT: return 5;
-    case ::tensorflow::DT_DOUBLE: return 6;
-    case ::tensorflow::DT_BOOL: return 7;
-    case ::tensorflow::DT_BFLOAT16: return 8;
-    default: return -1;
-  }
-}
-
-constexpr int kMaxDims = 8;
+using ::hvd_tf::DtypeCode;
+using ::hvd_tf::kMaxDims;
 
 bool ShapeOf(const Tensor& t, long long* dims, int* ndim) {
   if (t.dims() > kMaxDims) return false;

@@ -16,8 +16,11 @@
 #include <torch/extension.h>
 
 #include <cstring>
+#include <mutex>
 #include <stdexcept>
 #include <string>
+#include <unordered_map>
+#include <utility>
 #include <vector>
 
 extern "C" {
@@ -104,6 +107,92 @@ void ShapeOf(const at::Tensor& t, long long* dims, int* ndim) {
                            (e && *e ? e : "unknown"));
 }
 
+// Handles whose collective ran on a staging ("wire") buffer — a cast
+// (fp16/bf16 compression) or a contiguous copy of a strided tensor.
+// Wait() copies the wire result back into the user's tensor (aten copy_
+// restores dtype and strides). Mirrors mpi_ops_v2.cc's adapter keeping
+// the compressed buffer alive until WaitAndClear.
+struct WireEntry {
+  at::Tensor wire;
+  at::Tensor target;
+};
+std::mutex g_wire_mu;
+std::unordered_map<int, WireEntry> g_wire;
+
+void StashWire(int handle, at::Tensor wire, at::Tensor target) {
+  std::lock_guard<std::mutex> lk(g_wire_mu);
+  g_wire[handle] = WireEntry{std::move(wire), std::move(target)};
+}
+
+// Grouped (and/or compressed) in-place allreduce: one crossing for N
+// tensors, negotiated as ONE atomic group (reference:
+// horovod_torch_grouped_allreduce_async_ in mpi_ops_v2.cc).
+// wire_dtype >= 0 casts float32/float64 payloads to that dtype on the
+// wire (fp16/bf16 compression); group_id < 0 submits ungrouped (the
+// single-tensor compressed path reuses this entry point with one
+// element).
+std::vector<int> GroupedAllreduceAsync_(std::vector<at::Tensor> tensors,
+                                        const std::string& base_name,
+                                        int red_op, double prescale,
+                                        double postscale, int process_set,
+                                        int group_id, int wire_dtype) {
+  int n = (int)tensors.size();
+  // Even a single-member group keeps the (gid, 1) + ".0" form: the numpy
+  // bridge submits that shape, and a mixed native/bridge job must
+  // negotiate identical names (a native rank submitting the bare name
+  // ungrouped would never match and the collective would stall).
+  int gid = group_id;
+  int gsize = n;
+  // Validate and stage EVERY member before enqueueing ANY: once a member
+  // is in the core with group size n, peers wait for all n — a local
+  // validation error mid-loop would strand them.
+  std::vector<at::Tensor> wires;
+  wires.reserve(n);
+  for (int i = 0; i < n; ++i) {
+    at::Tensor t = tensors[i];
+    TORCH_CHECK(t.device().is_cpu(),
+                "horovod_tpu native torch ops take CPU tensors");
+    TORCH_CHECK(t.dim() >= 1 && t.dim() <= kMaxDims,
+                "grouped allreduce takes 1..8-dim tensors");
+    bool cast = wire_dtype >= 0 &&
+                (t.scalar_type() == at::kFloat ||
+                 t.scalar_type() == at::kDouble) &&
+                TypeFromCode(wire_dtype) != t.scalar_type();
+    at::Tensor wire = t;
+    if (cast) {
+      wire = t.to(TypeFromCode(wire_dtype)).contiguous();
+    } else if (!t.is_contiguous()) {
+      wire = t.contiguous();
+    }
+    wires.push_back(std::move(wire));
+  }
+  std::vector<int> handles;
+  handles.reserve(n);
+  for (int i = 0; i < n; ++i) {
+    at::Tensor& wire = wires[i];
+    long long dims[kMaxDims];
+    int ndim;
+    ShapeOf(wire, dims, &ndim);
+    std::string name = base_name + "." + std::to_string(i);
+    int h = hvd_allreduce_async(name.c_str(), wire.data_ptr(),
+                                wire.data_ptr(), dims, ndim,
+                                DtypeCode(wire), red_op, prescale,
+                                postscale, process_set, gid, gsize);
+    if (h < 0) {
+      // A mid-group core rejection is fatal to the job (peers already
+      // committed to an n-member group). Already-enqueued members keep
+      // their wire pins — the background thread still holds their data
+      // pointers, so freeing them here would be a use-after-free; the
+      // raised error tears the job down through the usual path.
+      Fail("grouped allreduce enqueue failed");
+    }
+    if (wire.data_ptr() != tensors[i].data_ptr())
+      StashWire(h, wire, tensors[i]);
+    handles.push_back(h);
+  }
+  return handles;
+}
+
 int AllreduceAsync(at::Tensor input, at::Tensor output,
                    const std::string& name, int red_op, double prescale,
                    double postscale, int process_set) {
@@ -181,6 +270,17 @@ void Wait(int handle) {
     pybind11::gil_scoped_release release;
     rc = hvd_wait(handle);
   }
+  WireEntry entry;
+  bool staged = false;
+  {
+    std::lock_guard<std::mutex> lk(g_wire_mu);
+    auto it = g_wire.find(handle);
+    if (it != g_wire.end()) {
+      entry = std::move(it->second);
+      staged = true;
+      g_wire.erase(it);
+    }
+  }
   if (rc != 1) {
     // Raw core message: the Python layer classifies it the same way the
     // bridge does (HorovodInternalError/shutdown → elastic signal;
@@ -189,11 +289,22 @@ void Wait(int handle) {
     hvd_release(handle);
     throw std::runtime_error(e && *e ? e : "collective failed");
   }
+  if (staged) {
+    // Decompress / restore strides: copy_ casts the wire dtype back and
+    // scatters into the (possibly non-contiguous) user tensor.
+    entry.target.copy_(entry.wire.reshape(entry.target.sizes()));
+  }
 }
 
 bool Poll(int handle) { return hvd_poll(handle) != 0; }
 
-void Release(int handle) { hvd_release(handle); }
+void Release(int handle) {
+  {
+    std::lock_guard<std::mutex> lk(g_wire_mu);
+    g_wire.erase(handle);
+  }
+  hvd_release(handle);
+}
 
 at::Tensor Result(int handle, int dtype_code) {
   // Core-owned output (allgather/alltoall/reducescatter) → fresh tensor.
@@ -219,6 +330,7 @@ std::vector<long long> RecvSplits(int handle) {
 
 PYBIND11_MODULE(TORCH_EXTENSION_NAME, m) {
   m.def("allreduce_async", &AllreduceAsync);
+  m.def("grouped_allreduce_async_", &GroupedAllreduceAsync_);
   m.def("allgather_async", &AllgatherAsync);
   m.def("broadcast_async_", &BroadcastAsync);
   m.def("alltoall_async", &AlltoallAsync);

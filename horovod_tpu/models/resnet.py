@@ -21,6 +21,46 @@ import jax.numpy as jnp
 ModuleDef = Any
 
 
+class PallasBatchNorm(nn.Module):
+    """BatchNorm whose train-mode reductions run as one-pass pallas
+    kernels (ops/pallas_norm.py — see PERF.md round 4: the BN stats
+    reductions, not the convs, dominate the ResNet step). Same parameter
+    and batch_stats structure as nn.BatchNorm."""
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scale_init: Any = nn.initializers.ones
+
+    @nn.compact
+    def __call__(self, x):
+        from ..ops.pallas_norm import batch_norm_train
+
+        C = x.shape[-1]
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros(C, jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones(C, jnp.float32))
+        scale = self.param("scale", self.scale_init, (C,), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, (C,),
+                          self.param_dtype)
+        if self.use_running_average:
+            inv = scale * jax.lax.rsqrt(ra_var.value + self.epsilon)
+            a = inv.astype(self.dtype)
+            b = (bias - ra_mean.value * inv).astype(self.dtype)
+            return x.astype(self.dtype) * a + b
+        interpret = jax.default_backend() != "tpu"
+        y, mean, var = batch_norm_train(x.astype(self.dtype), scale, bias,
+                                        self.epsilon, interpret)
+        if not self.is_initializing():
+            ra_mean.value = (self.momentum * ra_mean.value
+                             + (1 - self.momentum) * mean)
+            ra_var.value = (self.momentum * ra_var.value
+                            + (1 - self.momentum) * var)
+        return y
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: int
@@ -59,12 +99,17 @@ class ResNet(nn.Module):
     # weight transform); on TPU it quadruples the stem's MXU lane utilization
     # (C_in 3 -> 12 against 128 lanes), worth ~8% end-to-end at batch 128.
     stem: str = "classic"
+    # "flax": nn.BatchNorm. "pallas": PallasBatchNorm — train-mode stats
+    # reductions as one-pass pallas kernels (the step-time bottleneck, see
+    # PERF.md round 4).
+    norm: str = "flax"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
                        param_dtype=jnp.float32)
-        norm = partial(nn.BatchNorm, use_running_average=not train,
+        norm_cls = PallasBatchNorm if self.norm == "pallas" else nn.BatchNorm
+        norm = partial(norm_cls, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype,
                        param_dtype=jnp.float32)
         x = x.astype(self.dtype)
@@ -100,21 +145,23 @@ class ResNet(nn.Module):
 
 
 def ResNet50(num_classes: int = 1000, dtype=jnp.bfloat16,
-             stem: str = "classic") -> ResNet:
+             stem: str = "classic", norm: str = "flax") -> ResNet:
     return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes,
-                  dtype=dtype, stem=stem)
+                  dtype=dtype, stem=stem, norm=norm)
 
 
 def ResNet101(num_classes: int = 1000, dtype=jnp.bfloat16,
-              stem: str = "classic") -> ResNet:
+              stem: str = "classic", norm: str = "flax") -> ResNet:
     return ResNet(stage_sizes=(3, 4, 23, 3), num_classes=num_classes,
-                  dtype=dtype, stem=stem)
+                  dtype=dtype, stem=stem, norm=norm)
 
 
 def create_train_state(rng, image_size: int = 224, num_classes: int = 1000,
-                       dtype=jnp.bfloat16, model=None, stem: str = "classic"):
+                       dtype=jnp.bfloat16, model=None, stem: str = "classic",
+                       norm: str = "flax"):
     """Init params/batch_stats on a dummy batch. Returns (model, variables)."""
-    model = model or ResNet50(num_classes=num_classes, dtype=dtype, stem=stem)
+    model = model or ResNet50(num_classes=num_classes, dtype=dtype, stem=stem,
+                              norm=norm)
     dummy = jnp.ones((1, image_size, image_size, 3), jnp.float32)
     variables = jax.jit(partial(model.init, train=False))(rng, dummy)
     return model, variables

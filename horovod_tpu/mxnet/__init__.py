@@ -9,9 +9,10 @@ each update, ``DistributedTrainer`` doing the same for Gluon, and
 NDArrays live on CUDA streams; here (as with the torch binding) MXNet is a
 host-memory frontend to the same native core, bridged via numpy views.
 
-MXNet is NOT installed in this build's environment (see README descope
-note): the binding is complete and exercised for import/surface behavior,
-but its end-to-end tests gate on ``pytest.importorskip("mxnet")``.
+Real MXNet is NOT installed in this build's environment (upstream is
+archived; see README descope note); the binding's full surface executes
+end-to-end in CI against the numpy-backed conformance shim in
+``tests/shims/mxnet`` (``tests/workers/mxnet_worker.py``).
 """
 
 try:

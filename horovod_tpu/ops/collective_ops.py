@@ -192,13 +192,21 @@ def allreduce(tensor, op=Average, name=None, prescale_factor=1.0,
                                        postscale_factor, process_set))
 
 
+def alloc_group_id():
+    """Allocate a process-unique atomic-group id. Shared by the bridge and
+    the native torch extension so mixed submissions can't collide on the
+    core's (gid, size) group table."""
+    with _lock:
+        gid = _group_counter[0]
+        _group_counter[0] += 1
+    return gid
+
+
 def _grouped(kind, name, tensors, enqueue_one):
     """Shared atomic-group fan-out: allocate one group id, derive member
     names, enqueue each tensor with (gid, len). `enqueue_one(t, name,
     group)` does the per-op enqueue."""
-    with _lock:
-        gid = _group_counter[0]
-        _group_counter[0] += 1
+    gid = alloc_group_id()
     base = _auto_name(kind, name)
     group = (gid, len(tensors))
     return [enqueue_one(t, f"{base}.{i}", group)

@@ -73,9 +73,55 @@ def broadcast(x, axis, root_index=0):
 def alltoall(x, axis, split_axis=0, concat_axis=0):
     """MoE dispatch primitive (reference: hvd.alltoall): scatter dim
     `split_axis` across the axis, concatenate received blocks on
-    `concat_axis`. Rides ICI as a single XLA AllToAll."""
+    `concat_axis`. Rides ICI as a single XLA AllToAll. Even splits only —
+    uneven (alltoallv) exchanges go through :func:`ragged_alltoall`."""
     return lax.all_to_all(x, axis, split_axis=split_axis,
                           concat_axis=concat_axis, tiled=True)
+
+
+def ragged_alltoall(x, send_counts, axis, capacity):
+    """Uneven alltoall on ICI (reference: hvd.alltoall with `splits` —
+    MPIAlltoall's alltoallv — rebuilt for XLA's static shapes).
+
+    Real MoE routing is ragged: each shard sends a DIFFERENT number of
+    rows to each peer. XLA cannot ship dynamic shapes over ICI, so the
+    v-semantics ride a dense exchange: each destination's rows are packed
+    into a fixed ``capacity``-row slot (gather by index — static shapes,
+    no dynamic scatter), exchanged with ONE XLA AllToAll, and returned
+    padded with a validity count per source. Rows past ``capacity`` are
+    dropped — the same contract as capacity-factor MoE dispatch
+    (parallel/expert_parallel.py); pick ``capacity`` from the expected
+    imbalance (T gives lossless-but-dense).
+
+    Args (inside shard_map over ``axis``):
+      x: [T, ...] rows grouped by destination, peer j's block first.
+      send_counts: [P] int32, rows destined to each peer
+        (sum <= T; trailing rows beyond the sum are ignored).
+      capacity: static max rows per (src, dst) pair.
+
+    Returns (recv [P, capacity, ...], recv_counts [P]): block i holds the
+    first ``recv_counts[i]`` valid rows sent by peer i; padding rows are
+    zero.
+    """
+    P = lax.psum(1, axis)
+    T = x.shape[0]
+    send_counts = send_counts.astype(jnp.int32)
+    # Exclusive prefix: where each destination's block starts in x.
+    starts = jnp.cumsum(send_counts) - send_counts              # [P]
+    slot = jnp.arange(capacity, dtype=jnp.int32)                # [C]
+    idx = starts[:, None] + slot[None, :]                       # [P, C]
+    valid = slot[None, :] < send_counts[:, None]                # [P, C]
+    idx = jnp.clip(idx, 0, max(T - 1, 0))
+    buf = jnp.take(x, idx, axis=0)                              # [P, C, ...]
+    vshape = (P, capacity) + (1,) * (x.ndim - 1)
+    buf = jnp.where(valid.reshape(vshape), buf, 0)
+    # Dense exchange: slot j of every shard goes to peer j; arrives
+    # stacked by source rank.
+    recv = lax.all_to_all(buf, axis, split_axis=0, concat_axis=0,
+                          tiled=True)
+    recv_counts = lax.all_to_all(send_counts, axis, split_axis=0,
+                                 concat_axis=0, tiled=True)     # [P]
+    return recv, recv_counts
 
 
 def reducescatter(x, axis, op=Average):

@@ -20,4 +20,5 @@ from .ulysses import make_ulysses_attention, ulysses_attention  # noqa: F401
 from .expert_parallel import (  # noqa: F401
     make_moe_layer,
     moe_dispatch_combine,
+    moe_dispatch_combine_ragged,
 )

@@ -74,6 +74,105 @@ def moe_dispatch_combine(x, logits, expert_fn, axis, capacity_factor=1.25,
     return y.astype(x.dtype), aux
 
 
+def moe_dispatch_combine_ragged(x, logits, expert_fn, axis,
+                                capacity_factor=1.25, peer_capacity=None,
+                                expert_capacity=None):
+    """Top-1 MoE layer whose dispatch is RAGGED on the wire (VERDICT r3
+    #7; reference: MPIAlltoall's alltoallv splits, rebuilt for ICI).
+
+    :func:`moe_dispatch_combine` ships dense [E, C, D] buffers — every
+    expert slot crosses ICI whether routed or not. Here each shard packs
+    only the tokens actually routed to each peer (sorted by destination,
+    gathered into a [P, peer_capacity, D] slot buffer via
+    ops.jax_ops.ragged_alltoall), so wire bytes follow the REAL routing
+    distribution; the per-expert grouping happens after the exchange,
+    locally. Tokens beyond ``peer_capacity`` (per destination shard) or
+    ``expert_capacity`` (per local expert queue) are dropped — their
+    output is zero, standard Switch semantics.
+
+    Same contract as moe_dispatch_combine: call inside shard_map over
+    ``axis`` with x [T, D], logits [T, E]; expert_fn maps
+    [E_loc, N, D] -> [E_loc, N, D]. Returns (out [T, D], aux).
+    """
+    from ..ops.jax_ops import ragged_alltoall
+
+    P = lax.psum(1, axis)
+    T, D = x.shape
+    E = logits.shape[-1]
+    if E % P != 0:
+        raise ValueError(f"{E} experts not divisible by axis size {P}")
+    E_loc = E // P
+    cap = peer_capacity or max(1, int(T * capacity_factor / P))
+    C2 = expert_capacity or max(1, int(P * cap * capacity_factor / E_loc))
+
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                     # [T]
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], 1)[:, 0]
+    dest = expert_idx // E_loc                                  # [T] shard
+    local_e = expert_idx % E_loc                                # [T]
+
+    # sort tokens by destination shard → contiguous per-peer blocks
+    order = jnp.argsort(dest)
+    inv = jnp.argsort(order)
+    xs = jnp.take(x, order, axis=0)
+    le_s = jnp.take(local_e, order)
+    dest_s = jnp.take(dest, order)
+    send_counts = jnp.sum(jax.nn.one_hot(dest, P, dtype=jnp.int32), 0)
+    starts = jnp.cumsum(send_counts) - send_counts
+    pos_in_block = jnp.arange(T, dtype=jnp.int32) - starts[dest_s]
+    sent = pos_in_block < cap                                   # [T] sorted
+
+    recv_x, recv_counts = ragged_alltoall(xs, send_counts, axis, cap)
+    recv_le, _ = ragged_alltoall(le_s, send_counts, axis, cap)
+
+    # local per-expert packing of the received rows (no wire cost)
+    N = P * cap
+    rows = recv_x.reshape(N, D).astype(jnp.float32)
+    le = recv_le.reshape(N)
+    slot = jnp.arange(cap, dtype=jnp.int32)
+    rvalid = (slot[None, :] < recv_counts[:, None]).reshape(N)
+    le_oh = jax.nn.one_hot(le, E_loc, dtype=jnp.float32) \
+        * rvalid[:, None].astype(jnp.float32)                   # [N, E_loc]
+    pos = (jnp.cumsum(le_oh, axis=0) - 1.0) * le_oh
+    keep = (pos < C2).astype(jnp.float32) * le_oh
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C2,
+                            dtype=jnp.float32) * keep[..., None]
+    expert_in = jnp.einsum("nd,nec->ecd", rows, pos_oh).astype(x.dtype)
+    out = expert_fn(expert_in)                                  # [E_loc,C2,D]
+    if out.shape != expert_in.shape:
+        raise ValueError(
+            f"expert_fn changed shape {expert_in.shape}->{out.shape}")
+    rows_out = jnp.einsum("ecd,nec->nd", out.astype(jnp.float32), pos_oh)
+
+    # return trip: slot layout is already [P, cap, D] grouped by source —
+    # a plain tiled AllToAll routes every block straight back
+    back = lax.all_to_all(rows_out.reshape(P, cap, D).astype(x.dtype),
+                          axis, split_axis=0, concat_axis=0, tiled=True)
+    flat = back.reshape(N, D)
+    y_s = jnp.take(flat,
+                   dest_s * cap + jnp.clip(pos_in_block, 0, cap - 1),
+                   axis=0)
+    y_s = y_s * sent[:, None].astype(x.dtype)
+    y = jnp.take(y_s, inv, axis=0) * gate[:, None].astype(x.dtype)
+
+    mask = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)
+    frac_routed = lax.pmean(mask.mean(axis=0), axis)
+    mean_prob = lax.pmean(probs.mean(axis=0), axis)
+    # Survivors cleared BOTH capacity gates: sent past the peer slot AND
+    # queued within the local expert's C2 (keep counts the latter among
+    # received rows, so summing it globally counts end-to-end survivors —
+    # the dense sibling's keep-mask accounting).
+    survived = lax.psum(jnp.sum(keep), axis)
+    total = lax.psum(jnp.float32(T), axis)
+    aux = {
+        "load_balance_loss": E * jnp.sum(frac_routed * mean_prob),
+        "dropped_fraction": 1.0 - survived / total,
+        "peer_capacity": cap,
+        "expert_capacity": C2,
+    }
+    return y.astype(x.dtype), aux
+
+
 def make_moe_layer(mesh, axis, w_in, w_out, capacity_factor=1.25):
     """Convenience: build a jitted MoE FFN over `mesh`.
 

@@ -395,24 +395,14 @@ def _grouped_np(tensors, op, name, process_set, compression,
 def _xla_compression_cast(compression):
     """The tf dtype implementing `compression` as an in-graph cast, None
     for no compression, or ``...`` when the compressor has no in-graph
-    equivalent (custom subclass) and the XLA branch must not be taken."""
-    if compression is None:
-        return None
-    from ..compression import (BF16Compressor, FP16Compressor,
-                               NoneCompressor)
+    equivalent (custom subclass) and the XLA branch must not be taken.
+    Thin translation over the shared compression.wire_cast_dtype map."""
+    from ..compression import wire_cast_dtype
 
-    cls = compression if isinstance(compression, type) \
-        else type(compression)
-    tf = _tf()
-    # Exact-class match only: a SUBCLASS may override compress/decompress
-    # (e.g. error feedback) that a bare cast would silently skip.
-    if cls is FP16Compressor:
-        return tf.float16
-    if cls is BF16Compressor:
-        return tf.bfloat16
-    if cls is NoneCompressor:
-        return None
-    return ...
+    name = wire_cast_dtype(compression)
+    if name is None or name is ...:
+        return name
+    return _tf().as_dtype(name)
 
 
 def _xla_per_tensor(tensors, op, name, process_set, compression,

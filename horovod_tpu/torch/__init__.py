@@ -7,10 +7,11 @@ backward pass, `broadcast_parameters` / `broadcast_optimizer_state`,
 `SyncBatchNorm`. Like the reference, the collectives run through a native
 C++ torch extension (`csrc/torch_ops.cc`, JIT-built by
 :mod:`.native_ext` — the `mpi_ops_v2.cc` analog) that hands the core aten
-data pointers directly; unsupported cases (non-CPU/exotic dtypes,
-compression, the grouped hook plumbing) and environments without a
-toolchain fall back to the numpy bridge (`HVD_TORCH_NATIVE_OPS=0`
-forces it).
+data pointers directly, including grouped allreduce (one crossing per
+group) and fp16/bf16 compression (wire-buffer cast in the extension).
+The numpy bridge remains for non-CPU/exotic dtypes, custom compressors,
+and environments without a toolchain (`HVD_TORCH_NATIVE_OPS=0` forces
+it).
 """
 
 import numpy as np
@@ -187,6 +188,106 @@ def reducescatter(tensor, op=Average, name=None, process_set=0):
                             process_set=process_set)))
 
 
+def _wire_dtype_code(compression):
+    """Core dtype code for a compressor expressible as a wire cast inside
+    the native extension (fp16 → 4, bf16 → 8), -1 for no compression, or
+    None when the compressor is custom and must use the numpy bridge.
+    Thin translation over the shared compression.wire_cast_dtype map."""
+    from ..compression import wire_cast_dtype
+
+    name = wire_cast_dtype(compression)
+    if name is ...:
+        return None
+    if name is None:
+        return -1
+    return {"float16": 4, "bfloat16": 8}[name]
+
+
+def _native_grouped_for(tensors, compression=None):
+    """The native extension when the whole group can ride it: CPU tensors
+    of supported dtypes, >=1-dim, and a castable (or absent) compressor.
+    The extension itself handles non-contiguous tensors and the
+    compression cast via wire buffers (csrc/torch_ops.cc WireEntry)."""
+    if _wire_dtype_code(compression) is None:
+        return None
+    for t in tensors:
+        if (t.device.type != "cpu" or t.dtype not in _NATIVE_DTYPES
+                or t.dim() == 0):
+            return None
+    from . import native_ext
+
+    return native_ext.lib()
+
+
+def grouped_allreduce_async_(tensors, op=Average, name=None, process_set=0,
+                             prescale_factor=1.0, postscale_factor=1.0,
+                             compression=None):
+    """In-place atomic-group allreduce; synchronize() each returned handle
+    (reference: horovod_torch_grouped_allreduce_async_ in mpi_ops_v2.cc).
+    One C++ crossing enqueues the whole group; fp16/bf16 compression rides
+    wire buffers inside the extension."""
+    nat = _native_grouped_for(tensors, compression)
+    base = name or _core._auto_name("grouped_allreduce", None)
+    if nat is not None:
+        wire = _wire_dtype_code(compression)
+        # _f32: the native ext takes doubles; round like the bridge does
+        # so mixed native/bridge ranks submit bit-identical factors (the
+        # coordinator does not consistency-check prescale, and the
+        # response cache compares it exactly).
+        hs = nat.grouped_allreduce_async_(
+            list(tensors), base, int(op), _core._f32(prescale_factor),
+            _core._f32(postscale_factor), int(process_set),
+            _core.alloc_group_id(), wire)
+        return [TorchHandle(h, target=t, native=nat, keep=(t,))
+                for h, t in zip(hs, tensors)]
+    arrs = []
+    ctxs = []
+    for t in tensors:
+        a = _to_numpy(t)
+        if compression is not None:
+            a, c = compression.compress(a)
+        else:
+            c = None
+        arrs.append(a)
+        ctxs.append(c)
+    hs = _core.grouped_allreduce_async(
+        arrs, op=op, name=base, process_set=process_set,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor)
+    out = []
+    for h, t, c in zip(hs, tensors, ctxs):
+        th = TorchHandle(h, target=t)
+        th.kind = ("decompress", compression, c)
+        out.append(th)
+    return out
+
+
+def grouped_allreduce_(tensors, **kw):
+    hs = grouped_allreduce_async_(tensors, **kw)
+    return [synchronize(h) for h in hs]
+
+
+def grouped_allreduce(tensors, op=Average, name=None, process_set=0,
+                      compression=None):
+    """Out-of-place grouped allreduce (reference: hvd.grouped_allreduce)."""
+    outs = [t.detach().clone() for t in tensors]
+    return grouped_allreduce_(outs, op=op, name=name,
+                              process_set=process_set,
+                              compression=compression)
+
+
+def grouped_allgather(tensors, name=None, process_set=0):
+    outs = _core.grouped_allgather([_to_numpy(t) for t in tensors],
+                                   name=name, process_set=process_set)
+    return [torch.from_numpy(np.ascontiguousarray(o)) for o in outs]
+
+
+def grouped_reducescatter(tensors, op=Average, name=None, process_set=0):
+    outs = _core.grouped_reducescatter([_to_numpy(t) for t in tensors],
+                                       op=op, name=name,
+                                       process_set=process_set)
+    return [torch.from_numpy(np.ascontiguousarray(o)) for o in outs]
+
+
 def broadcast_object(obj, root_rank=0, name=None, process_set=0):
     return _core.broadcast_object(obj, root_rank=root_rank, name=name,
                                   process_set=process_set)
@@ -224,8 +325,9 @@ def allreduce_async(tensor, op=Average, name=None, process_set=0,
         out = torch.empty_like(x)
         h = nat.allreduce_async(x, out,
                                 name or _core._auto_name("allreduce", None),
-                                int(op), float(prescale_factor),
-                                float(postscale_factor), int(process_set))
+                                int(op), _core._f32(prescale_factor),
+                                _core._f32(postscale_factor),
+                                int(process_set))
         return TorchHandle(h, native=nat, out=out, keep=(x, out))
     return TorchHandle(_core.allreduce_async(
         _to_numpy(tensor), op=op, name=name, process_set=process_set,
@@ -245,8 +347,9 @@ def allreduce_async_(tensor, op=Average, name=None, process_set=0,
     if nat is not None:
         h = nat.allreduce_async(tensor, tensor,
                                 name or _core._auto_name("allreduce", None),
-                                int(op), float(prescale_factor),
-                                float(postscale_factor), int(process_set))
+                                int(op), _core._f32(prescale_factor),
+                                _core._f32(postscale_factor),
+                                int(process_set))
         return TorchHandle(h, target=tensor, native=nat, keep=(tensor,))
     return TorchHandle(_core.allreduce_async(
         _to_numpy(tensor), op=op, name=name, process_set=process_set,
@@ -313,12 +416,17 @@ def _native_synchronize(handle):
 
 def synchronize(handle):
     target = None
+    decomp = None
     if isinstance(handle, TorchHandle):
         if handle.native is not None:
             return _native_synchronize(handle)
         target = handle.target
+        if isinstance(handle.kind, tuple) and handle.kind[0] == "decompress":
+            decomp = handle.kind[1:]
         handle = handle.core
     out = _core.synchronize(handle)
+    if decomp is not None and decomp[0] is not None:
+        out = decomp[0].decompress(out, decomp[1])
     if target is not None:
         target.copy_(_from_numpy(out, target))
         return target
@@ -361,7 +469,7 @@ class _DistributedOptimizerMixin:
 
     def _hvd_init(self, named_parameters, op, compression,
                   backward_passes_per_step, process_set,
-                  gradient_predivide_factor=1.0):
+                  gradient_predivide_factor=1.0, num_groups=0):
         self._hvd_op = op
         self._hvd_compression = compression
         self._hvd_bpps = backward_passes_per_step
@@ -370,6 +478,9 @@ class _DistributedOptimizerMixin:
         _core.validate_predivide(op, self._hvd_predivide)
         self._hvd_step_count = 0
         self._hvd_handles = {}
+        # submission-path counters, observable by tests/users: the native
+        # extension must carry the hook path whenever it can
+        self._hvd_stats = {"native": 0, "bridge": 0}
         if named_parameters is not None:
             named = list(named_parameters)
         else:
@@ -377,10 +488,83 @@ class _DistributedOptimizerMixin:
                      for i, g in enumerate(self.param_groups)
                      for j, p in enumerate(g["params"])]
         self._hvd_names = {p: n for n, p in named}
-        for group in self.param_groups:
-            for p in group["params"]:
-                if p.requires_grad:
-                    p.register_post_accumulate_grad_hook(self._hvd_hook)
+        params = [p for group in self.param_groups
+                  for p in group["params"] if p.requires_grad]
+        # num_groups > 0: split params into that many contiguous chunks;
+        # a group's allreduces are submitted as ONE atomic group once every
+        # member's gradient arrived (reference: horovod/torch/optimizer.py
+        # num_groups / split_list).
+        self._hvd_num_groups = min(int(num_groups), len(params)) \
+            if num_groups else 0
+        self._hvd_group_of = {}
+        self._hvd_group_ready = {}
+        if self._hvd_num_groups > 0:
+            k, m = divmod(len(params), self._hvd_num_groups)
+            idx = 0
+            self._hvd_group_size = {}
+            for gi in range(self._hvd_num_groups):
+                n = k + (1 if gi < m else 0)
+                for p in params[idx:idx + n]:
+                    self._hvd_group_of[p] = gi
+                self._hvd_group_size[gi] = n
+                idx += n
+            self._hvd_group_ready = {gi: [] for gi
+                                     in range(self._hvd_num_groups)}
+        for p in params:
+            p.register_post_accumulate_grad_hook(self._hvd_hook)
+
+    def _hvd_submit_one(self, p, op, pre, post):
+        """Per-tensor submission (num_groups == 0)."""
+        name = f"allreduce.{self._hvd_names.get(p, id(p))}"
+        comp = self._hvd_compression
+        if comp is None:
+            # Hot path: in-place allreduce on the grad buffer via
+            # allreduce_async_ (native extension when available, bridge
+            # otherwise — both submit the SAME prescale, with the bpps
+            # local-accumulation average folded in).
+            h = allreduce_async_(
+                p.grad, op=op, name=name,
+                process_set=self._hvd_process_set,
+                prescale_factor=pre / self._hvd_bpps,
+                postscale_factor=post)
+            self._hvd_count(h)
+            self._hvd_handles[p] = h
+            return
+        if _wire_dtype_code(comp) is not None:
+            # fp16/bf16: single-member grouped entry point — the wire cast
+            # happens inside the native extension (csrc/torch_ops.cc),
+            # with the bridge's compress/decompress as fallback.
+            hs = grouped_allreduce_async_(
+                [p.grad], op=op, name=name,
+                process_set=self._hvd_process_set,
+                prescale_factor=pre / self._hvd_bpps,
+                postscale_factor=post, compression=comp)
+            self._hvd_count(hs[0])
+            self._hvd_handles[p] = hs[0]
+            return
+        # custom compressor: numpy bridge, compress before enqueue
+        a, ctx = comp.compress(p.grad.detach().cpu().numpy())
+        if self._hvd_bpps > 1:
+            a = a / self._hvd_bpps
+        h = _core.allreduce_async(
+            a, op=op, name=name, process_set=self._hvd_process_set,
+            prescale_factor=pre, postscale_factor=post)
+        self._hvd_stats["bridge"] += 1
+        self._hvd_handles[p] = (h, ctx)
+
+    def _hvd_submit_group(self, gi, members, op, pre, post):
+        hs = grouped_allreduce_async_(
+            [p.grad for p in members], op=op, name=f"opt.group{gi}",
+            process_set=self._hvd_process_set,
+            prescale_factor=pre / self._hvd_bpps, postscale_factor=post,
+            compression=self._hvd_compression)
+        for p, h in zip(members, hs):
+            self._hvd_count(h)
+            self._hvd_handles[p] = h
+
+    def _hvd_count(self, h):
+        native = isinstance(h, TorchHandle) and h.native is not None
+        self._hvd_stats["native" if native else "bridge"] += 1
 
     def _hvd_hook(self, p):
         if (self._hvd_step_count + 1) % self._hvd_bpps != 0:
@@ -391,34 +575,38 @@ class _DistributedOptimizerMixin:
         # honored and an unknown process set fails loudly.
         op, pre, post = _core.predivide_factors(
             self._hvd_op, self._hvd_predivide, self._hvd_process_set)
-        name = f"allreduce.{self._hvd_names.get(p, id(p))}"
-        if self._hvd_compression is None:
-            # Hot path: in-place allreduce on the grad buffer via
-            # allreduce_async_ (native extension when available, bridge
-            # otherwise — both submit the SAME prescale, with the bpps
-            # local-accumulation average folded in).
-            h = allreduce_async_(
-                p.grad, op=op, name=name,
-                process_set=self._hvd_process_set,
-                prescale_factor=pre / self._hvd_bpps,
-                postscale_factor=post)
-            self._hvd_handles[p] = (h, None)
+        if self._hvd_num_groups == 0:
+            self._hvd_submit_one(p, op, pre, post)
             return
-        a = self._hvd_compression.compress(p.grad.detach().cpu().numpy())
-        a, ctx = a
-        if self._hvd_bpps > 1:
-            a = a / self._hvd_bpps
-        h = _core.allreduce_async(
-            a, op=op, name=name, process_set=self._hvd_process_set,
-            prescale_factor=pre, postscale_factor=post)
-        self._hvd_handles[p] = (h, ctx)
+        gi = self._hvd_group_of[p]
+        ready = self._hvd_group_ready[gi]
+        if not any(q is p for q in ready):  # identity, not tensor __eq__
+            ready.append(p)
+        if len(ready) == self._hvd_group_size[gi]:
+            self._hvd_submit_group(gi, ready, op, pre, post)
+            self._hvd_group_ready[gi] = []
+
+    def _hvd_flush_groups(self):
+        """Submit groups left incomplete at step time (params whose grads
+        never materialized this step, e.g. frozen layers)."""
+        if self._hvd_num_groups == 0:
+            return
+        op, pre, post = _core.predivide_factors(
+            self._hvd_op, self._hvd_predivide, self._hvd_process_set)
+        for gi, ready in self._hvd_group_ready.items():
+            members = [p for p in ready if p not in self._hvd_handles]
+            if members:
+                self._hvd_submit_group(gi, members, op, pre, post)
+            self._hvd_group_ready[gi] = []
 
     def synchronize(self):
-        for p, (h, ctx) in list(self._hvd_handles.items()):
+        self._hvd_flush_groups()
+        for p, h in list(self._hvd_handles.items()):
             if isinstance(h, TorchHandle):
                 synchronize(h)  # in place on p.grad (native or bridge)
                 continue
-            out = _core.synchronize(h)
+            core_h, ctx = h
+            out = _core.synchronize(core_h)
             if self._hvd_compression is not None:
                 out = self._hvd_compression.decompress(out, ctx)
             p.grad.copy_(torch.from_numpy(
@@ -437,19 +625,26 @@ class _DistributedOptimizerMixin:
 
 def DistributedOptimizer(optimizer, named_parameters=None, op=Average,
                          compression=None, backward_passes_per_step=1,
-                         process_set=0, gradient_predivide_factor=1.0):
+                         process_set=0, gradient_predivide_factor=1.0,
+                         num_groups=0):
     """Wrap a torch optimizer: backward hooks launch async allreduces per
     gradient (overlapped with the rest of backward); step() synchronizes
     then applies (reference: horovod/torch DistributedOptimizer).
     ``gradient_predivide_factor`` splits the averaging around the sum
-    (prescale 1/f, postscale f/size); requires op=Average."""
+    (prescale 1/f, postscale f/size); requires op=Average.
+    ``num_groups`` splits the parameters into that many atomic allreduce
+    groups, each submitted through ONE native-extension crossing once all
+    its gradients arrived (reference: num_groups / group_table.cc).
+    ``compression=Compression.fp16``/``bf16`` stays on the native
+    extension (wire-buffer cast in csrc/torch_ops.cc); custom compressors
+    use the numpy bridge."""
     cls = type("DistributedOptimizer",
                (_DistributedOptimizerMixin, optimizer.__class__), {})
     dist = cls.__new__(cls)
     dist.__dict__.update(optimizer.__dict__)
     dist._hvd_init(named_parameters, op, compression,
                    backward_passes_per_step, process_set,
-                   gradient_predivide_factor)
+                   gradient_predivide_factor, num_groups)
     return dist
 
 

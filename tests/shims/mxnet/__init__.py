@@ -1,0 +1,17 @@
+"""CI-only mxnet conformance shim (NOT part of horovod_tpu).
+
+Implements the exact API surface ``horovod_tpu.mxnet`` consumes —
+``mxnet.ndarray.NDArray``/``array``, ``mx.optimizer.Optimizer`` (+ an SGD
+for tests), ``mx.gluon.Trainer``/``Parameter`` — over plain numpy.
+Upstream MXNet is archived (Apache attic, 2023) and not installable here;
+the shim lets the binding's collectives, ``DistributedOptimizer`` and
+``DistributedTrainer`` execute end-to-end in CI instead of only their
+ImportError surface. Real-MXNet behavior (deferred init, contexts/GPU
+streams, autograd) is explicitly NOT simulated. See README descope note.
+"""
+from . import gluon, ndarray, optimizer  # noqa: F401
+from .ndarray import NDArray, array  # noqa: F401
+
+nd = ndarray
+
+__version__ = "0.0-horovod-tpu-ci-shim"

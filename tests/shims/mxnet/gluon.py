@@ -1,0 +1,49 @@
+"""mx.gluon subset for the CI mxnet shim: Parameter + Trainer with the
+kvstore-free update loop horovod_tpu.mxnet.DistributedTrainer overrides."""
+import numpy as np
+
+from . import optimizer as _opt
+from .ndarray import NDArray
+
+
+class Parameter:
+    def __init__(self, name, data, grad_req="write"):
+        self.name = name
+        self.grad_req = grad_req
+        self._data = data if isinstance(data, NDArray) else NDArray(data)
+        self._grad = NDArray(np.zeros_like(self._data._np))
+
+    def data(self):
+        return self._data
+
+    def grad(self):
+        return self._grad
+
+    def list_grad(self):
+        return [self._grad]
+
+    def list_data(self):
+        return [self._data]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore=None):
+        if hasattr(params, "values"):
+            params = list(params.values())
+        self._params = list(params)
+        if isinstance(optimizer, str):
+            optimizer = _opt.create(optimizer, **(optimizer_params or {}))
+        self._optimizer = optimizer
+        self._states = [self._optimizer.create_state(i, p.data())
+                        for i, p in enumerate(self._params)]
+
+    def _allreduce_grads(self):
+        pass  # kvstore-backed in real gluon; subclasses override
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        self._allreduce_grads()
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            self._optimizer.update(i, p.data(), p.grad(), self._states[i])

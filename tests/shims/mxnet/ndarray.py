@@ -1,0 +1,39 @@
+"""numpy-backed NDArray subset for the CI mxnet shim."""
+import numpy as np
+
+
+class NDArray:
+    def __init__(self, data, ctx=None, dtype=None):
+        self._np = np.array(data, dtype=dtype)
+        self.context = ctx
+
+    def asnumpy(self):
+        return self._np.copy()
+
+    @property
+    def shape(self):
+        return self._np.shape
+
+    @property
+    def dtype(self):
+        return self._np.dtype
+
+    def __getitem__(self, idx):
+        out = self._np[idx]
+        return NDArray(out, ctx=self.context) if isinstance(out, np.ndarray) \
+            else out
+
+    def __setitem__(self, idx, value):
+        self._np[idx] = value._np if isinstance(value, NDArray) else value
+
+    def __len__(self):
+        return len(self._np)
+
+    def __repr__(self):
+        return f"NDArray({self._np!r})"
+
+
+def array(data, ctx=None, dtype=None):
+    if isinstance(data, NDArray):
+        data = data._np
+    return NDArray(data, ctx=ctx, dtype=dtype)

@@ -1,0 +1,36 @@
+"""mx.optimizer subset for the CI mxnet shim."""
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.01):
+        self.learning_rate = learning_rate
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self.learning_rate = lr
+
+
+class SGD(Optimizer):
+    def update(self, index, weight, grad, state):
+        if isinstance(index, (tuple, list)):  # grouped form, like real mx
+            for w, g in zip(weight, grad):
+                w[:] = w.asnumpy() - self.learning_rate * g.asnumpy()
+            return
+        weight[:] = weight.asnumpy() - self.learning_rate * grad.asnumpy()
+
+
+def create(name, **kwargs):
+    if name.lower() == "sgd":
+        return SGD(**kwargs)
+    raise ValueError(f"shim knows only 'sgd', got {name!r}")

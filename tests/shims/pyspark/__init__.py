@@ -1,0 +1,170 @@
+"""CI-only pyspark conformance shim (NOT part of horovod_tpu).
+
+Implements the exact API surface ``horovod_tpu.spark.run`` consumes —
+``SparkContext.getOrCreate``, ``sc.parallelize(...).barrier()
+.mapPartitions(...).collect()``, ``BarrierTaskContext.get`` with
+``partitionId`` / ``stageAttemptNumber`` / ``barrier`` — with the one
+semantic that matters for a collective job: every barrier task runs
+CONCURRENTLY in its own OS process (real Spark: one task per executor
+slot). Tasks are shipped to children via cloudpickle like real pyspark
+ships closures.
+
+Used by tests/workers/spark_shim_worker.py (prepended to PYTHONPATH) so
+the barrier/negotiation path of ``spark.run()`` executes end-to-end in
+CI; real-cluster behavior (scheduling, locality, stage retries) is
+explicitly NOT simulated. See README "Spark/Ray" descope note.
+"""
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import cloudpickle
+
+_SHIM_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class BarrierTaskContext:
+    """Per-task context; available inside a barrier task only."""
+
+    _current = None
+
+    def __init__(self, partition_id, n_tasks, barrier_dir, attempt=0):
+        self._pid = partition_id
+        self._n = n_tasks
+        self._dir = barrier_dir
+        self._attempt = attempt
+        self._epoch = 0
+
+    @classmethod
+    def get(cls):
+        if cls._current is None:
+            raise RuntimeError("not inside a barrier task")
+        return cls._current
+
+    def partitionId(self):  # noqa: N802 — pyspark's camelCase API
+        return self._pid
+
+    def stageAttemptNumber(self):  # noqa: N802
+        return self._attempt
+
+    def barrier(self):
+        """Global sync across all tasks of the stage (filesystem
+        count-down: one marker per task per epoch)."""
+        self._epoch += 1
+        my = os.path.join(self._dir, f"b{self._epoch}.{self._pid}")
+        with open(my, "w"):
+            pass
+        deadline = time.time() + 300
+        while True:
+            seen = sum(
+                os.path.exists(os.path.join(self._dir,
+                                            f"b{self._epoch}.{i}"))
+                for i in range(self._n))
+            if seen == self._n:
+                return
+            if time.time() > deadline:
+                raise RuntimeError("barrier() timed out")
+            time.sleep(0.01)
+
+    def getTaskInfos(self):  # noqa: N802 — minimal parity
+        return [type("TaskInfo", (), {"address": "127.0.0.1"})()
+                for _ in range(self._n)]
+
+
+class _BarrierRDD:
+    def __init__(self, sc, n_partitions):
+        self._sc = sc
+        self._n = n_partitions
+        self._fn = None
+
+    def mapPartitions(self, fn):  # noqa: N802
+        out = _BarrierRDD(self._sc, self._n)
+        out._fn = fn
+        return out
+
+    def collect(self):
+        if self._fn is None:
+            raise RuntimeError("no mapPartitions function")
+        n = self._n
+        tmp = tempfile.mkdtemp(prefix="fake-spark-")
+        fn_path = os.path.join(tmp, "task.pkl")
+        with open(fn_path, "wb") as f:
+            cloudpickle.dump(self._fn, f)
+        outs = [os.path.join(tmp, f"out-{i}.pkl") for i in range(n)]
+        errs = [os.path.join(tmp, f"err-{i}.log") for i in range(n)]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _SHIM_DIR + os.pathsep \
+            + env.get("PYTHONPATH", "")
+        procs = []
+        try:
+            for i in range(n):
+                with open(errs[i], "wb") as ef:
+                    procs.append(subprocess.Popen(
+                        [sys.executable, "-m", "pyspark._task_runner",
+                         fn_path, outs[i], str(i), str(n), tmp],
+                        env=env, stderr=ef, start_new_session=True))
+            deadline = time.time() + 600
+            codes = [None] * n
+            while any(c is None for c in codes):
+                for i, p in enumerate(procs):
+                    if codes[i] is None:
+                        codes[i] = p.poll()
+                        if codes[i] not in (None, 0):
+                            with open(errs[i], "rb") as ef:
+                                tail = ef.read()[-4000:].decode(
+                                    "utf-8", "replace")
+                            raise RuntimeError(
+                                f"barrier task {i} failed "
+                                f"(exit {codes[i]}):\n{tail}")
+                if time.time() > deadline:
+                    raise RuntimeError("barrier stage timed out")
+                time.sleep(0.02)
+            results = []
+            for i in range(n):
+                with open(outs[i], "rb") as f:
+                    results.extend(cloudpickle.load(f))
+            return results
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+class _RDD:
+    def __init__(self, sc, n_partitions):
+        self._sc = sc
+        self._n = n_partitions
+
+    def barrier(self):
+        return _BarrierRDD(self._sc, self._n)
+
+
+class SparkContext:
+    _instance = None
+
+    def __init__(self, master="local[2]"):
+        self.master = master
+
+    @property
+    def defaultParallelism(self):  # noqa: N802
+        return max(os.cpu_count() or 2, 2)
+
+    @classmethod
+    def getOrCreate(cls):  # noqa: N802
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def parallelize(self, data, num_slices):
+        return _RDD(self, num_slices)
+
+    def stop(self):
+        SparkContext._instance = None
+
+
+__version__ = "0.0-horovod-tpu-ci-shim"

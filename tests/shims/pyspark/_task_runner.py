@@ -1,0 +1,24 @@
+"""Barrier-task child process of the CI pyspark shim: install the task's
+BarrierTaskContext, run the cloudpickled mapPartitions function on this
+partition's iterator, write the result list back."""
+import sys
+
+import cloudpickle
+
+
+def main():
+    fn_path, out_path, pid, n, barrier_dir = sys.argv[1:6]
+    pid, n = int(pid), int(n)
+    import pyspark
+
+    pyspark.BarrierTaskContext._current = pyspark.BarrierTaskContext(
+        pid, n, barrier_dir)
+    with open(fn_path, "rb") as f:
+        fn = cloudpickle.load(f)
+    result = list(fn(iter([pid])))
+    with open(out_path, "wb") as f:
+        cloudpickle.dump(result, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,130 @@
+"""CI-only ray conformance shim (NOT part of horovod_tpu).
+
+Implements the exact API surface ``horovod_tpu.ray.RayExecutor._run_ray``
+consumes — ``ray.init`` / ``ray.is_initialized`` / ``@ray.remote(...)``
+returning handles with ``.remote(...)`` / ``ray.get(futures, timeout=)``
+/ ``ray.cancel(fut, force=)`` / ``ray.util.get_node_ip_address`` — with
+the one semantic that matters for a collective job: each remote call runs
+CONCURRENTLY in its own OS process, shipped via cloudpickle like real ray
+ships tasks.
+
+Used by tests/workers/ray_shim_worker.py (prepended to PYTHONPATH) so the
+``backend="ray"`` path executes end-to-end in CI; real-cluster behavior
+(placement groups, scheduling, object store) is explicitly NOT simulated.
+See README "Spark/Ray" descope note.
+"""
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import cloudpickle
+
+from . import util  # noqa: F401  (ray.util.get_node_ip_address)
+
+_SHIM_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_initialized = False
+
+
+def init(*args, **kwargs):
+    global _initialized
+    _initialized = True
+
+
+def is_initialized():
+    return _initialized
+
+
+def shutdown():
+    global _initialized
+    _initialized = False
+
+
+class _Future:
+    def __init__(self, fn_blob, args):
+        self._tmp = tempfile.mkdtemp(prefix="fake-ray-")
+        in_path = os.path.join(self._tmp, "task.pkl")
+        self.out_path = os.path.join(self._tmp, "out.pkl")
+        self.err_path = os.path.join(self._tmp, "err.log")
+        with open(in_path, "wb") as f:
+            f.write(fn_blob)
+        with open(os.path.join(self._tmp, "args.pkl"), "wb") as f:
+            cloudpickle.dump(args, f)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _SHIM_DIR + os.pathsep \
+            + env.get("PYTHONPATH", "")
+        with open(self.err_path, "wb") as ef:
+            self.proc = subprocess.Popen(
+                [sys.executable, "-m", "ray._task_runner", in_path,
+                 os.path.join(self._tmp, "args.pkl"), self.out_path],
+                env=env, stderr=ef, start_new_session=True)
+
+    def _error_tail(self):
+        try:
+            with open(self.err_path, "rb") as ef:
+                return ef.read()[-4000:].decode("utf-8", "replace")
+        except OSError:
+            return "<no stderr captured>"
+
+    def _cleanup(self):
+        import shutil
+
+        shutil.rmtree(self._tmp, ignore_errors=True)
+
+
+class RayTaskError(Exception):
+    pass
+
+
+class _RemoteFunction:
+    def __init__(self, fn):
+        self._blob = cloudpickle.dumps(fn)
+
+    def remote(self, *args):
+        return _Future(self._blob, args)
+
+
+def remote(*args, **options):
+    """Supports both ``@ray.remote`` and ``@ray.remote(max_calls=1)``."""
+    if args and callable(args[0]) and not options:
+        return _RemoteFunction(args[0])
+
+    def deco(fn):
+        return _RemoteFunction(fn)
+
+    return deco
+
+
+def get(futures, timeout=None):
+    single = isinstance(futures, _Future)
+    futs = [futures] if single else list(futures)
+    deadline = time.time() + (timeout if timeout else 3600)
+    pending = set(range(len(futs)))
+    while pending:
+        for i in list(pending):
+            rc = futs[i].proc.poll()
+            if rc is None:
+                continue
+            pending.discard(i)
+            if rc != 0:
+                raise RayTaskError(
+                    f"ray task {i} failed (exit {rc}):\n"
+                    f"{futs[i]._error_tail()}")
+        if pending and time.time() > deadline:
+            raise TimeoutError(f"ray.get timed out after {timeout}s")
+        time.sleep(0.02)
+    results = []
+    for f in futs:
+        with open(f.out_path, "rb") as fh:
+            results.append(cloudpickle.load(fh))
+        f._cleanup()
+    return results[0] if single else results
+
+
+def cancel(fut, force=False):
+    if fut.proc.poll() is None:
+        fut.proc.kill() if force else fut.proc.terminate()
+
+
+__version__ = "0.0-horovod-tpu-ci-shim"

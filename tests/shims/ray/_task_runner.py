@@ -1,0 +1,20 @@
+"""Remote-task child process of the CI ray shim: run the cloudpickled
+function with its args, write the result back."""
+import sys
+
+import cloudpickle
+
+
+def main():
+    fn_path, args_path, out_path = sys.argv[1:4]
+    with open(fn_path, "rb") as f:
+        remote_fn = cloudpickle.load(f)
+    with open(args_path, "rb") as f:
+        args = cloudpickle.load(f)
+    result = remote_fn(*args)
+    with open(out_path, "wb") as f:
+        cloudpickle.dump(result, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Cluster-integration code paths under CI shims (VERDICT r3 #4).
+
+pyspark and ray are not installable here (no network), so tests/shims
+vendors minimal conformance shims of exactly the API surface
+horovod_tpu.spark.run and RayExecutor(backend="ray") consume, with
+barrier tasks / remote tasks as real concurrent OS processes. These tests
+make the previously never-executed code paths run end-to-end; what stays
+untested is real-cluster behavior (scheduling, placement, retries) —
+documented in the README descope note.
+
+The workers run in subprocesses so the shim packages never enter the
+pytest process's sys.modules (other tests probe for the real packages'
+absence).
+"""
+import os
+
+from .util import run_single
+
+_SHIMS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "shims")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PP = {"PYTHONPATH": _REPO + os.pathsep + _SHIMS}
+
+
+def test_spark_run_barrier_stage():
+    """spark.run(): barrier tasks negotiate a fresh job through the
+    driver's signed KV and return per-rank results ordered by rank."""
+    run_single("spark_shim_worker.py", extra_env=_PP, timeout=300)
+
+
+def test_ray_executor_ray_backend():
+    """RayExecutor(backend='ray'): remote task fan-out, result collection,
+    and the kill-survivors failure contract."""
+    run_single("ray_shim_worker.py", extra_env=_PP, timeout=300)

@@ -59,6 +59,20 @@ def test_tf_xla_ops_fallback():
     run_worker_job(2, "tf_xla_worker.py", timeout=300)
 
 
+def test_mxnet_binding_2proc():
+    """The full mxnet surface (collectives, broadcast_parameters,
+    DistributedOptimizer, DistributedTrainer) executes end-to-end over the
+    CI mxnet shim (tests/shims/mxnet — upstream MXNet is archived and not
+    installable here; see README descope note)."""
+    import os
+
+    shims = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "shims")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    run_worker_job(2, "mxnet_worker.py", timeout=120,
+                   extra_env={"PYTHONPATH": repo + os.pathsep + shims})
+
+
 def test_mxnet_binding_import_surface():
     """MXNet is absent in this environment (README descope note): the
     binding must fail with a clear, actionable ImportError — and import

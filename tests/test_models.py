@@ -146,3 +146,84 @@ def test_transformer_ring_attention_matches_gather():
     np.testing.assert_allclose(np.asarray(out_ring, np.float32),
                                np.asarray(out_gather, np.float32),
                                rtol=3e-2, atol=3e-2)
+
+
+def test_pallas_norm_matches_reference():
+    """ops/pallas_norm paired_reduce + batch_norm_train: forward and all
+    three gradients must match the naive XLA batch norm (the kernels are
+    the measured PERF.md round-4 experiment; norm='pallas' exposes them in
+    ResNet)."""
+    from horovod_tpu.ops.pallas_norm import batch_norm_train, paired_reduce
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 8, 8, 16)), jnp.float32)
+    g = jnp.asarray(rng.standard_normal(16), jnp.float32)
+    b = jnp.asarray(rng.standard_normal(16), jnp.float32)
+
+    s, p = paired_reduce(x, x, interpret=True)
+    np.testing.assert_allclose(np.asarray(s),
+                               np.asarray(x).reshape(-1, 16).sum(0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p),
+                               (np.asarray(x).reshape(-1, 16) ** 2).sum(0),
+                               rtol=1e-5)
+
+    def ref(x, g, b):
+        mu = jnp.mean(x, (0, 1, 2))
+        var = jnp.var(x, (0, 1, 2))
+        return ((x - mu) * jax.lax.rsqrt(var + 1e-5)) * g + b
+
+    def pal(x, g, b):
+        y, _, _ = batch_norm_train(x, g, b, 1e-5, True)
+        return y
+
+    np.testing.assert_allclose(np.asarray(pal(x, g, b)),
+                               np.asarray(ref(x, g, b)),
+                               rtol=2e-4, atol=2e-4)
+    w = jnp.cos(jnp.arange(16.0))
+    gr = jax.grad(lambda *a: jnp.sum(ref(*a) ** 2 * w), (0, 1, 2))(x, g, b)
+    gp = jax.grad(lambda *a: jnp.sum(pal(*a) ** 2 * w), (0, 1, 2))(x, g, b)
+    for a_, b_, n in zip(gr, gp, "xgb"):
+        np.testing.assert_allclose(np.asarray(a_), np.asarray(b_),
+                                   rtol=2e-3, atol=2e-3, err_msg=f"d{n}")
+
+
+def test_resnet_pallas_norm_trains():
+    """ResNet(norm='pallas') runs a training step end-to-end (interpret
+    mode on CPU) and produces finite loss + updated batch stats."""
+    import optax
+
+    from horovod_tpu.models import resnet
+
+    model, variables = resnet.create_train_state(
+        jax.random.PRNGKey(0), image_size=32, num_classes=10,
+        norm="pallas")
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.1)
+    opt_state = tx.init(params)
+
+    def loss_fn(params, batch_stats, images, labels):
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats}, images,
+            train=True, mutable=["batch_stats"])
+        return resnet.cross_entropy_loss(logits, labels), \
+            updates["batch_stats"]
+
+    @jax.jit
+    def step(params, batch_stats, opt_state, images, labels):
+        (loss, batch_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch_stats, images, labels)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), batch_stats, \
+            opt_state, loss
+
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.standard_normal((4, 32, 32, 3)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 10, (4,)), jnp.int32)
+    before = np.asarray(
+        batch_stats["bn_init"]["mean"], np.float32).copy()
+    params, batch_stats, opt_state, loss = step(
+        params, batch_stats, opt_state, images, labels)
+    assert np.isfinite(float(loss)), loss
+    after = np.asarray(batch_stats["bn_init"]["mean"], np.float32)
+    assert not np.allclose(before, after), "running stats never updated"

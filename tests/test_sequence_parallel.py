@@ -154,6 +154,127 @@ def test_moe_drops_overflow():
     assert nonzero_rows == 8, nonzero_rows
 
 
+def test_ragged_alltoall_uneven_splits():
+    """ragged_alltoall (the ICI alltoallv — VERDICT r3 #7): every shard
+    sends a DIFFERENT number of rows to each peer; receivers must see
+    exactly the sent rows, tagged with correct counts, zero-padded."""
+    import functools
+
+    from jax import shard_map
+
+    from horovod_tpu.ops.jax_ops import ragged_alltoall
+
+    Pn, D, cap = 8, 4, 6
+    mesh = Mesh(np.asarray(jax.devices()[:Pn]), ("x",))
+    # shard i sends (i + j) % 4 rows to peer j; row values encode
+    # (src, dst, slot) so the receiver can verify provenance exactly.
+    counts = np.array([[(i + j) % 4 for j in range(Pn)]
+                      for i in range(Pn)], np.int32)
+
+    @jax.jit
+    @functools.partial(shard_map, mesh=mesh, in_specs=(),
+                       out_specs=(P("x", None, None, None), P("x", None)),
+                       check_vma=False)
+    def go():
+        i = jax.lax.axis_index("x")
+        my_counts = jnp.asarray(counts)[i]                       # [P]
+        starts = jnp.cumsum(my_counts) - my_counts
+        T = int(counts.sum(1).max())
+        row = jnp.arange(T, dtype=jnp.int32)
+        # destination of each row under the grouped layout
+        dst = jnp.sum((row[:, None] >= (starts + my_counts)[None, :])
+                      .astype(jnp.int32), axis=1)
+        slot = row - starts[dst]
+        x = (i * 10000 + dst * 100 + slot).astype(jnp.float32)[:, None] \
+            * jnp.ones((1, D), jnp.float32)
+        recv, rcounts = ragged_alltoall(x, my_counts, "x", cap)
+        return recv[None], rcounts[None]
+
+    recv, rcounts = go()
+    recv, rcounts = np.asarray(recv), np.asarray(rcounts)
+    for dst in range(Pn):
+        for src in range(Pn):
+            n = counts[src, dst]
+            assert rcounts[dst, src] == n, (dst, src, rcounts[dst])
+            for s in range(cap):
+                expect = (src * 10000 + dst * 100 + s) if s < n else 0.0
+                np.testing.assert_allclose(
+                    recv[dst, src, s], expect,
+                    err_msg=f"dst={dst} src={src} slot={s}")
+
+
+def _ragged_moe_layer(mesh, axis, w_in, w_out, **kw):
+    import functools
+
+    from jax import shard_map
+
+    from horovod_tpu.parallel import moe_dispatch_combine_ragged
+
+    espec = P(axis, None, None)
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), espec, espec),
+        out_specs=P(axis, None), check_vma=False)
+    def fn(x, logits, w_in_l, w_out_l):
+        def expert_fn(buf):
+            h = jnp.einsum("end,edf->enf", buf.astype(jnp.float32),
+                           w_in_l.astype(jnp.float32))
+            h = jax.nn.gelu(h)
+            return jnp.einsum("enf,efd->end", h,
+                              w_out_l.astype(jnp.float32)).astype(buf.dtype)
+
+        out, _ = moe_dispatch_combine_ragged(x, logits, expert_fn, axis,
+                                             **kw)
+        return out
+
+    return lambda x, logits: fn(x, logits, w_in, w_out)
+
+
+def test_moe_ragged_matches_dense():
+    """Ragged (wire-following) dispatch == dense one-hot routing when
+    capacities are lossless — including under IMBALANCED routing."""
+    rng = np.random.default_rng(11)
+    E, D, F, T = 8, 16, 32, 64
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("expert",))
+    w_in = jnp.asarray(rng.standard_normal((E, D, F)) * 0.1, jnp.float32)
+    w_out = jnp.asarray(rng.standard_normal((E, F, D)) * 0.1, jnp.float32)
+    x = jnp.asarray(rng.standard_normal((8 * T, D)), jnp.float32)
+    # skewed router: expert 0 drawn ~6x more often than the rest
+    logits_np = rng.standard_normal((8 * T, E)).astype(np.float32)
+    logits_np[:, 0] += 1.5
+    logits = jnp.asarray(logits_np)
+
+    layer = _ragged_moe_layer(mesh, "expert", w_in, w_out,
+                              peer_capacity=T, expert_capacity=8 * T)
+    out = layer(x, logits)
+
+    probs = jax.nn.softmax(np.asarray(logits, np.float32), axis=-1)
+    eidx = np.argmax(probs, -1)
+    gate = probs[np.arange(len(eidx)), eidx]
+    h = np.einsum("td,edf->tef", np.asarray(x), np.asarray(w_in))
+    h = np.asarray(jax.nn.gelu(jnp.asarray(h)))
+    y = np.einsum("tef,efd->ted", h, np.asarray(w_out))
+    ref = y[np.arange(len(eidx)), eidx] * gate[:, None]
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3, atol=2e-3)
+
+
+def test_moe_ragged_drops_overflow():
+    """peer_capacity=1 with every token routed to shard 0's expert:
+    exactly one token per source shard survives; dropped outputs are 0."""
+    E, D, T = 8, 4, 16
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("expert",))
+    eye = jnp.zeros((E, D, D), jnp.float32) + jnp.eye(D)
+    x = jnp.ones((8 * T, D), jnp.float32)
+    logits = jnp.zeros((8 * T, E), jnp.float32).at[:, 0].set(10.0)
+    layer = _ragged_moe_layer(mesh, "expert", eye, eye,
+                              peer_capacity=1, expert_capacity=16)
+    out = np.asarray(layer(x, logits))
+    nonzero_rows = (np.abs(out).sum(-1) > 1e-6).sum()
+    assert nonzero_rows == 8, nonzero_rows
+
+
 def test_ring_attention_gradients(seq_mesh):
     """Training must differentiate through the ring (scan + ppermute):
     grads of sharded ring attention == grads of the dense reference."""

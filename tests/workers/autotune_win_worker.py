@@ -1,0 +1,93 @@
+"""Worker: tuned parameters must BEAT the (deliberately bad) defaults.
+
+Phase 1: init with HVD_AUTOTUNE=1 under a pathological default cycle time
+(set by the test: HVD_CYCLE_TIME_MS=25 paces the negotiation loop at
+~40 Hz), drive the synthetic stream until the search locks, then time M
+iterations at the tuned point. Phase 2: shutdown, re-init with autotune
+OFF at the same defaults, time the same M iterations. The tuned
+configuration must move more bytes/sec — the end-to-end "tuned >= default"
+assertion VERDICT r3 #8 asks for (reference: parameter_manager.cc's whole
+reason to exist).
+
+Every rank runs identical iteration counts (collectives stay symmetric);
+rank 0 asserts the win.
+"""
+import os
+import time
+
+# Fake multi-host topology (hier_worker.py convention) so the
+# hierarchical arm is toggleable — see autotune_worker.py.
+_L = os.environ.get("AT_LOCAL_SIZE")
+if _L:
+    _r = int(os.environ["HVD_RANK"])
+    _s = int(os.environ["HVD_SIZE"])
+    _L = int(_L)
+    os.environ["HVD_LOCAL_RANK"] = str(_r % _L)
+    os.environ["HVD_LOCAL_SIZE"] = str(_L)
+    os.environ["HVD_CROSS_RANK"] = str(_r // _L)
+    os.environ["HVD_CROSS_SIZE"] = str(_s // _L)
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def stream(n_iters, tag):
+    for i in range(n_iters):
+        out = hvd.allreduce(np.full((512,), 1.0, np.float32), op=hvd.Sum,
+                            name=f"{tag}{i % 4}")
+        assert out[0] == hvd.size(), out[0]
+
+
+M = int(os.environ.get("TEST_TIMED_ITERS", "60"))
+max_samples = int(os.environ.get("HVD_AUTOTUNE_MAX_SAMPLES", "8"))
+
+# -- phase 1: autotune on, search to lock, then timed window --------------
+hvd.init()
+r = hvd.rank()
+assert hvd.autotune_state()[0] == "searching"
+# Fixed iteration count on every rank (no status-dependent early exit: a
+# rank observing "locked" one cycle before its peers would break first and
+# strand their next allreduce).
+stream(30 * max_samples, "warm")
+status, fusion, cycle = hvd.autotune_state()
+assert status == "locked", status
+t0 = time.perf_counter()
+stream(M, "tuned")
+tuned_secs = time.perf_counter() - t0
+# All ranks at the same point before tearing the mesh down, then stagger
+# the re-init: rank 0 must bind the controller port strictly after every
+# old socket closed and strictly before the workers' ConnectRetry window.
+hvd.barrier(name="phase1.done")
+hvd.shutdown()
+
+# -- phase 2: same job, autotune off, same defaults -----------------------
+os.environ["HVD_AUTOTUNE"] = "0"
+time.sleep(0.5 if r == 0 else 2.5)
+# Re-forming a 32-rank mesh on the same port is raceable under box load
+# (a worker can connect in rank 0's partial window and see a reset);
+# hvd_init rebuilds Global from scratch, so failed attempts retry clean.
+for attempt in range(6):
+    try:
+        hvd.init()
+        break
+    except RuntimeError:
+        time.sleep(1.0 + r * 0.05)
+else:
+    raise SystemExit("phase-2 init never succeeded")
+t0 = time.perf_counter()
+stream(M, "plain")
+default_secs = time.perf_counter() - t0
+hvd.shutdown()
+
+if r == 0:
+    speedup = default_secs / tuned_secs
+    # The pathological 25 ms default cycle paces the stream at ~40
+    # windows/sec; any sane tuned cycle beats it severalfold. >=1.5x keeps
+    # the assertion meaningful yet robust to box noise.
+    assert speedup >= 1.5, (
+        f"tuned {tuned_secs:.2f}s vs default {default_secs:.2f}s "
+        f"(speedup {speedup:.2f}) — autotune did not beat defaults")
+    print(f"rank 0: autotune win {speedup:.1f}x "
+          f"(fusion={fusion} cycle={cycle:.2f}ms)", flush=True)
+print(f"rank {r}: autotune-win PASS", flush=True)

@@ -8,6 +8,20 @@ every collective result stays correct while parameters move underneath.
 """
 import os
 
+# Optional fake multi-host topology (hier_worker.py convention): makes the
+# hierarchical-allreduce arm toggleable, so the categorical sweep covers
+# all 4 (cache, hier) combinations. Without it cross_size == 1 and the
+# manager correctly skips the no-op hier arm.
+_L = os.environ.get("AT_LOCAL_SIZE")
+if _L:
+    _r = int(os.environ["HVD_RANK"])
+    _s = int(os.environ["HVD_SIZE"])
+    _L = int(_L)
+    os.environ["HVD_LOCAL_RANK"] = str(_r % _L)
+    os.environ["HVD_LOCAL_SIZE"] = str(_L)
+    os.environ["HVD_CROSS_RANK"] = str(_r // _L)
+    os.environ["HVD_CROSS_SIZE"] = str(_s // _L)
+
 import numpy as np
 
 import horovod_tpu as hvd
@@ -39,13 +53,27 @@ log_path = os.environ.get("HVD_AUTOTUNE_LOG", "")
 if r == 0 and log_path:
     with open(log_path) as f:
         lines = [l for l in f.read().splitlines() if l]
-    assert lines[0] == "sample,fusion_kb,cycle_ms,score_mbps", lines[:1]
+    assert lines[0] == "sample,fusion_kb,cycle_ms,cache,hier,score_mbps", \
+        lines[:1]
     rows = [l for l in lines[1:] if not l.startswith("#")]
     assert len(rows) == max_samples, (len(rows), max_samples)
     assert any(l.startswith("# final") for l in lines), lines[-2:]
-    # More than one distinct parameter point was actually explored.
+    # More than one distinct numeric point was actually explored.
     points = {tuple(l.split(",")[1:3]) for l in rows}
     assert len(points) >= 3, points
+    # The categorical sweep ran: the first rows walk every TOGGLEABLE
+    # (cache, hier) arm at a pinned numeric point (reference:
+    # parameter_manager.cc categorical layers before numeric tuning).
+    # 4 arms on a fake multi-host pod (AT_LOCAL_SIZE), 2 when only the
+    # cache can toggle (cross_size == 1 makes hier a no-op).
+    n_arms = int(os.environ.get("EXPECT_ARMS", "2"))
+    arms = [tuple(l.split(",")[3:5]) for l in rows[:n_arms]]
+    assert len(set(arms)) == n_arms, arms
+    numeric_pts = {tuple(l.split(",")[1:3]) for l in rows[:n_arms]}
+    assert len(numeric_pts) == 1, numeric_pts
+    # ...and the numeric phase runs under ONE locked arm.
+    tail_arms = {tuple(l.split(",")[3:5]) for l in rows[n_arms:]}
+    assert len(tail_arms) == 1, tail_arms
 
 hvd.shutdown()
 print(f"rank {r}: autotune PASS fusion={fusion} cycle={cycle:.3f}",

@@ -1,0 +1,98 @@
+"""MXNet binding worker over the CI mxnet shim (tests/shims).
+
+Exercises the REAL horovod_tpu.mxnet code — every collective, both
+broadcast_parameters forms, DistributedOptimizer, DistributedTrainer —
+with the shim supplying the mxnet API over numpy. (Reference coverage
+model: test/parallel/test_mxnet.py.)
+"""
+import mxnet as mx
+
+assert "ci-shim" in mx.__version__, \
+    "this worker must run against the CI shim, not a real mxnet"
+
+import numpy as np  # noqa: E402
+from mxnet import ndarray as nd  # noqa: E402
+
+import horovod_tpu.mxnet as hvd  # noqa: E402
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+
+# -- collectives ------------------------------------------------------------
+out = hvd.allreduce(nd.array(np.full(8, r + 1.0, np.float32)), op=hvd.Sum)
+assert isinstance(out, nd.NDArray)
+assert np.allclose(out.asnumpy(), s * (s + 1) / 2.0)
+
+t = nd.array(np.full(4, float(r), np.float32))
+hvd.allreduce_(t, op=hvd.Average)
+assert np.allclose(t.asnumpy(), (s - 1) / 2.0)
+
+outs = hvd.grouped_allreduce(
+    [nd.array(np.full(3, r + 1.0, np.float32)),
+     nd.array(np.full(5, 2.0 * r, np.float32))], op=hvd.Sum)
+assert np.allclose(outs[0].asnumpy(), s * (s + 1) / 2.0)
+assert np.allclose(outs[1].asnumpy(), 2.0 * sum(range(s)))
+
+g = hvd.allgather(nd.array(np.full((2, 3), r, np.float32)))
+assert g.shape == (2 * s, 3)
+
+b = hvd.broadcast(nd.array(np.arange(4, dtype=np.float32) * (r + 1)),
+                  root_rank=0)
+assert np.allclose(b.asnumpy(), np.arange(4))
+
+t2 = nd.array(np.arange(4).astype(np.float32) * (r + 1))
+hvd.broadcast_(t2, root_rank=0)
+assert np.allclose(t2.asnumpy(), np.arange(4))
+
+a2a, rs_ = hvd.alltoall(nd.array(np.full(2 * s, float(r), np.float32)),
+                        splits=[2] * s)
+assert np.allclose(rs_.asnumpy(), 2)
+assert np.allclose(a2a.asnumpy(),
+                   np.repeat(np.arange(s, dtype=np.float32), 2))
+
+rsc = hvd.reducescatter(nd.array(np.ones((2 * s, 3), np.float32) * (r + 1)),
+                        op=hvd.Sum)
+assert rsc.shape == (2, 3)
+assert np.allclose(rsc.asnumpy(), s * (s + 1) / 2.0)
+
+# -- broadcast_parameters ---------------------------------------------------
+arg_params = {"w": nd.array(np.ones(3, np.float32) * (r + 10)),
+              "b": nd.array(np.ones(2, np.float32) * (r + 20))}
+hvd.broadcast_parameters(arg_params, root_rank=0, prefix="args")
+assert np.allclose(arg_params["w"].asnumpy(), 10.0)
+assert np.allclose(arg_params["b"].asnumpy(), 20.0)
+
+gluon_params = {"w": mx.gluon.Parameter(
+    "w", np.ones(3, np.float32) * (r + 5))}
+hvd.broadcast_parameters(gluon_params, root_rank=0, prefix="gluon")
+assert np.allclose(gluon_params["w"].data().asnumpy(), 5.0)
+
+# -- DistributedOptimizer ---------------------------------------------------
+opt = hvd.DistributedOptimizer(mx.optimizer.SGD(learning_rate=1.0),
+                               op=hvd.Average)
+w = nd.array(np.zeros(3, np.float32))
+gr = nd.array(np.full(3, float(r + 1), np.float32))
+opt.update(0, w, gr, opt.create_state(0, w))
+# averaged grad = (s+1)/2, lr 1.0
+assert np.allclose(w.asnumpy(), -(s + 1) / 2.0), w.asnumpy()
+# grouped update path (list index)
+w1, w2 = (nd.array(np.zeros(2, np.float32)) for _ in range(2))
+g1 = nd.array(np.full(2, float(r + 1), np.float32))
+g2 = nd.array(np.full(2, 2.0 * (r + 1), np.float32))
+opt.update([1, 2], [w1, w2], [g1, g2], [None, None])
+assert np.allclose(g1.asnumpy(), (s + 1) / 2.0), g1.asnumpy()
+assert np.allclose(g2.asnumpy(), (s + 1) * 1.0), g2.asnumpy()
+
+# -- DistributedTrainer -----------------------------------------------------
+params = [mx.gluon.Parameter("w0", np.zeros(4, np.float32)),
+          mx.gluon.Parameter("w1", np.zeros(2, np.float32))]
+trainer = hvd.DistributedTrainer(params, "sgd",
+                                 {"learning_rate": 0.5}, op=hvd.Average)
+params[0].grad()[:] = np.full(4, float(r + 1), np.float32)
+params[1].grad()[:] = np.full(2, 4.0 * (r + 1), np.float32)
+trainer.step(batch_size=1)
+assert np.allclose(params[0].data().asnumpy(), -0.5 * (s + 1) / 2.0)
+assert np.allclose(params[1].data().asnumpy(), -2.0 * (s + 1) / 2.0)
+
+print(f"rank {r}: MXNET PASS", flush=True)
+hvd.shutdown()

@@ -134,5 +134,61 @@ g = model_pd.weight.grad.numpy()
 expect = np.mean([2 * (i + 1) for i in range(s)])  # avg over ranks of sum_b x
 assert np.allclose(g, expect, atol=1e-5), (g, expect)
 
+# grouped allreduce: atomic group through ONE native crossing (reference:
+# horovod_torch_grouped_allreduce_async_); in-place, out-of-place, and
+# fp16 wire compression inside the extension
+g1 = torch.full((4,), float(r + 1))
+g2 = torch.full((2, 3), 2.0 * (r + 1))
+outs = hvd.grouped_allreduce([g1, g2], op=hvd.Sum)
+assert np.allclose(outs[0].numpy(), s * (s + 1) / 2.0)
+assert np.allclose(outs[1].numpy(), s * (s + 1))
+t1, t2 = g1.clone(), g2.clone()
+hvd.grouped_allreduce_([t1, t2], op=hvd.Average,
+                       compression=hvd.Compression.fp16)
+assert np.allclose(t1.numpy(), (s + 1) / 2.0, atol=1e-2), t1.numpy()
+assert np.allclose(t2.numpy(), s + 1.0, atol=1e-2), t2.numpy()
+
+# num_groups + fp16 compression on the optimizer: the hook path must stay
+# native (wire cast in csrc/torch_ops.cc), never the numpy bridge
+model_ng = torch.nn.Sequential(torch.nn.Linear(4, 8),
+                               torch.nn.Linear(8, 1))
+for q in model_ng.parameters():
+    q.data.fill_(0.25)
+opt_ng = hvd.DistributedOptimizer(
+    torch.optim.SGD(model_ng.parameters(), lr=0.05),
+    compression=hvd.Compression.fp16, num_groups=2)
+x_ng = torch.full((4, 4), float(r + 1))
+for _ in range(2):
+    opt_ng.zero_grad()
+    model_ng(x_ng).sum().backward()
+    opt_ng.step()
+if expect_native:
+    assert opt_ng._hvd_stats["native"] > 0, opt_ng._hvd_stats
+    assert opt_ng._hvd_stats["bridge"] == 0, opt_ng._hvd_stats
+else:
+    assert opt_ng._hvd_stats["native"] == 0, opt_ng._hvd_stats
+for i, q in enumerate(model_ng.parameters()):
+    ref = hvd.broadcast(q.data, root_rank=0)
+    assert np.allclose(q.data.numpy(), ref.numpy(), atol=1e-6), \
+        f"num_groups param {i} diverged"
+
+# a custom compressor must take the bridge (the native wire cast would
+# silently skip its compress/decompress)
+class _Doubling(hvd.Compression.fp16):
+    @staticmethod
+    def compress(tensor):
+        out, ctx = hvd.Compression.fp16.compress(tensor)
+        return out, ctx
+
+opt_cc = hvd.DistributedOptimizer(
+    torch.optim.SGD([torch.nn.Parameter(torch.ones(3))], lr=0.1),
+    compression=_Doubling)
+p_cc = opt_cc.param_groups[0]["params"][0]
+p_cc.grad = torch.full((3,), float(r + 1))
+opt_cc._hvd_hook(p_cc)
+opt_cc.synchronize()
+assert opt_cc._hvd_stats["bridge"] == 1, opt_cc._hvd_stats
+assert np.allclose(p_cc.grad.numpy(), (s + 1) / 2.0, atol=1e-2)
+
 print(f"rank {r}: TORCH PASS", flush=True)
 hvd.shutdown()
