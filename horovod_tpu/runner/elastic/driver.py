@@ -69,6 +69,7 @@ class ElasticDriver:
         self._wind_down_failed = False
         self._wind_down_since = None
         self.ssh_port = None
+        self.remote_shell = None  # None/"ssh" or "blaunch" (LSF)
         # Per-epoch jax.distributed coordination services (driver-hosted so
         # a worker death can never take the service down — see
         # horovod_tpu/jax/distributed.py). Old epochs' services are kept
@@ -112,17 +113,28 @@ class ElasticDriver:
 
             s = _S()
             s.hostname = hostname
-            # The HMAC secret rides stdin, never argv: the ssh command line
-            # is visible to every local user (ps) on both hosts.
+            # The HMAC secret never rides argv (visible to every local
+            # user via ps on both hosts): ssh delivers it over stdin;
+            # blaunch propagates the caller's environment instead (no
+            # stdin guarantee — see launch.get_remote_command).
             cmd = get_remote_command(s, self.command, {
                 k: v for k, v in env.items()
                 if k.startswith(("HVD_", "PYTHONPATH", "PATH", "TPU_"))},
                 ssh_port=self.ssh_port,
-                stdin_env=("HVD_RENDEZVOUS_SECRET",))
-            proc = util.safe_exec(["/bin/sh", "-c", cmd],
-                                  env=dict(os.environ),
-                                  stdin=subprocess.PIPE)
-            util.send_stdin_line(proc, env["HVD_RENDEZVOUS_SECRET"].encode())
+                stdin_env=("HVD_RENDEZVOUS_SECRET",),
+                remote_shell=self.remote_shell)
+            if self.remote_shell == "blaunch":
+                spawn_env = dict(os.environ)
+                spawn_env["HVD_RENDEZVOUS_SECRET"] = \
+                    env["HVD_RENDEZVOUS_SECRET"]
+                proc = util.safe_exec(["/bin/sh", "-c", cmd],
+                                      env=spawn_env)
+            else:
+                proc = util.safe_exec(["/bin/sh", "-c", cmd],
+                                      env=dict(os.environ),
+                                      stdin=subprocess.PIPE)
+                util.send_stdin_line(proc,
+                                     env["HVD_RENDEZVOUS_SECRET"].encode())
         w = _Worker(wid, hostname, slot, proc, self.epoch + 1)
         self.workers[wid] = w
         self._log(f"spawned {wid}")
@@ -437,7 +449,15 @@ def run_elastic(args):
         discovery = FixedHosts({h.hostname: h.slots
                                 for h in parse_hosts(args.hosts)})
     else:
-        discovery = FixedHosts({"localhost": args.np or 1})
+        from .. import lsf
+
+        if lsf.in_lsf():
+            # bsub allocation with no explicit hosts: the membership
+            # comes from the scheduler env (same as the static path).
+            discovery = FixedHosts({h.hostname: h.slots
+                                    for h in lsf.host_slots()})
+        else:
+            discovery = FixedHosts({"localhost": args.np or 1})
     min_np = args.min_np or args.np or 1
     max_np = args.max_np or 0
     extra_env = args_to_env(args)
@@ -449,6 +469,7 @@ def run_elastic(args):
                                args.blacklist_cooldown_range)
                            if args.blacklist_cooldown_range else None)
     driver.ssh_port = args.ssh_port
+    driver.remote_shell = getattr(args, "remote_shell", None)
     try:
         return driver.run()
     finally:

@@ -36,6 +36,10 @@ def parse_args(argv=None):
     p.add_argument("--hostfile", dest="hostfile",
                    help="file with one 'host slots=N' per line")
     p.add_argument("--ssh-port", type=int, default=None)
+    p.add_argument("--remote-shell", dest="remote_shell",
+                   choices=["ssh", "blaunch"], default=None,
+                   help="remote spawn tool (default: ssh; blaunch "
+                        "auto-selected inside an LSF allocation)")
     p.add_argument("--start-timeout", type=int, default=None,
                    help="seconds to wait for ranks to register")
     p.add_argument("--verbose", action="store_true")
@@ -95,27 +99,52 @@ def _resolve_hosts(args):
     elif args.hosts:
         hs = hosts_mod.parse_hosts(args.hosts)
     else:
-        hs = [hosts_mod.HostInfo("localhost", args.np or 1)]
+        from . import lsf
+
+        if lsf.in_lsf():
+            # bsub allocation: hosts/slots come from the scheduler env
+            # (reference: horovodrun's LSF auto-detection, runner/util/
+            # lsf.py).
+            hs = lsf.host_slots()
+            if args.verbose:
+                print(f"tpurun: LSF allocation detected: "
+                      f"{','.join(f'{h.hostname}:{h.slots}' for h in hs)}",
+                      file=sys.stderr)
+        else:
+            hs = [hosts_mod.HostInfo("localhost", args.np or 1)]
     return hs
 
 
-def get_remote_command(slot, command, env, ssh_port=None, stdin_env=()):
-    """Assemble the per-slot ssh command (reference: gloo_run.py
+def get_remote_command(slot, command, env, ssh_port=None, stdin_env=(),
+                       remote_shell=None):
+    """Assemble the per-slot remote command (reference: gloo_run.py
     `get_remote_command` — env exported inline, command exec'd on host).
 
     Variables named in ``stdin_env`` are NOT placed on the command line
     (argv is world-readable via ps on both hosts — secrets must never ride
     it); the remote shell reads one line per variable from stdin instead,
     and the spawner writes the values there (see ElasticDriver._spawn).
+
+    ``remote_shell="blaunch"`` uses LSF's in-allocation remote-execution
+    tool instead of ssh (reference: the LSF/jsrun launch path). blaunch
+    gives the remote task the CALLER's environment (LSF's res propagates
+    it, like lsrun) but no stdin forwarding guarantee — so the
+    ``stdin_env`` variables still stay off argv, and the spawner exports
+    them into its own environment instead of writing stdin (see
+    _run_static / ElasticDriver._spawn).
     """
     env = {k: v for k, v in env.items() if k not in stdin_env}
     exports = " ".join(f"{k}={shlex.quote(str(v))}"
                        for k, v in sorted(env.items()))
-    reads = "".join(f"read -r {k} && export {k} && "
-                    for k in sorted(stdin_env))
+    reads = "" if remote_shell == "blaunch" else \
+        "".join(f"read -r {k} && export {k} && "
+                for k in sorted(stdin_env))
     inner = f"cd {shlex.quote(os.getcwd())} > /dev/null 2>&1 ; " \
             f"{reads}env {exports} " \
             f"{' '.join(shlex.quote(c) for c in command)}"
+    if remote_shell == "blaunch":
+        # blaunch offers no port option; it rides LSF's own daemons.
+        return f"blaunch {slot.hostname} {shlex.quote(inner)}"
     port = f"-p {ssh_port} " if ssh_port else ""
     return f"ssh -o PasswordAuthentication=no -o StrictHostKeyChecking=no " \
            f"{port}{slot.hostname} {shlex.quote(inner)}"
@@ -175,9 +204,20 @@ def _run_static(args):
                 cmd = get_remote_command(s, list(args.command), {
                     k: v for k, v in env.items()
                     if k.startswith(("HVD_", "PYTHONPATH", "PATH", "TPU_"))
-                }, args.ssh_port, stdin_env=("HVD_RENDEZVOUS_SECRET",))
+                }, args.ssh_port, stdin_env=("HVD_RENDEZVOUS_SECRET",),
+                    remote_shell=args.remote_shell)
+                spawn_env = dict(os.environ)
+                if args.remote_shell == "blaunch":
+                    # blaunch propagates the caller's environment to the
+                    # remote task (no stdin guarantee): the secret rides
+                    # the env, still never argv.
+                    spawn_env["HVD_RENDEZVOUS_SECRET"] = \
+                        env["HVD_RENDEZVOUS_SECRET"]
+                    procs.append(safe_exec(["/bin/sh", "-c", cmd],
+                                           env=spawn_env))
+                    continue
                 p = safe_exec(["/bin/sh", "-c", cmd],
-                              env=dict(os.environ), stdin=subprocess.PIPE)
+                              env=spawn_env, stdin=subprocess.PIPE)
                 util.send_stdin_line(
                     p, env["HVD_RENDEZVOUS_SECRET"].encode())
                 procs.append(p)
@@ -209,6 +249,13 @@ def _wait_all(procs, verbose=False):
 
 def run_commandline(argv=None):
     args = parse_args(argv)
+    from . import lsf
+
+    if args.remote_shell is None and lsf.in_lsf():
+        # In-allocation remote shell, regardless of whether hosts come
+        # from the scheduler env or an explicit -H/--hostfile subset
+        # (allocation nodes commonly refuse direct ssh).
+        args.remote_shell = "blaunch"
     if args.min_np is not None or args.max_np is not None \
             or args.host_discovery_script:
         from .elastic.driver import run_elastic

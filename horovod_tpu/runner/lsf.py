@@ -1,0 +1,73 @@
+"""LSF scheduler integration (reference: horovod/runner/util/lsf.py +
+js_run.py).
+
+Inside an LSF allocation (``bsub``), the hosts and slot counts are not
+given on the command line — they come from the scheduler's environment.
+`tpurun` auto-detects this (``in_lsf``) when neither ``-H`` nor
+``--hostfile`` is passed and builds the host list from, in order of
+preference:
+
+- ``LSB_DJOB_RANKFILE``: the launch node on the FIRST line, then one
+  hostname per allocated task slot — the first line is skipped
+  unconditionally (the reference's rankfile handling);
+- ``LSB_MCPU_HOSTS``: ``"host1 n1 host2 n2 ..."`` pairs of execution
+  hosts and their slot counts — used as-is;
+- ``LSB_HOSTS``: one execution hostname per slot, space-separated —
+  used as-is.
+
+Remote spawn uses ``blaunch`` — LSF's native remote-execution tool, the
+in-allocation equivalent of ssh — via ``--remote-shell blaunch``
+(auto-selected under LSF). The reference's ``js_run.py`` (jsrun) existed
+to start its MPI world on CORAL systems; this stack has no MPI world to
+start — every rank is an independent process wired by env — so blaunch
+covers the capability.
+"""
+import os
+from collections import OrderedDict
+
+from . import hosts as hosts_mod
+
+
+def in_lsf(env=None):
+    """True inside an LSF allocation (reference: LSFUtils.using_lsf)."""
+    env = env if env is not None else os.environ
+    return "LSB_JOBID" in env
+
+
+def _per_slot_hosts(env):
+    """The allocation as an ordered host-per-slot list."""
+    rankfile = env.get("LSB_DJOB_RANKFILE")
+    if rankfile and os.path.exists(rankfile):
+        with open(rankfile) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+        # First line = the launch node, not a task slot; skipped
+        # UNCONDITIONALLY (reference semantics) — no slot-count
+        # heuristics: a launch node that also hosts tasks appears again
+        # in the task lines below it.
+        return lines[1:]
+    mcpu = env.get("LSB_MCPU_HOSTS")
+    if mcpu:
+        toks = mcpu.split()
+        if len(toks) % 2 != 0:
+            raise ValueError(f"malformed LSB_MCPU_HOSTS: {mcpu!r}")
+        out = []
+        for h, n in zip(toks[::2], toks[1::2]):
+            out.extend([h] * int(n))
+        return out
+    lsb_hosts = env.get("LSB_HOSTS")
+    if lsb_hosts:
+        return lsb_hosts.split()
+    raise ValueError(
+        "LSF allocation detected (LSB_JOBID set) but none of "
+        "LSB_DJOB_RANKFILE / LSB_MCPU_HOSTS / LSB_HOSTS is usable")
+
+
+def host_slots(env=None):
+    """``[HostInfo(host, slots)]`` for the allocation (execution hosts
+    with their task-slot counts; see the module docstring for how each
+    env form is read)."""
+    env = env if env is not None else os.environ
+    counts = OrderedDict()
+    for h in _per_slot_hosts(env):
+        counts[h] = counts.get(h, 0) + 1
+    return [hosts_mod.HostInfo(h, n) for h, n in counts.items()]

@@ -311,3 +311,132 @@ def test_tpu_chip_binding(monkeypatch):
     assert all(e.get("TPU_VISIBLE_CHIPS") != "0" or
                e.get("TPU_VISIBLE_CHIPS") != "1" for e in envs)
     assert all("TPU_VISIBLE_CHIPS" not in e for e in envs)
+
+
+# -- LSF integration (reference: runner/util/lsf.py + js_run.py) ------------
+
+def test_lsf_host_parsing(tmp_path):
+    """All three LSF env forms parse to (host, slots); the rankfile's
+    first line (the launch node) is skipped unconditionally — reference
+    semantics, no slot-count heuristics."""
+    from horovod_tpu.runner import lsf
+
+    # rankfile: launch node first, then one host per task slot
+    rf = tmp_path / "rankfile"
+    rf.write_text("mgmt01\nnode1\nnode1\nnode2\nnode2\n")
+    env = {"LSB_JOBID": "7", "LSB_DJOB_RANKFILE": str(rf)}
+    assert lsf.in_lsf(env)
+    hs = lsf.host_slots(env)
+    assert [(h.hostname, h.slots) for h in hs] == [("node1", 2),
+                                                   ("node2", 2)]
+
+    # launch node ALSO hosting tasks: its batch line is skipped, its
+    # task lines are kept
+    rf.write_text("node1\nnode1\nnode1\nnode2\nnode2\n")
+    hs = lsf.host_slots(env)
+    assert [(h.hostname, h.slots) for h in hs] == [("node1", 2),
+                                                   ("node2", 2)]
+
+    # MCPU pairs are execution hosts — used as-is (span[ptile=1] shape:
+    # one slot per host must not lose its first host)
+    env = {"LSB_JOBID": "7", "LSB_MCPU_HOSTS": "node1 1 node2 1"}
+    hs = lsf.host_slots(env)
+    assert [(h.hostname, h.slots) for h in hs] == [("node1", 1),
+                                                   ("node2", 1)]
+
+    # LSB_HOSTS per-slot list — used as-is
+    env = {"LSB_JOBID": "7", "LSB_HOSTS": "node1 node1 node2 node2"}
+    hs = lsf.host_slots(env)
+    assert [(h.hostname, h.slots) for h in hs] == [("node1", 2),
+                                                   ("node2", 2)]
+
+    assert not lsf.in_lsf({})
+
+
+def test_lsf_autodetect_runs_job(tmp_path, monkeypatch):
+    """Inside a (faked) LSF allocation whose compute slots are localhost,
+    `tpurun` with NO -H/-np runs the job end-to-end from the scheduler
+    env alone."""
+    import sys
+
+    import horovod_tpu.runner.launch as launch_mod
+
+    rf = tmp_path / "rankfile"
+    rf.write_text("mgmt01\nlocalhost\nlocalhost\n")
+    monkeypatch.setenv("LSB_JOBID", "42")
+    monkeypatch.setenv("LSB_DJOB_RANKFILE", str(rf))
+    out = tmp_path / "ranks.txt"
+    script = tmp_path / "job.py"
+    script.write_text(
+        "import os\n"
+        "import horovod_tpu as hvd\n"
+        "import numpy as np\n"
+        "hvd.init()\n"
+        "s = float(hvd.allreduce(np.ones(2, np.float32),"
+        " op=hvd.Sum)[0])\n"
+        f"open({str(out)!r}, 'a').write("
+        "f'{hvd.rank()}/{hvd.size()}:{s}\\n')\n"
+        "hvd.shutdown()\n")
+    rc = launch_mod.run_commandline(
+        ["--verbose", sys.executable, str(script)])
+    assert rc == 0
+    lines = sorted(out.read_text().split())
+    assert lines == ["0/2:2.0", "1/2:2.0"], lines
+
+
+def test_lsf_blaunch_remote_command(monkeypatch, tmp_path):
+    """Remote slots under LSF spawn via blaunch (LSF's in-allocation
+    remote shell), not ssh; auto-selected, overridable."""
+    import horovod_tpu.runner.launch as launch_mod
+
+    s = hosts.SlotInfo("node7", 1, 2, 0, 1, 1, 2)
+    cmd = get_remote_command(s, ["python", "train.py"],
+                             {"HVD_RANK": "1"}, remote_shell="blaunch")
+    assert cmd.startswith("blaunch node7 ")
+    assert "HVD_RANK=1" in cmd and "python train.py" in cmd
+
+    rf = tmp_path / "rankfile"
+    rf.write_text("mgmt01\nnodeA\nnodeB\n")
+    monkeypatch.setenv("LSB_JOBID", "42")
+    monkeypatch.setenv("LSB_DJOB_RANKFILE", str(rf))
+
+    spawned = []
+
+    class _P:
+        stdin = None
+
+        def poll(self):
+            return 0
+
+    def fake_safe_exec(command, env=None, **kw):
+        p = _P()
+
+        class _Stdin:
+            def write(self, b):
+                pass
+
+            def flush(self):
+                pass
+
+            def close(self):
+                pass
+
+        p.stdin = _Stdin()
+        spawned.append((command, env or {}))
+        return p
+
+    monkeypatch.setattr(launch_mod, "safe_exec", fake_safe_exec)
+    monkeypatch.setattr(launch_mod, "terminate", lambda p: None)
+    monkeypatch.setattr(launch_mod.util, "send_stdin_line",
+                        lambda p, b: None)
+    rc = launch_mod.run_commandline(["python", "train.py"])
+    assert rc == 0
+    shells = [c[2] for c, _ in spawned]
+    assert len(shells) == 2
+    assert all(sh.startswith("blaunch node") for sh in shells), shells
+    for sh, env in zip(shells, (e for _, e in spawned)):
+        # no stdin protocol under blaunch, and the secret stays off argv:
+        # it rides the propagated caller environment instead
+        assert "read -r" not in sh, sh
+        assert "HVD_RENDEZVOUS_SECRET" not in sh, sh
+        assert env.get("HVD_RENDEZVOUS_SECRET"), "secret must ride env"
