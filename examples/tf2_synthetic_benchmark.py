@@ -3,6 +3,13 @@ examples/tensorflow2/tensorflow2_synthetic_benchmark.py): a small Keras
 model trained with DistributedGradientTape; rank 0 reports samples/sec.
 
 Run: tpurun -np 4 python examples/tf2_synthetic_benchmark.py
+
+With HVD_ENABLE_XLA_OPS=1 in the environment, JIT=1 compiles the whole
+train step — collectives included — under XLA
+(tf.function(jit_compile=True) via csrc/tf_xla_ops.cc):
+
+    HVD_ENABLE_XLA_OPS=1 JIT=1 tpurun -np 4 \\
+        python examples/tf2_synthetic_benchmark.py
 """
 import os
 import time
@@ -18,6 +25,7 @@ r, s = hvd.rank(), hvd.size()
 BATCH = int(os.environ.get("BATCH", 32))
 STEPS = int(os.environ.get("STEPS", 20))
 DIM = int(os.environ.get("DIM", 128))
+JIT = os.environ.get("JIT", "0") == "1"
 
 model = tf.keras.Sequential([
     tf.keras.layers.Dense(DIM, activation="relu"),
@@ -30,7 +38,7 @@ x = tf.constant(rng.normal(size=(BATCH, DIM)), tf.float32)
 y = tf.constant(rng.normal(size=(BATCH, 1)), tf.float32)
 
 
-@tf.function
+@tf.function(jit_compile=JIT or None)
 def step():
     with tf.GradientTape() as tape:
         loss = tf.reduce_mean((model(x) - y) ** 2)

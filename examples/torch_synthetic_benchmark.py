@@ -3,6 +3,13 @@ examples/pytorch/pytorch_synthetic_benchmark.py): hook-based
 DistributedOptimizer overlaps gradient allreduce with backward.
 
 Run: tpurun -np 4 python examples/torch_synthetic_benchmark.py
+
+NUM_GROUPS=2 submits the gradients as atomic groups through one native
+C++ crossing each; FP16=1 compresses them to fp16 on the wire (both stay
+on the native extension — csrc/torch_ops.cc):
+
+    NUM_GROUPS=2 FP16=1 tpurun -np 4 \\
+        python examples/torch_synthetic_benchmark.py
 """
 import os
 import time
@@ -16,6 +23,8 @@ r, s = hvd.rank(), hvd.size()
 BATCH = int(os.environ.get("BATCH", 32))
 STEPS = int(os.environ.get("STEPS", 20))
 DIM = int(os.environ.get("DIM", 128))
+NUM_GROUPS = int(os.environ.get("NUM_GROUPS", 0))
+FP16 = os.environ.get("FP16", "0") == "1"
 
 torch.manual_seed(0)
 model = torch.nn.Sequential(
@@ -24,7 +33,9 @@ hvd.broadcast_parameters(model.state_dict(), root_rank=0)
 
 opt = hvd.DistributedOptimizer(
     torch.optim.SGD(model.parameters(), lr=0.01),
-    named_parameters=model.named_parameters())
+    named_parameters=model.named_parameters(),
+    num_groups=NUM_GROUPS,
+    compression=hvd.Compression.fp16 if FP16 else None)
 
 torch.manual_seed(r)
 x = torch.randn(BATCH, DIM)

@@ -104,37 +104,23 @@ class ElasticDriver:
         if is_local(hostname):
             proc = util.safe_exec(self.command, env=env)
         else:
-            import subprocess
-
-            from ..launch import get_remote_command
+            from ..launch import get_remote_command, spawn_remote
 
             class _S:  # SlotInfo stand-in for hostname only
                 pass
 
             s = _S()
             s.hostname = hostname
-            # The HMAC secret never rides argv (visible to every local
-            # user via ps on both hosts): ssh delivers it over stdin;
-            # blaunch propagates the caller's environment instead (no
-            # stdin guarantee — see launch.get_remote_command).
+            # Secret delivery (never argv) is shared with the static
+            # launcher: ssh → stdin, blaunch → propagated caller env.
             cmd = get_remote_command(s, self.command, {
                 k: v for k, v in env.items()
                 if k.startswith(("HVD_", "PYTHONPATH", "PATH", "TPU_"))},
                 ssh_port=self.ssh_port,
                 stdin_env=("HVD_RENDEZVOUS_SECRET",),
                 remote_shell=self.remote_shell)
-            if self.remote_shell == "blaunch":
-                spawn_env = dict(os.environ)
-                spawn_env["HVD_RENDEZVOUS_SECRET"] = \
-                    env["HVD_RENDEZVOUS_SECRET"]
-                proc = util.safe_exec(["/bin/sh", "-c", cmd],
-                                      env=spawn_env)
-            else:
-                proc = util.safe_exec(["/bin/sh", "-c", cmd],
-                                      env=dict(os.environ),
-                                      stdin=subprocess.PIPE)
-                util.send_stdin_line(proc,
-                                     env["HVD_RENDEZVOUS_SECRET"].encode())
+            proc = spawn_remote(cmd, env["HVD_RENDEZVOUS_SECRET"],
+                                remote_shell=self.remote_shell)
         w = _Worker(wid, hostname, slot, proc, self.epoch + 1)
         self.workers[wid] = w
         self._log(f"spawned {wid}")

@@ -150,6 +150,25 @@ def get_remote_command(slot, command, env, ssh_port=None, stdin_env=(),
            f"{port}{slot.hostname} {shlex.quote(inner)}"
 
 
+def spawn_remote(cmd, secret, remote_shell=None):
+    """Spawn an assembled remote command with the secret-delivery protocol
+    matching the shell: ssh reads HVD_RENDEZVOUS_SECRET from stdin (the
+    command carries a `read -r` prefix); blaunch propagates the caller's
+    environment to the remote task (no stdin guarantee), so the secret
+    rides the spawn env. Either way it never touches argv. One
+    implementation shared by the static launcher and ElasticDriver."""
+    import subprocess
+
+    spawn_env = dict(os.environ)
+    if remote_shell == "blaunch":
+        spawn_env["HVD_RENDEZVOUS_SECRET"] = secret
+        return safe_exec(["/bin/sh", "-c", cmd], env=spawn_env)
+    p = safe_exec(["/bin/sh", "-c", cmd], env=spawn_env,
+                  stdin=subprocess.PIPE)
+    util.send_stdin_line(p, secret.encode())
+    return p
+
+
 def _slot_extra_env(args):
     env = config_parser.args_to_env(args)
     if args.verbose:
@@ -199,28 +218,14 @@ def _run_static(args):
             if hosts_mod.is_local(s.hostname):
                 procs.append(safe_exec(list(args.command), env=env))
             else:
-                import subprocess
-
                 cmd = get_remote_command(s, list(args.command), {
                     k: v for k, v in env.items()
                     if k.startswith(("HVD_", "PYTHONPATH", "PATH", "TPU_"))
                 }, args.ssh_port, stdin_env=("HVD_RENDEZVOUS_SECRET",),
                     remote_shell=args.remote_shell)
-                spawn_env = dict(os.environ)
-                if args.remote_shell == "blaunch":
-                    # blaunch propagates the caller's environment to the
-                    # remote task (no stdin guarantee): the secret rides
-                    # the env, still never argv.
-                    spawn_env["HVD_RENDEZVOUS_SECRET"] = \
-                        env["HVD_RENDEZVOUS_SECRET"]
-                    procs.append(safe_exec(["/bin/sh", "-c", cmd],
-                                           env=spawn_env))
-                    continue
-                p = safe_exec(["/bin/sh", "-c", cmd],
-                              env=spawn_env, stdin=subprocess.PIPE)
-                util.send_stdin_line(
-                    p, env["HVD_RENDEZVOUS_SECRET"].encode())
-                procs.append(p)
+                procs.append(spawn_remote(
+                    cmd, env["HVD_RENDEZVOUS_SECRET"],
+                    remote_shell=args.remote_shell))
         return _wait_all(procs, verbose=args.verbose)
     finally:
         for p in procs:

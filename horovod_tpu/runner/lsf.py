@@ -29,9 +29,13 @@ from . import hosts as hosts_mod
 
 
 def in_lsf(env=None):
-    """True inside an LSF allocation (reference: LSFUtils.using_lsf)."""
+    """True inside an LSF allocation with a usable host list (reference:
+    LSFUtils.using_lsf requires the host variables too — a leaked
+    LSB_JOBID alone must not hijack the localhost launch path)."""
     env = env if env is not None else os.environ
-    return "LSB_JOBID" in env
+    return "LSB_JOBID" in env and any(
+        k in env for k in ("LSB_DJOB_RANKFILE", "LSB_MCPU_HOSTS",
+                           "LSB_HOSTS"))
 
 
 def _per_slot_hosts(env):

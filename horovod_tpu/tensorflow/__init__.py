@@ -303,6 +303,14 @@ def broadcast_variables(variables, root_rank=0):
         v.assign(_core.synchronize(h))
 
 
+def broadcast_global_variables(root_rank=0):
+    """TF1-style alias over the v1 global-variables collection
+    (reference: hvd.broadcast_global_variables)."""
+    tf = _tf()
+    return broadcast_variables(tf.compat.v1.global_variables(),
+                               root_rank=root_rank)
+
+
 def DistributedGradientTape(tape, op=Average, compression=None,
                             process_set=0, sparse_as_dense=False,
                             num_groups=0, gradient_predivide_factor=1.0):

@@ -14,11 +14,10 @@ absence).
 """
 import os
 
-from .util import run_single
+from .util import run_single, tpu_isolated_env
 
 _SHIMS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "shims")
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_PP = {"PYTHONPATH": _REPO + os.pathsep + _SHIMS}
+_PP = tpu_isolated_env(_SHIMS)
 
 
 def test_spark_run_barrier_stage():

@@ -9,6 +9,8 @@ import sys
 import threading
 import time
 
+from .util import tpu_isolated_env
+
 WORKER = os.path.join(os.path.dirname(__file__), "workers",
                       "elastic_train_worker.py")
 MESH_WORKER = os.path.join(os.path.dirname(__file__), "workers",
@@ -23,7 +25,9 @@ def _run_elastic(tmp_path, hosts_initial, extra_env, min_np, max_np,
     hosts_file.write_text(hosts_initial + "\n")
     log_file = tmp_path / "final.log"
     env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # Repo-only PYTHONPATH + CPU jax: the single off-the-real-TPU policy
+    # (tests/util.tpu_isolated_env) for every spawned test process.
+    env.update(tpu_isolated_env())
     env["TEST_LOG"] = str(log_file)
     env.update(extra_env)
 

@@ -66,11 +66,12 @@ def test_mxnet_binding_2proc():
     installable here; see README descope note)."""
     import os
 
+    from .util import tpu_isolated_env
+
     shims = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "shims")
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     run_worker_job(2, "mxnet_worker.py", timeout=120,
-                   extra_env={"PYTHONPATH": repo + os.pathsep + shims})
+                   extra_env=tpu_isolated_env(shims))
 
 
 def test_mxnet_binding_import_surface():

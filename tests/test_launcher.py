@@ -246,10 +246,21 @@ def test_kv_store_wait_rendezvous():
 
 # -- end-to-end localhost launch -------------------------------------------
 
-def test_tpurun_localhost(tmp_path):
+def _worker_pythonpath(monkeypatch):
+    """Spawned launcher ranks must NOT inherit the session's site-hook
+    PYTHONPATH (it would register the real TPU platform inside every
+    worker — tests/util.tpu_isolated_env is the single policy)."""
+    from .util import tpu_isolated_env
+
+    for k, v in tpu_isolated_env().items():
+        monkeypatch.setenv(k, v)
+
+
+def test_tpurun_localhost(tmp_path, monkeypatch):
     """Full CLI path: tpurun -np 2 on localhost, real collective."""
     from horovod_tpu.runner.launch import run_commandline
 
+    _worker_pythonpath(monkeypatch)
     script = tmp_path / "w.py"
     script.write_text(textwrap.dedent("""\
         import numpy as np
@@ -361,6 +372,7 @@ def test_lsf_autodetect_runs_job(tmp_path, monkeypatch):
 
     import horovod_tpu.runner.launch as launch_mod
 
+    _worker_pythonpath(monkeypatch)
     rf = tmp_path / "rankfile"
     rf.write_text("mgmt01\nlocalhost\nlocalhost\n")
     monkeypatch.setenv("LSB_JOBID", "42")
@@ -378,7 +390,7 @@ def test_lsf_autodetect_runs_job(tmp_path, monkeypatch):
         "f'{hvd.rank()}/{hvd.size()}:{s}\\n')\n"
         "hvd.shutdown()\n")
     rc = launch_mod.run_commandline(
-        ["--verbose", sys.executable, str(script)])
+        ["--verbose", "--no-stall-check", sys.executable, str(script)])
     assert rc == 0
     lines = sorted(out.read_text().split())
     assert lines == ["0/2:2.0", "1/2:2.0"], lines

@@ -9,6 +9,16 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKERS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "workers")
 
 
+def tpu_isolated_env(*extra_paths):
+    """Env pinning spawned test processes OFF the real TPU: repo-only
+    PYTHONPATH (a session site hook there would register the tunneled
+    TPU platform in every child) and the CPU jax platform. The single
+    policy for every harness that spawns workers — run_worker_job,
+    run_single, the launcher e2e tests, the elastic harness."""
+    path = os.pathsep.join((_REPO,) + tuple(extra_paths))
+    return {"PYTHONPATH": path, "JAX_PLATFORMS": "cpu"}
+
+
 def run_worker_job(np_, worker_file, extra_env=None, timeout=120,
                    jax_coord=False):
     """Launch `worker_file` as an np_-rank job; assert every rank exits 0.
@@ -18,9 +28,7 @@ def run_worker_job(np_, worker_file, extra_env=None, timeout=120,
     """
     from horovod_tpu.runner.local import run_local
 
-    env = {"PYTHONPATH": _REPO}
-    # Workers are plain-python (no JAX); keep them off any real TPU.
-    env["JAX_PLATFORMS"] = "cpu"
+    env = tpu_isolated_env()
     if extra_env:
         env.update(extra_env)
     codes = run_local(

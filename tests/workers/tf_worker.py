@@ -82,6 +82,10 @@ for i, v in enumerate(model.variables):
 # metric average
 assert abs(hvd.metric_average(float(r)) - (s - 1) / 2.0) < 1e-9
 
+# v1-compat alias exists and is a no-op under eager TF2 (empty v1
+# global-variables collection)
+hvd.broadcast_global_variables(0)
+
 # DistributedOptimizer inside compiled model.fit (the graph path:
 # apply_gradients runs under tf.function and lowers via tf.py_function)
 tf.random.set_seed(200 + r)
