@@ -27,21 +27,42 @@ STEPS = int(os.environ.get("STEPS", 20))
 DIM = int(os.environ.get("DIM", 128))
 JIT = os.environ.get("JIT", "0") == "1"
 
-model = tf.keras.Sequential([
-    tf.keras.layers.Dense(DIM, activation="relu"),
-    tf.keras.layers.Dense(1),
-])
-opt = tf.keras.optimizers.SGD(0.01)
-
+# MODEL=resnet50 runs the reference benchmark's actual model
+# (tf.keras.applications.ResNet50 on synthetic images — the graded
+# "examples/tensorflow2 ResNet-50 + DistributedGradientTape" config);
+# default is a small Dense net so CI stays cheap.
+MODEL = os.environ.get("MODEL", "dense")
 rng = np.random.default_rng(r)
-x = tf.constant(rng.normal(size=(BATCH, DIM)), tf.float32)
-y = tf.constant(rng.normal(size=(BATCH, 1)), tf.float32)
+if MODEL == "resnet50":
+    IMG = int(os.environ.get("IMG", 224))
+    model = tf.keras.applications.ResNet50(weights=None,
+                                           input_shape=(IMG, IMG, 3),
+                                           classes=1000)
+    x = tf.constant(rng.normal(size=(BATCH, IMG, IMG, 3)), tf.float32)
+    y = tf.constant(rng.integers(0, 1000, (BATCH,)), tf.int64)
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(
+        from_logits=False)
+
+    def compute_loss():
+        return loss_fn(y, model(x, training=True))
+else:
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(DIM, activation="relu"),
+        tf.keras.layers.Dense(1),
+    ])
+    x = tf.constant(rng.normal(size=(BATCH, DIM)), tf.float32)
+    y = tf.constant(rng.normal(size=(BATCH, 1)), tf.float32)
+
+    def compute_loss():
+        return tf.reduce_mean((model(x) - y) ** 2)
+
+opt = tf.keras.optimizers.SGD(0.01)
 
 
 @tf.function(jit_compile=JIT or None)
 def step():
     with tf.GradientTape() as tape:
-        loss = tf.reduce_mean((model(x) - y) ** 2)
+        loss = compute_loss()
     tape = hvd.DistributedGradientTape(tape)
     grads = tape.gradient(loss, model.trainable_variables)
     opt.apply_gradients(zip(grads, model.trainable_variables))

@@ -27,8 +27,29 @@ NUM_GROUPS = int(os.environ.get("NUM_GROUPS", 0))
 FP16 = os.environ.get("FP16", "0") == "1"
 
 torch.manual_seed(0)
-model = torch.nn.Sequential(
-    torch.nn.Linear(DIM, DIM), torch.nn.ReLU(), torch.nn.Linear(DIM, 1))
+# MODEL=bert runs the reference's graded "pytorch BERT + grad
+# compression" pattern: a BERT masked-LM built from config (offline —
+# random init, no downloaded weights), trained with fp16-compressed
+# gradient allreduce. BERT_* env scale it from CI-tiny up to bert-large
+# (BERT_LAYERS=24 BERT_HIDDEN=1024 BERT_HEADS=16).
+MODEL = os.environ.get("MODEL", "mlp")
+if MODEL == "bert":
+    from transformers import BertConfig, BertForMaskedLM
+
+    SEQ = int(os.environ.get("SEQ", 128))
+    cfg = BertConfig(
+        vocab_size=30522,
+        hidden_size=int(os.environ.get("BERT_HIDDEN", 128)),
+        num_hidden_layers=int(os.environ.get("BERT_LAYERS", 2)),
+        num_attention_heads=int(os.environ.get("BERT_HEADS", 2)),
+        intermediate_size=4 * int(os.environ.get("BERT_HIDDEN", 128)),
+        max_position_embeddings=max(SEQ, 512))
+    model = BertForMaskedLM(cfg)
+else:
+    model = torch.nn.Sequential(
+        torch.nn.Linear(DIM, DIM), torch.nn.ReLU(),
+        torch.nn.Linear(DIM, 1))
+
 hvd.broadcast_parameters(model.state_dict(), root_rank=0)
 
 opt = hvd.DistributedOptimizer(
@@ -37,14 +58,25 @@ opt = hvd.DistributedOptimizer(
     num_groups=NUM_GROUPS,
     compression=hvd.Compression.fp16 if FP16 else None)
 
+# Per-rank data AFTER the rank seed: every rank must train on DIFFERENT
+# samples so the allreduce averages genuinely different gradients.
 torch.manual_seed(r)
-x = torch.randn(BATCH, DIM)
-y = torch.randn(BATCH, 1)
+if MODEL == "bert":
+    def run_batch():
+        tokens = torch.randint(0, cfg.vocab_size, (BATCH, SEQ))
+        out = model(input_ids=tokens, labels=tokens)
+        return out.loss
+else:
+    x = torch.randn(BATCH, DIM)
+    y = torch.randn(BATCH, 1)
+
+    def run_batch():
+        return torch.nn.functional.mse_loss(model(x), y)
 
 t0 = time.perf_counter()
 for _ in range(STEPS):
     opt.zero_grad()
-    loss = torch.nn.functional.mse_loss(model(x), y)
+    loss = run_batch()
     loss.backward()
     opt.step()
 dt = time.perf_counter() - t0

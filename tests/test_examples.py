@@ -1,0 +1,39 @@
+"""The graded benchmark examples run end-to-end tiny (BASELINE.json
+configs: "ResNet-50 + DistributedGradientTape" and "BERT +
+DistributedOptimizer (grad compression on)"). CI sizes are minimal; the
+same scripts scale to the real configs via env."""
+import os
+import sys
+
+import pytest
+
+from .util import tpu_isolated_env
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_EXAMPLES = os.path.join(_REPO, "examples")
+
+
+def _run_example(script, extra_env, timeout=420):
+    from horovod_tpu.runner.local import run_local
+
+    env = tpu_isolated_env()
+    env.update({k: str(v) for k, v in extra_env.items()})
+    # run_local (not a bare subprocess): on a hang it terminates the whole
+    # rank group instead of orphaning spinning workers.
+    codes = run_local(2, [sys.executable, os.path.join(_EXAMPLES, script)],
+                      env=env, timeout=timeout)
+    assert codes == [0, 0], codes
+
+
+def test_tf2_resnet50_graded_config():
+    pytest.importorskip("tensorflow")
+    _run_example("tf2_synthetic_benchmark.py",
+                 {"MODEL": "resnet50", "IMG": 32, "BATCH": 2, "STEPS": 2})
+
+
+def test_torch_bert_compression_graded_config():
+    pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    _run_example("torch_synthetic_benchmark.py",
+                 {"MODEL": "bert", "FP16": 1, "NUM_GROUPS": 2,
+                  "STEPS": 2, "BATCH": 2, "SEQ": 32})
