@@ -41,20 +41,21 @@ namespace hvd {
 namespace {
 
 // ---------------------------------------------------------------------------
-// Env helpers (reference: horovod/common/utils/env_parser.cc)
+// Env helpers (reference: horovod/common/utils/env_parser.cc).
+// EnvRaw (logging.h) supplies the HVD_ -> HOROVOD_ compat fallback.
 
 std::string EnvStr(const char* name, const std::string& dflt) {
-  const char* v = getenv(name);
+  const char* v = EnvRaw(name);
   return v ? std::string(v) : dflt;
 }
 
 double EnvDouble(const char* name, double dflt) {
-  const char* v = getenv(name);
+  const char* v = EnvRaw(name);
   return v ? atof(v) : dflt;
 }
 
 int64_t EnvInt(const char* name, int64_t dflt) {
-  const char* v = getenv(name);
+  const char* v = EnvRaw(name);
   return v ? atoll(v) : dflt;
 }
 
@@ -1020,7 +1021,11 @@ int hvd_init() {
     g->hierarchical = EnvInt("HVD_HIERARCHICAL_ALLREDUCE", 0) != 0;
     g->fusion_threshold =
         EnvInt("HVD_FUSION_THRESHOLD", 64 * 1024 * 1024);
-    g->cycle_time_ms = EnvDouble("HVD_CYCLE_TIME_MS", 1.0);
+    // HOROVOD_CYCLE_TIME is the reference's name for the same value
+    // (also milliseconds); the generic HVD_->HOROVOD_ fallback only
+    // covers identical suffixes.
+    g->cycle_time_ms = EnvDouble("HVD_CYCLE_TIME_MS",
+                                 EnvDouble("HOROVOD_CYCLE_TIME", 1.0));
     g->process_sets.InitGlobal(g->size);
     RegisterBackends(g->ops);
     g->cache.Configure(EnvInt("HVD_CACHE_CAPACITY", 1024));

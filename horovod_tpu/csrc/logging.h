@@ -11,6 +11,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <ctime>
+#include <string>
 
 namespace hvd {
 
@@ -26,11 +27,25 @@ inline LogLevel g_log_level = LogLevel::kWarn;
 inline bool g_log_timestamp = false;
 inline int g_log_rank = -1;
 
+// One copy of the HVD_ -> HOROVOD_ compat policy (docs/migrating.md):
+// every HVD_X tunable also answers to the reference's HOROVOD_X
+// spelling, HVD_X winning when both are set. Shared by core.cc's
+// EnvStr/EnvInt/EnvDouble and the logging init below.
+inline const char* EnvRaw(const char* name) {
+  const char* v = getenv(name);
+  if (v) return v;
+  if (strncmp(name, "HVD_", 4) == 0) {
+    std::string compat = std::string("HOROVOD_") + (name + 4);
+    return getenv(compat.c_str());
+  }
+  return nullptr;
+}
+
 inline void InitLoggingFromEnv(int rank) {
   g_log_rank = rank;
-  const char* ts = getenv("HVD_LOG_TIMESTAMP");
+  const char* ts = EnvRaw("HVD_LOG_TIMESTAMP");
   g_log_timestamp = ts && *ts && strcmp(ts, "0") != 0;
-  const char* lv = getenv("HVD_LOG_LEVEL");
+  const char* lv = EnvRaw("HVD_LOG_LEVEL");
   if (!lv) return;
   if (!strcmp(lv, "trace"))
     g_log_level = LogLevel::kTrace;

@@ -66,7 +66,11 @@ def _load_xla(tf):
     if _xla_loaded:
         return
     _xla_loaded = True
-    if os.environ.get("HVD_ENABLE_XLA_OPS", "0") != "1":
+    enabled = os.environ.get(
+        "HVD_ENABLE_XLA_OPS",
+        os.environ.get("HOROVOD_ENABLE_XLA_OPS", "0"))  # reference name
+    if enabled.strip().lower() not in ("1", "true", "yes"):
+        # upstream parses booleans loosely ("true" works there)
         return
     try:
         _make_under_lock("tfxla")

@@ -59,6 +59,18 @@ def test_response_cache_disabled():
                    extra_env={"HVD_CACHE_CAPACITY": "0"})
 
 
+def test_horovod_env_spelling_compat():
+    """The reference's HOROVOD_* env names configure the core via the
+    EnvRaw fallback (docs/migrating.md), with HVD_* taking precedence."""
+    from .util import run_single
+
+    run_single("horovod_env_worker.py", extra_env={
+        "HOROVOD_FUSION_THRESHOLD": str(8 * 1024 * 1024),
+        "HOROVOD_CYCLE_TIME": "3.0",
+        "HOROVOD_CACHE_CAPACITY": "64",
+    }, timeout=120, drop_prefixes=("HVD_", "HOROVOD_"))
+
+
 def test_autotune(tmp_path):
     """--autotune is live: GP+EI search moves fusion/cycle params on a
     synthetic stream, locks, and logs a CSV (reference:

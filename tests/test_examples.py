@@ -3,26 +3,18 @@ configs: "ResNet-50 + DistributedGradientTape" and "BERT +
 DistributedOptimizer (grad compression on)"). CI sizes are minimal; the
 same scripts scale to the real configs via env."""
 import os
-import sys
 
 import pytest
 
-from .util import tpu_isolated_env
+from .util import run_worker_job
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _EXAMPLES = os.path.join(_REPO, "examples")
 
 
 def _run_example(script, extra_env, timeout=420):
-    from horovod_tpu.runner.local import run_local
-
-    env = tpu_isolated_env()
-    env.update({k: str(v) for k, v in extra_env.items()})
-    # run_local (not a bare subprocess): on a hang it terminates the whole
-    # rank group instead of orphaning spinning workers.
-    codes = run_local(2, [sys.executable, os.path.join(_EXAMPLES, script)],
-                      env=env, timeout=timeout)
-    assert codes == [0, 0], codes
+    run_worker_job(2, os.path.join(_EXAMPLES, script),
+                   extra_env=extra_env, timeout=timeout)
 
 
 def test_tf2_resnet50_graded_config():

@@ -19,9 +19,17 @@ def tpu_isolated_env(*extra_paths):
     return {"PYTHONPATH": path, "JAX_PLATFORMS": "cpu"}
 
 
+def _worker_path(worker_file):
+    """Absolute path accepted as-is; bare names resolve to tests/workers."""
+    if os.path.isabs(worker_file):
+        return worker_file
+    return os.path.join(WORKERS, worker_file)
+
+
 def run_worker_job(np_, worker_file, extra_env=None, timeout=120,
                    jax_coord=False):
-    """Launch `worker_file` as an np_-rank job; assert every rank exits 0.
+    """Launch `worker_file` (bare name under tests/workers, or an absolute
+    script path) as an np_-rank job; assert every rank exits 0.
 
     ``jax_coord=True`` provisions a jax.distributed coordinator so the ranks
     form one global device mesh (the multi-process ICI-plane tests).
@@ -30,21 +38,28 @@ def run_worker_job(np_, worker_file, extra_env=None, timeout=120,
 
     env = tpu_isolated_env()
     if extra_env:
-        env.update(extra_env)
+        env.update({k: str(v) for k, v in extra_env.items()})
     codes = run_local(
-        np_, [sys.executable, os.path.join(WORKERS, worker_file)],
+        np_, [sys.executable, _worker_path(worker_file)],
         env=env, timeout=timeout, jax_coord=jax_coord,
     )
     assert codes == [0] * np_, f"worker exit codes: {codes}"
 
 
-def run_single(worker_file, extra_env=None, timeout=120):
+def run_single(worker_file, extra_env=None, timeout=120,
+               drop_prefixes=()):
+    """Run one worker process. ``drop_prefixes`` strips ambient env keys
+    (e.g. a developer's exported HVD_* tunables) that would otherwise
+    leak into a test asserting specific configuration."""
     env = dict(os.environ)
+    for k in list(env):
+        if any(k.startswith(p) for p in drop_prefixes):
+            del env[k]
     env["PYTHONPATH"] = _REPO
     if extra_env:
         env.update({k: str(v) for k, v in extra_env.items()})
     p = subprocess.run(
-        [sys.executable, os.path.join(WORKERS, worker_file)],
+        [sys.executable, _worker_path(worker_file)],
         env=env, timeout=timeout, capture_output=True, text=True,
     )
     assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr}"

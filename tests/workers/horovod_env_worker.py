@@ -1,0 +1,29 @@
+"""Worker: the reference's HOROVOD_* env spellings configure the core
+(docs/migrating.md — core.cc EnvRaw fallback). Launched with
+HOROVOD_FUSION_THRESHOLD / HOROVOD_CYCLE_TIME / HOROVOD_CACHE_CAPACITY
+set and no HVD_* equivalents; asserts the live parameters took them, and
+that HVD_* wins when both are present."""
+import os
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+r = hvd.rank()
+
+_, fusion, cycle = hvd.autotune_state()
+assert fusion == 8 * 1024 * 1024, fusion       # HOROVOD_FUSION_THRESHOLD
+assert abs(cycle - 3.0) < 1e-9, cycle          # HOROVOD_CYCLE_TIME (ms)
+out = hvd.allreduce(np.ones(4, np.float32) * (r + 1), op=hvd.Sum)
+assert np.allclose(out, hvd.size() * (hvd.size() + 1) / 2.0)
+hvd.shutdown()
+
+# precedence: HVD_* beats the compat spelling
+os.environ["HVD_CYCLE_TIME_MS"] = "7.0"
+hvd.init()
+_, _, cycle = hvd.autotune_state()
+assert abs(cycle - 7.0) < 1e-9, cycle
+hvd.shutdown()
+
+print(f"rank {r}: HOROVOD_* env compat PASS", flush=True)
