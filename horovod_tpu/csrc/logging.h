@@ -28,17 +28,23 @@ inline bool g_log_timestamp = false;
 inline int g_log_rank = -1;
 
 // One copy of the HVD_ -> HOROVOD_ compat policy (docs/migrating.md):
-// every HVD_X tunable also answers to the reference's HOROVOD_X
-// spelling, HVD_X winning when both are set. Shared by core.cc's
-// EnvStr/EnvInt/EnvDouble and the logging init below.
+// every HVD_X TUNABLE also answers to the reference's HOROVOD_X
+// spelling, HVD_X winning when both are set. Topology/endpoint vars are
+// excluded: those describe THIS job's wiring (the launcher sets them),
+// and honoring an ambient HOROVOD_RANK/SIZE from an old job script
+// would hijack single-process init into waiting for phantom peers.
 inline const char* EnvRaw(const char* name) {
   const char* v = getenv(name);
   if (v) return v;
-  if (strncmp(name, "HVD_", 4) == 0) {
-    std::string compat = std::string("HOROVOD_") + (name + 4);
-    return getenv(compat.c_str());
-  }
-  return nullptr;
+  if (strncmp(name, "HVD_", 4) != 0) return nullptr;
+  static const char* kNoCompat[] = {
+      "HVD_RANK", "HVD_SIZE", "HVD_LOCAL_RANK", "HVD_LOCAL_SIZE",
+      "HVD_CROSS_RANK", "HVD_CROSS_SIZE", "HVD_CONTROLLER_ADDR",
+      "HVD_START_TIMEOUT"};
+  for (const char* n : kNoCompat)
+    if (strcmp(name, n) == 0) return nullptr;
+  std::string compat = std::string("HOROVOD_") + (name + 4);
+  return getenv(compat.c_str());
 }
 
 inline void InitLoggingFromEnv(int rank) {

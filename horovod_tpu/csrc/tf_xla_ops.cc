@@ -9,13 +9,17 @@
 // negotiation thread → fused TCP plane → wait), exactly like the
 // AsyncOpKernels in tf_ops.cc do from their closure threads.
 //
-// Coverage is allreduce + broadcast: the shape-preserving collectives (XLA
-// needs static shapes; allgather/alltoall are dynamically shaped by design
-// and stay eager/graph-mode — the reference's XLA file covers allreduce
-// only). Metadata (name, op, scales, process set) is serialized into a
-// trailing u8 constant operand because XLA:CPU's legacy custom-call ABI
-// does not deliver the `opaque` string (the thunk calls
-// `target(out, ins, status)`).
+// Coverage is allreduce, broadcast, allgather and reducescatter (the
+// reference's XLA file covers allreduce only). XLA needs static shapes:
+// the shape-preserving ops are trivial; the gather family derives its
+// output dim0 at TRACE time from the process-set size (uniform shards),
+// bakes it into the metadata, and the call target validates the core's
+// ACTUAL result shape against it — a ragged or resized-set execution
+// fails the program instead of mis-copying. alltoall stays eager/graph
+// (its splits are runtime data). Metadata (name, op, scales, process
+// set, expected shape) is serialized into a trailing u8 constant operand
+// because XLA:CPU's legacy custom-call ABI does not deliver the `opaque`
+// string (the thunk calls `target(out, ins, status)`).
 //
 // Built as a separate library (`make tfxla`) and loaded by
 // tensorflow/native_ops.py only when HVD_ENABLE_XLA_OPS=1, mirroring the
@@ -39,6 +43,7 @@
 #include "xla/service/custom_call_status.h"
 #include "xla/service/custom_call_target_registry.h"
 
+#include "common.h"
 #include "tf_dtype.h"
 
 // C API of libhvd_tpu.so (signatures mirror horovod_tpu/basics.py).
@@ -50,8 +55,19 @@ int hvd_allreduce_async(const char* name, const void* in, void* out,
 int hvd_broadcast_async(const char* name, const void* in, void* out,
                         const long long* shape, int ndim, int dtype,
                         int root, int process_set);
+int hvd_allgather_async(const char* name, const void* in,
+                        const long long* shape, int ndim, int dtype,
+                        int process_set, int group_id, int group_size);
+int hvd_reducescatter_async(const char* name, const void* in,
+                            const long long* shape, int ndim, int dtype,
+                            int red_op, double prescale, double postscale,
+                            int process_set, int group_id, int group_size);
 int hvd_wait(int handle);
 void hvd_release(int handle);
+int hvd_output_ndim(int handle);
+int hvd_output_shape(int handle, long long* out);
+const void* hvd_output_ptr(int handle);
+int hvd_process_set_size(int id);
 const char* hvd_last_error();
 }
 
@@ -83,12 +99,23 @@ using ::hvd_tf::DtypeCode;
 // Metadata blob: compile-time op parameters serialized into a u8[] constant
 // operand (XLA:CPU drops `opaque`; shapes are static under XLA so they can
 // ride the blob). Layout, little-endian, no padding:
-//   i32 kind (0=allreduce 1=broadcast), i32 dtype, i32 ndim,
-//   i64 dims[ndim], i32 red_op_or_root, f64 prescale, f64 postscale,
-//   i32 process_set, i32 name_len, char name[name_len]
+//   i32 kind (0=allreduce 1=broadcast 2=allgather 3=reducescatter),
+//   i32 dtype, i32 ndim, i64 dims[ndim], i32 red_op_or_root,
+//   f64 prescale, f64 postscale, i32 process_set,
+//   i64 out_dim0 (gather family: the COMPILED output's dim0 — the
+//   buffer size the program was built with; -1 otherwise),
+//   i32 name_len, char name[name_len]
 
 constexpr int kAllreduce = 0;
 constexpr int kBroadcast = 1;
+// Gather-family kinds: dynamically shaped in eager/graph mode, but under
+// XLA the output shape is fixed at TRACE time from the process-set size
+// (uniform shards) — the call target validates the core's ACTUAL result
+// shape against the compiled one and fails the status on mismatch, so a
+// ragged allgather can never silently mis-copy (beyond the reference,
+// whose xla_mpi_ops.cc covers allreduce only).
+constexpr int kAllgather = 2;
+constexpr int kReducescatter = 3;
 
 void AppendRaw(std::vector<uint8_t>* buf, const void* p, size_t n) {
   const uint8_t* b = reinterpret_cast<const uint8_t*>(p);
@@ -111,6 +138,7 @@ struct Meta {
   int32_t red_op_or_root = 0;
   double prescale = 1.0, postscale = 1.0;
   int32_t process_set = 0;
+  int64_t out_dim0 = -1;  // gather family: compiled output dim0
   std::string name;
 };
 
@@ -124,6 +152,7 @@ std::vector<uint8_t> PackMeta(const Meta& m) {
   AppendF64(&buf, m.prescale);
   AppendF64(&buf, m.postscale);
   AppendI32(&buf, m.process_set);
+  AppendI64(&buf, m.out_dim0);
   AppendI32(&buf, (int32_t)m.name.size());
   AppendRaw(&buf, m.name.data(), m.name.size());
   return buf;
@@ -156,6 +185,7 @@ Meta UnpackMeta(const uint8_t* p) {
   m.prescale = r.F64();
   m.postscale = r.F64();
   m.process_set = r.I32();
+  m.out_dim0 = r.I64();
   int32_t nlen = r.I32();
   m.name = r.Str(nlen);
   return m;
@@ -177,6 +207,7 @@ extern "C" void hvd_tpu_xla_collective(void* out, const void** ins,
                                        XlaCustomCallStatus* status) {
   Meta m = UnpackMeta(reinterpret_cast<const uint8_t*>(ins[1]));
   int h = -1;
+  bool core_owned_out = false;
   if (m.kind == kAllreduce) {
     h = hvd_allreduce_async(m.name.c_str(), ins[0], out, m.dims.data(),
                             (int)m.dims.size(), m.dtype, m.red_op_or_root,
@@ -185,6 +216,17 @@ extern "C" void hvd_tpu_xla_collective(void* out, const void** ins,
     h = hvd_broadcast_async(m.name.c_str(), ins[0], out, m.dims.data(),
                             (int)m.dims.size(), m.dtype, m.red_op_or_root,
                             m.process_set);
+  } else if (m.kind == kAllgather) {
+    h = hvd_allgather_async(m.name.c_str(), ins[0], m.dims.data(),
+                            (int)m.dims.size(), m.dtype, m.process_set,
+                            -1, 0);
+    core_owned_out = true;
+  } else if (m.kind == kReducescatter) {
+    h = hvd_reducescatter_async(m.name.c_str(), ins[0], m.dims.data(),
+                                (int)m.dims.size(), m.dtype,
+                                m.red_op_or_root, m.prescale, m.postscale,
+                                m.process_set, -1, 0);
+    core_owned_out = true;
   }
   if (h < 0) {
     const char* e = hvd_last_error();
@@ -195,6 +237,32 @@ extern "C" void hvd_tpu_xla_collective(void* out, const void** ins,
   if (rc != 1) {
     const char* e = hvd_last_error();
     Fail(status, e ? e : "unknown");
+    hvd_release(h);
+    return;
+  }
+  if (core_owned_out) {
+    // XLA's output buffer size is FIXED at the shape the program was
+    // COMPILED with (m.out_dim0 — not the runtime process-set size,
+    // which may have changed since the trace); if the actual result
+    // shape differs (ragged contributions, resized set), copying would
+    // corrupt memory — fail the program instead.
+    int ondim = hvd_output_ndim(h);
+    std::vector<long long> oshape(ondim > 0 ? ondim : 0);
+    if (ondim > 0) hvd_output_shape(h, oshape.data());
+    std::vector<long long> expect = m.dims;
+    expect[0] = m.out_dim0;
+    if (ondim != (int)expect.size() ||
+        !std::equal(expect.begin(), expect.end(), oshape.begin())) {
+      Fail(status,
+           "in-XLA allgather/reducescatter requires uniform shards: the "
+           "collective's actual output shape differs from the compiled "
+           "static shape (ragged inputs must use the eager/graph path)");
+      hvd_release(h);
+      return;
+    }
+    int64_t bytes = (int64_t)hvd::DataTypeSize((hvd::DataType)m.dtype);
+    for (long long d : oshape) bytes *= d;
+    if (bytes) memcpy(out, hvd_output_ptr(h), bytes);
   }
   hvd_release(h);
 }
@@ -214,11 +282,13 @@ TargetRegisterer target_registerer;
 // instead of rejecting the graph (reference: REGISTER_XLA_OP(
 // Name("HorovodAllreduce"), HVDAllreduceOp) in xla_mpi_ops.cc).
 
-xla::XlaOp EmitCollective(XlaOpKernelContext* ctx, const Meta& m) {
+xla::XlaOp EmitCollective(XlaOpKernelContext* ctx, const Meta& m,
+                          int64_t out_dim0 = -1) {
   xla::XlaBuilder* b = ctx->builder();
   xla::XlaOp x = ctx->Input(0);
   xla::XlaOp meta = xla::ConstantR1<uint8_t>(b, PackMeta(m));
   xla::Shape out_shape = b->GetShape(x).value();
+  if (out_dim0 >= 0) out_shape.set_dimensions(0, out_dim0);
   // has_side_effect: a collective must not be CSE'd or dead-code-eliminated
   // — every rank's program must enqueue it exactly once.
   return xla::CustomCall(
@@ -287,7 +357,92 @@ class HvdTpuBroadcastXlaOp : public XlaOpKernel {
   int root_, process_set_;
 };
 
+// Gather-family kernels: the op registry's shape functions leave dim0
+// unknown (runtime-sized in eager/graph mode), but XLA needs it static —
+// the kernels compile AFTER hvd.init(), so the process-set size is
+// available at trace time and uniform shards give dim0 exactly. The call
+// target validates the actual result shape (see hvd_tpu_xla_collective).
+
+class HvdTpuAllgatherXlaOp : public XlaOpKernel {
+ public:
+  explicit HvdTpuAllgatherXlaOp(OpKernelConstruction* c) : XlaOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set", &process_set_));
+  }
+
+  void Compile(XlaOpKernelContext* ctx) override {
+    Meta m;
+    m.kind = kAllgather;
+    m.dtype = DtypeCode(ctx->input_type(0));
+    OP_REQUIRES(ctx, m.dtype >= 0,
+                ::tensorflow::errors::Internal("unsupported dtype"));
+    TensorShape shape = ctx->InputShape(0);
+    OP_REQUIRES(ctx, shape.dims() >= 1,
+                ::tensorflow::errors::InvalidArgument(
+                    "in-XLA allgather needs >=1-dim input"));
+    for (int i = 0; i < shape.dims(); ++i) m.dims.push_back(shape.dim_size(i));
+    m.process_set = process_set_;
+    m.name = name_;
+    int p = hvd_process_set_size(process_set_);
+    OP_REQUIRES(ctx, p > 0,
+                ::tensorflow::errors::FailedPrecondition(
+                    "horovod_tpu must be initialized (and the process set "
+                    "exist) before XLA-compiling an allgather"));
+    m.out_dim0 = shape.dim_size(0) * (int64_t)p;
+    ctx->SetOutput(0, EmitCollective(ctx, m, m.out_dim0));
+  }
+
+ private:
+  std::string name_;
+  int process_set_;
+};
+
+class HvdTpuReducescatterXlaOp : public XlaOpKernel {
+ public:
+  explicit HvdTpuReducescatterXlaOp(OpKernelConstruction* c)
+      : XlaOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("reduce_op", &red_op_));
+    OP_REQUIRES_OK(c, c->GetAttr("prescale", &prescale_));
+    OP_REQUIRES_OK(c, c->GetAttr("postscale", &postscale_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set", &process_set_));
+  }
+
+  void Compile(XlaOpKernelContext* ctx) override {
+    Meta m;
+    m.kind = kReducescatter;
+    m.dtype = DtypeCode(ctx->input_type(0));
+    OP_REQUIRES(ctx, m.dtype >= 0,
+                ::tensorflow::errors::Internal("unsupported dtype"));
+    TensorShape shape = ctx->InputShape(0);
+    int p = hvd_process_set_size(process_set_);
+    OP_REQUIRES(ctx, p > 0,
+                ::tensorflow::errors::FailedPrecondition(
+                    "horovod_tpu must be initialized (and the process set "
+                    "exist) before XLA-compiling a reducescatter"));
+    OP_REQUIRES(ctx, shape.dims() >= 1 && shape.dim_size(0) % p == 0,
+                ::tensorflow::errors::InvalidArgument(
+                    "in-XLA reducescatter needs dim0 divisible by the "
+                    "process-set size (uniform shards)"));
+    for (int i = 0; i < shape.dims(); ++i) m.dims.push_back(shape.dim_size(i));
+    m.red_op_or_root = red_op_;
+    m.prescale = prescale_;
+    m.postscale = postscale_;
+    m.process_set = process_set_;
+    m.name = name_;
+    m.out_dim0 = shape.dim_size(0) / p;
+    ctx->SetOutput(0, EmitCollective(ctx, m, m.out_dim0));
+  }
+
+ private:
+  std::string name_;
+  int red_op_, process_set_;
+  float prescale_, postscale_;
+};
+
 REGISTER_XLA_OP(Name("HvdTpuAllreduce"), HvdTpuAllreduceXlaOp);
 REGISTER_XLA_OP(Name("HvdTpuBroadcast"), HvdTpuBroadcastXlaOp);
+REGISTER_XLA_OP(Name("HvdTpuAllgather"), HvdTpuAllgatherXlaOp);
+REGISTER_XLA_OP(Name("HvdTpuReducescatter"), HvdTpuReducescatterXlaOp);
 
 }  // namespace

@@ -67,6 +67,29 @@ def compiled_avg(x):
 a = compiled_avg(tf.fill([6], float(r)))
 assert np.allclose(a.numpy(), 0.5 * (s - 1) / 2.0), a.numpy()
 
+# allgather + reducescatter also compile (beyond the reference, whose
+# xla_mpi_ops.cc covers allreduce only): static shapes come from the
+# process-set size at trace time; the call target validates the actual
+# result shape against the compiled one.
+@tf.function(jit_compile=True)
+def compiled_gather_scatter(x):
+    g = hvd.allgather(x, name="xla.ag")              # [s*2, 3]
+    rs = hvd.reducescatter(g, op=hvd.Sum, name="xla.rs")  # [2, 3]
+    return g, rs
+
+
+gx, rsx = compiled_gather_scatter(tf.fill([2, 3], float(r + 1)))
+assert gx.shape == (2 * s, 3), gx.shape
+expect_g = np.repeat(np.arange(1, s + 1, dtype=np.float32), 2)[:, None]
+assert np.allclose(gx.numpy(), np.broadcast_to(expect_g, (2 * s, 3))), \
+    gx.numpy()
+# reducescatter of the gathered tensor: every rank contributed the same
+# gathered value, so shard r holds s * gathered[2r:2r+2]
+assert rsx.shape == (2, 3), rsx.shape
+assert np.allclose(rsx.numpy(), s * gx.numpy()[2 * r:2 * r + 2]), \
+    rsx.numpy()
+
+
 # --- fully compiled DistributedGradientTape train step -------------------
 tf.random.set_seed(42)  # same init everywhere; bcast still exercised
 model = tf.keras.Sequential([
