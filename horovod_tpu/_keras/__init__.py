@@ -1,1 +1,42 @@
 """Shared Keras support (reference: horovod/_keras/__init__.py)."""
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=None, **dist_kwargs):
+    """Load a Keras model saved with ``model.save()``, with its optimizer
+    deserialized straight into a ``DistributedOptimizer`` (reference:
+    horovod/_keras ``load_model`` — the wrap happens inside
+    ``from_config`` via ``custom_objects``, so optimizer slot state and
+    hyperparameters survive the round trip; recompiling after load would
+    lose them).
+
+    ``custom_optimizers``: extra optimizer classes to wrap (the standard
+    tf.keras optimizers are covered); ``custom_objects``: passed through
+    to ``tf.keras.models.load_model``; ``compression`` and
+    ``dist_kwargs`` forward to ``DistributedOptimizer``.
+    """
+    import tensorflow as tf
+
+    from ..tensorflow import DistributedOptimizer
+
+    def wrap_cls(opt_cls):
+        class _Wrapped(opt_cls):
+            @classmethod
+            def from_config(cls, config, **kw):
+                opt = opt_cls.from_config(config, **kw)
+                return DistributedOptimizer(opt, compression=compression,
+                                            **dist_kwargs)
+
+        _Wrapped.__name__ = opt_cls.__name__
+        return _Wrapped
+
+    std = [tf.keras.optimizers.SGD, tf.keras.optimizers.Adam,
+           tf.keras.optimizers.AdamW, tf.keras.optimizers.RMSprop,
+           tf.keras.optimizers.Adagrad, tf.keras.optimizers.Adadelta,
+           tf.keras.optimizers.Adamax, tf.keras.optimizers.Nadam,
+           tf.keras.optimizers.Ftrl]
+    objs = {cls.__name__: wrap_cls(cls)
+            for cls in std + list(custom_optimizers or [])}
+    if custom_objects:
+        objs.update(custom_objects)
+    return tf.keras.models.load_model(filepath, custom_objects=objs)

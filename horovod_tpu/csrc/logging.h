@@ -39,8 +39,7 @@ inline const char* EnvRaw(const char* name) {
   if (strncmp(name, "HVD_", 4) != 0) return nullptr;
   static const char* kNoCompat[] = {
       "HVD_RANK", "HVD_SIZE", "HVD_LOCAL_RANK", "HVD_LOCAL_SIZE",
-      "HVD_CROSS_RANK", "HVD_CROSS_SIZE", "HVD_CONTROLLER_ADDR",
-      "HVD_START_TIMEOUT"};
+      "HVD_CROSS_RANK", "HVD_CROSS_SIZE", "HVD_CONTROLLER_ADDR"};
   for (const char* n : kNoCompat)
     if (strcmp(name, n) == 0) return nullptr;
   std::string compat = std::string("HOROVOD_") + (name + 4);

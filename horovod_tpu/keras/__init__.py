@@ -27,7 +27,7 @@ from ..tensorflow import (  # noqa: F401
     shutdown,
     size,
 )
-from .._keras import callbacks  # noqa: F401
+from .._keras import callbacks, load_model  # noqa: F401
 from .._keras.callbacks import (  # noqa: F401
     BroadcastGlobalVariablesCallback,
     CommitStateCallback,

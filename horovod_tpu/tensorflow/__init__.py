@@ -534,6 +534,11 @@ def DistributedOptimizer(optimizer, op=Average, compression=None,
                 tf.equal(self._hvd_count % bpps, 0), _flush,
                 lambda: tf.constant(0))
 
+    # Serialize under the BASE optimizer's class name: model.save() then
+    # records e.g. class_name="SGD", and hvd.load_model's custom_objects
+    # (keyed by the standard names) deserialize it straight back into a
+    # wrapped optimizer (reference: horovod/_keras wrap_optimizer).
+    _DistOpt.__name__ = optimizer.__class__.__name__
     obj = _DistOpt.from_config(optimizer.get_config())
     return obj
 

@@ -218,5 +218,38 @@ try:
 except ValueError:
     pass
 
+# hvd.load_model (reference: horovod/_keras load_model): a saved model's
+# optimizer deserializes straight into a DistributedOptimizer with its
+# hyperparameters AND slot state (Adam moments) intact, and keeps
+# training in sync.
+import tempfile  # noqa: E402
+
+lm_model = tf.keras.Sequential([tf.keras.layers.Dense(1)])
+lm_model.build((None, 3))
+hvd.broadcast_variables(lm_model.variables, root_rank=0)
+lm_model.compile(optimizer=hvd.DistributedOptimizer(
+    tf.keras.optimizers.Adam(0.037)), loss="mse")
+lm_model.fit(fx, fy, epochs=1, batch_size=4, verbose=0)  # builds slots
+slots_before = [v.numpy().copy() for v in lm_model.optimizer.variables]
+with tempfile.TemporaryDirectory() as td:
+    path = os.path.join(td, "m.keras")
+    lm_model.save(path)
+    loaded = hvd_keras.load_model(path)
+    assert getattr(loaded.optimizer, "_hvd_wrapped", False), \
+        type(loaded.optimizer)
+    assert loaded.optimizer.__class__.__name__ == "Adam"
+    assert abs(float(loaded.optimizer.learning_rate.numpy())
+               - 0.037) < 1e-7, "learning rate lost in round trip"
+    # Adam's moment slots must survive save -> load_model
+    slots_after = [v.numpy() for v in loaded.optimizer.variables]
+    assert len(slots_after) == len(slots_before) and len(slots_after) > 1
+    for i, (a, b) in enumerate(zip(slots_before, slots_after)):
+        assert np.allclose(a, b, atol=1e-6), f"slot {i} lost"
+    loaded.fit(fx, fy, epochs=1, batch_size=4, verbose=0)
+    for i, v in enumerate(loaded.variables):
+        ref = hvd.broadcast(tf.identity(v), root_rank=0)
+        assert np.allclose(v.numpy(), ref.numpy(), atol=1e-6), \
+            f"loaded var {i} diverged"
+
 print(f"rank {r}: TF PASS", flush=True)
 hvd.shutdown()
