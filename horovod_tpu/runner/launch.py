@@ -76,6 +76,9 @@ def parse_args(argv=None):
     p.add_argument("--blacklist-cooldown-range", nargs=2, type=float,
                    default=None, help="elastic host blacklist cooldown "
                    "min/max seconds")
+    p.add_argument("--check-build", action="store_true",
+                   help="print framework/native-layer availability and "
+                        "exit (reference: horovodrun --check-build)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command")
     args = p.parse_args(argv)
@@ -86,9 +89,53 @@ def parse_args(argv=None):
         args.stall_check_shutdown_time_seconds = 0
     if args.disable_cache:
         args.cache_capacity = 0
-    if not args.command:
+    if not args.command and not args.check_build:
         p.error("no training command given")
     return args
+
+
+def check_build():
+    """`tpurun --check-build` (reference: horovodrun --check-build):
+    which frameworks import, which native layers are present."""
+    import importlib.util
+
+    def have(mod):
+        return importlib.util.find_spec(mod) is not None
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    lib = os.path.join(pkg, "lib")
+    mark = lambda b: "[X]" if b else "[ ]"  # noqa: E731
+    print("horovod_tpu build:")
+    print("  Frameworks:")
+    for label, mod in (("JAX", "jax"), ("TensorFlow", "tensorflow"),
+                       ("PyTorch", "torch"), ("Keras", "tensorflow"),
+                       ("MXNet", "mxnet")):
+        print(f"    {mark(have(mod))} {label}")
+    print("  Native layers:")
+    print(f"    {mark(os.path.exists(os.path.join(lib, 'libhvd_tpu.so')))}"
+          f" core runtime (libhvd_tpu.so)")
+    print(f"    {mark(os.path.exists(os.path.join(lib, 'libhvd_tf_ops.so')))}"
+          f" TF custom ops (libhvd_tf_ops.so)")
+    print(f"    {mark(os.path.exists(os.path.join(lib, 'libhvd_tf_xla_ops.so')))}"
+          f" TF in-XLA-graph ops (libhvd_tf_xla_ops.so)")
+    # Cheap artifact probe only — calling native_ext.lib() here would
+    # JIT-compile the extension (minutes, under the exclusive build
+    # lock) just to print a checkmark.
+    import glob
+    import sys as _sys
+
+    cache = os.path.join(
+        "/tmp", f"hvd-torch-ext-{os.getuid()}-"
+        f"py{_sys.version_info[0]}{_sys.version_info[1]}")
+    torch_ext = bool(glob.glob(os.path.join(cache, "hvd_torch_ops*")))
+    print(f"    {mark(torch_ext)} torch extension (hvd_torch_ops; "
+          f"JIT-built on first use when unmarked)")
+    print("  Data planes:")
+    print("    [X] in-jit XLA collectives over the device mesh (ICI)")
+    print("    [X] fused TCP ring (host/DCN) + hierarchical compose")
+    print("    [ ] MPI / NCCL / Gloo — not used by design "
+          "(docs/migrating.md)")
+    return 0
 
 
 def _resolve_hosts(args):
@@ -254,6 +301,8 @@ def _wait_all(procs, verbose=False):
 
 def run_commandline(argv=None):
     args = parse_args(argv)
+    if args.check_build:
+        return check_build()
     from . import lsf
 
     if args.remote_shell is None and lsf.in_lsf():

@@ -452,3 +452,19 @@ def test_lsf_blaunch_remote_command(monkeypatch, tmp_path):
         assert "read -r" not in sh, sh
         assert "HVD_RENDEZVOUS_SECRET" not in sh, sh
         assert env.get("HVD_RENDEZVOUS_SECRET"), "secret must ride env"
+
+
+def test_check_build(capsys):
+    """tpurun --check-build (reference: horovodrun --check-build) reports
+    frameworks and native layers without needing a training command."""
+    import horovod_tpu.runner.launch as launch_mod
+
+    rc = launch_mod.run_commandline(["--check-build"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # report SHAPE, not the host's package inventory: every row present
+    for row in ("JAX", "TensorFlow", "PyTorch", "MXNet",
+                "core runtime (libhvd_tpu.so)", "TF custom ops",
+                "TF in-XLA-graph ops", "torch extension"):
+        assert row in out, (row, out)
+    assert out.count("[") >= 10
