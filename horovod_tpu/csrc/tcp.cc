@@ -196,6 +196,29 @@ void Listener::Listen(int port) {
   port_ = ntohs(addr.sin_port);
 }
 
+void Socket::SetRecvTimeout(double sec) {
+  timeval tv{};
+  if (sec > 0) {
+    tv.tv_sec = (time_t)sec;
+    tv.tv_usec = (suseconds_t)((sec - (double)tv.tv_sec) * 1e6);
+  }
+  setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
+bool Listener::AcceptTimeout(double sec, Socket* out) {
+  pollfd p{};
+  p.fd = fd_;
+  p.events = POLLIN;
+  int rc = ::poll(&p, 1, (int)(sec * 1000));
+  if (rc == 0) return false;
+  if (rc < 0) {
+    if (errno == EINTR) return false;
+    throw_errno("poll");
+  }
+  *out = Accept();
+  return true;
+}
+
 Socket Listener::Accept() {
   while (true) {
     int fd = ::accept(fd_, nullptr, nullptr);
@@ -245,6 +268,25 @@ Socket ConnectRetry(const std::string& host, int port, double timeout_sec) {
   }
   throw std::runtime_error("connect to " + host + ":" + std::to_string(port) +
                            " timed out: " + err);
+}
+
+void ListenRetry(Listener& l, int port, double timeout_sec) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_sec);
+  std::string err;
+  while (true) {
+    try {
+      l.Listen(port);
+      return;
+    } catch (const std::exception& e) {
+      err = e.what();
+      l.Close();
+      if (std::chrono::steady_clock::now() >= deadline) break;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+  }
+  throw std::runtime_error("listen on port " + std::to_string(port) +
+                           " failed past timeout: " + err);
 }
 
 std::string LocalAddr(const Socket& s) {

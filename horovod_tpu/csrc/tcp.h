@@ -42,6 +42,12 @@ class Socket {
   // close); safe to call from another thread than the IO owner.
   void Interrupt();
 
+  // Bound recv-side blocking (SO_RCVTIMEO): recv past the timeout fails
+  // with EAGAIN and surfaces as the usual runtime_error. sec <= 0
+  // restores fully blocking reads. Used to keep rendezvous handshakes
+  // from wedging on a silent peer.
+  void SetRecvTimeout(double sec);
+
   // Negotiation-frame sanity cap (1 GiB) — see RecvFrame.
   static constexpr uint32_t kMaxFrameBytes = 1u << 30;
 
@@ -70,6 +76,9 @@ class Listener {
   Listener() : fd_(-1), port_(0) {}
   void Listen(int port);
   Socket Accept();  // blocking
+  // Poll-bounded accept: false on timeout (no connection) instead of
+  // blocking forever, so accept loops can re-check their deadlines.
+  bool AcceptTimeout(double sec, Socket* out);
   int port() const { return port_; }
   void Close();
   ~Listener() { Close(); }
@@ -90,6 +99,12 @@ std::vector<std::vector<uint8_t>> RecvFrameEach(
 
 // Blocking connect with retry (rendezvous races are expected at startup).
 Socket ConnectRetry(const std::string& host, int port, double timeout_sec);
+
+// Listen with rebind backoff: rapid re-init on a fixed port races the
+// previous epoch's teardown (TIME_WAIT / a listener still draining its
+// close), so retry EADDRINUSE-class failures until `timeout_sec` instead
+// of making callers wrap init() in retry loops (VERDICT r4 weak #6).
+void ListenRetry(Listener& l, int port, double timeout_sec);
 
 // Local address of a connected socket (used to advertise the data-plane addr).
 std::string LocalAddr(const Socket& s);

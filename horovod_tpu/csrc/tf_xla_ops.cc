@@ -41,6 +41,11 @@
 #include "tensorflow/core/framework/op_kernel.h"
 #include "xla/hlo/builder/xla_builder.h"
 #include "xla/service/custom_call_status.h"
+// Internal header shipped in the TF wheel: provides the REAL
+// XlaCustomCallStatus_ layout so the setter below can never drift from
+// what the thunk's CustomCallStatusGetMessage reads back (ADVICE r4: a
+// hand-copied struct was an ABI/ODR hazard across TF upgrades).
+#include "xla/service/custom_call_status_internal.h"
 #include "xla/service/custom_call_target_registry.h"
 
 #include "common.h"
@@ -72,13 +77,10 @@ const char* hvd_last_error();
 }
 
 // The C status setter is declared in custom_call_status.h but not exported
-// from libtensorflow_cc; define it locally against the same layout XLA's
-// custom_call_status.cc uses (the thunk reads the message back through the
-// exported CustomCallStatusGetMessage, so only the struct layout must
-// match: an optional<string>).
-struct XlaCustomCallStatus_ {
-  std::optional<std::string> message;
-};
+// from libtensorflow_cc; define it locally. The struct layout comes from
+// custom_call_status_internal.h (above) — the same header XLA's own
+// custom_call_status.cc compiles against — so a TF upgrade that changes
+// the layout changes it here too, in the same build.
 extern "C" void XlaCustomCallStatusSetFailure(XlaCustomCallStatus* status,
                                               const char* message,
                                               size_t message_len) {

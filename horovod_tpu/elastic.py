@@ -180,12 +180,18 @@ def _is_native_op_failure(e):
     # cold `import tensorflow` in a jax-only process would be seconds of
     # side-effectful initialization inside the restore loop.
     wrapper_types = []
+    # getattr chains, not direct attribute access: a framework version
+    # where `errors` exists without the expected type must degrade to
+    # "not a native failure" instead of raising inside the recovery
+    # handler and masking the original error (ADVICE r4).
     tf = sys.modules.get("tensorflow")
-    if tf is not None:
-        wrapper_types.append(tf.errors.OpError)
+    t = getattr(getattr(tf, "errors", None), "OpError", None)
+    if t is not None:
+        wrapper_types.append(t)
     jax = sys.modules.get("jax")
-    if jax is not None and hasattr(jax, "errors"):
-        wrapper_types.append(jax.errors.JaxRuntimeError)
+    t = getattr(getattr(jax, "errors", None), "JaxRuntimeError", None)
+    if t is not None:
+        wrapper_types.append(t)
     if not isinstance(e, tuple(wrapper_types)):
         return False
     msg = str(e)

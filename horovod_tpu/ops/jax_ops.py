@@ -121,6 +121,11 @@ def ragged_alltoall(x, send_counts, axis, capacity):
                           tiled=True)
     recv_counts = lax.all_to_all(send_counts, axis, split_axis=0,
                                  concat_axis=0, tiled=True)     # [P]
+    # A sender whose send_counts[j] exceeds capacity only ships the first
+    # `capacity` rows (the valid mask above); clamp so the returned counts
+    # honor the "first recv_counts[i] valid rows" contract instead of
+    # pointing past the dropped overflow (ADVICE r4).
+    recv_counts = jnp.minimum(recv_counts, jnp.int32(capacity))
     return recv, recv_counts
 
 

@@ -122,12 +122,20 @@ def check_build():
     # JIT-compile the extension (minutes, under the exclusive build
     # lock) just to print a checkmark.
     import glob
-    import sys as _sys
+    import importlib.util
 
-    cache = os.path.join(
-        "/tmp", f"hvd-torch-ext-{os.getuid()}-"
-        f"py{_sys.version_info[0]}{_sys.version_info[1]}")
-    torch_ext = bool(glob.glob(os.path.join(cache, "hvd_torch_ops*")))
+    # Load native_ext.py by file path: its top level is os/sys-only, and
+    # going through the `horovod_tpu.torch` package would import torch
+    # itself just to print a checkmark. The path format still has exactly
+    # one definition (native_ext.jit_build_dir — ADVICE r4).
+    _ne_spec = importlib.util.spec_from_file_location(
+        "_hvd_native_ext_paths",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "torch", "native_ext.py"))
+    _ne = importlib.util.module_from_spec(_ne_spec)
+    _ne_spec.loader.exec_module(_ne)
+    torch_ext = bool(glob.glob(os.path.join(_ne.jit_build_dir(),
+                                            "hvd_torch_ops*")))
     print(f"    {mark(torch_ext)} torch extension (hvd_torch_ops; "
           f"JIT-built on first use when unmarked)")
     print("  Data planes:")

@@ -425,10 +425,18 @@ def _xla_per_tensor(tensors, op, name, process_set, compression,
     HOROVOD_ENABLE_XLA_OPS is likewise process-global). The atomic-group
     fusion of the py_function path is traded for XLA compilability; the
     core's fusion buffer still packs the resulting small messages per
-    cycle. Predivide factors are computed at TRACE time here — same
-    contract as the reference's XLA op attrs; Average's 1/size itself
-    stays execution-time inside the core, so plain averaging remains
-    elastic-safe."""
+    cycle.
+
+    Elastic safety of the predivide factors (ADVICE r4): the factors
+    baked into the compiled graph are ``(1/f, f)`` — functions of the
+    user's ``gradient_predivide_factor`` ONLY, never of world size
+    (ops/collective_ops.py `predivide_factors`). Average's 1/size is
+    applied by the core at collective-EXECUTION time from the negotiated
+    response's member count (csrc/core.cc `EffectivePostscale`), so an
+    elastic resize can never leave a traced tf.function applying a stale
+    size — this path and the py_function path compute identical
+    constants. Enforced by the predivide step in
+    tests/workers/tf_xla_worker.py."""
     from . import native_ops
 
     tf = _tf()

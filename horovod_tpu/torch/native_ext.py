@@ -21,6 +21,15 @@ _loaded = False
 _mod = None
 
 
+def jit_build_dir():
+    """The per-user, per-python JIT build cache directory. The single
+    source of truth — `tpurun --check-build` probes the same path for its
+    checkmark instead of re-deriving the format (ADVICE r4)."""
+    return os.path.join(
+        "/tmp", f"hvd-torch-ext-{os.getuid()}-"
+        f"py{sys.version_info[0]}{sys.version_info[1]}")
+
+
 def lib():
     global _loaded, _mod
     if _loaded:
@@ -41,9 +50,7 @@ def lib():
 
         from torch.utils import cpp_extension
 
-        build_dir = os.path.join(
-            "/tmp", f"hvd-torch-ext-{os.getuid()}-"
-            f"py{sys.version_info[0]}{sys.version_info[1]}")
+        build_dir = jit_build_dir()
         os.makedirs(build_dir, exist_ok=True)
         with open(os.path.join(_CSRC, ".build.lock"), "w") as lk:
             fcntl.flock(lk, fcntl.LOCK_EX)

@@ -1,0 +1,22 @@
+"""Worker for the socket-auth test (csrc/auth.cc): rank 1 delays its
+init so the coordinator's control listener sits in its accepting window
+long enough for the test process to poke it with an unauthenticated
+connect. The job must complete normally regardless — a rogue connect is
+dropped, never fatal."""
+import os
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+r = int(os.environ["HVD_RANK"])
+if r == 1:
+    time.sleep(float(os.environ.get("AUTH_RANK1_DELAY", "5")))
+
+hvd.init()
+out = hvd.allreduce(np.ones(32, np.float32), op=hvd.Sum, name="auth.ar")
+assert np.allclose(out, float(hvd.size())), out[:4]
+hvd.barrier()
+hvd.shutdown()
+print(f"rank {r}: auth-job PASS", flush=True)

@@ -90,6 +90,28 @@ assert np.allclose(rsx.numpy(), s * gx.numpy()[2 * r:2 * r + 2]), \
     rsx.numpy()
 
 
+# gradient_predivide_factor through the XLA per-tensor path (ADVICE r4):
+# the compiled graph bakes only the size-free (1/f, f) pair; Average's
+# 1/member_count is applied by the core at collective-execution time
+# (csrc/core.cc EffectivePostscale), so the traced function stays correct
+# across elastic resizes. Assert exact averaging here so any future
+# size-dependent factor would fail the 2-proc matrix.
+@tf.function(jit_compile=True)
+def predivide_step(w, x):
+    with tf.GradientTape() as tape:
+        tape.watch(w)
+        loss = tf.reduce_sum(w * x)
+    dtape = hvd.DistributedGradientTape(tape, gradient_predivide_factor=4.0)
+    (g,) = dtape.gradient(loss, [w])
+    return g
+
+
+gpre = predivide_step(tf.ones([5]), tf.fill([5], float(r + 1)))
+# d(loss)/dw = x = r+1 per rank; averaged over ranks = (s+1)/2 exactly,
+# independent of f.
+assert np.allclose(gpre.numpy(), (s + 1) / 2.0), gpre.numpy()
+
+
 # --- fully compiled DistributedGradientTape train step -------------------
 tf.random.set_seed(42)  # same init everywhere; bcast still exercised
 model = tf.keras.Sequential([
