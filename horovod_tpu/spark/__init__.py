@@ -3,10 +3,13 @@ estimator layer.
 
 Reference parity: ``horovod/spark/__init__.py`` (``horovod.spark.run``:
 one rank per Spark task, results collected to the driver). The estimator
-layer lives in :mod:`.keras` (``KerasEstimator``) and :mod:`.torch`
-(``TorchEstimator``) — ``fit(df)`` materializes the DataFrame to the
-:mod:`.store`, trains N ranks through a backend (negotiated local
-processes by default, barrier Spark tasks via
+layer lives in :mod:`.keras` (``KerasEstimator``), :mod:`.torch`
+(``TorchEstimator``) and :mod:`.lightning` (``LightningEstimator``, the
+reference's ``lightning/estimator.py`` analog over the LightningModule
+protocol) — ``fit(df)`` materializes the DataFrame to the :mod:`.store`
+(filesystem-abstracted: local, dbfs:/, and fsspec-backed hdfs/gs/s3
+behind one ``FilesystemStore`` class), trains N ranks through a backend
+(negotiated local processes by default, barrier Spark tasks via
 :class:`~horovod_tpu.spark.params.SparkBackend`), and returns a
 transformer model. Everything except ``run()`` itself is importable and
 usable without pyspark — see the README descope note for what changes

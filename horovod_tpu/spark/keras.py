@@ -28,7 +28,8 @@ def _train_fn(spec):
     r = hvd.rank()
     tf.keras.utils.set_random_seed(spec["seed"] + r)
 
-    X, Y = load_shard(spec["train_path"], r)
+    store = spec.get("store")
+    X, Y = load_shard(spec["train_path"], r, store)
     model = tf.keras.models.model_from_json(
         spec["model_json"], custom_objects=spec["custom_objects"] or None)
     model.set_weights(spec["weights"])
@@ -45,7 +46,7 @@ def _train_fn(spec):
 
     # Validation scores averaged across ranks (each rank holds one shard).
     val = None
-    Xv, Yv = load_shard(spec["val_path"], r)
+    Xv, Yv = load_shard(spec["val_path"], r, store)
     if len(Xv):
         scores = model.evaluate(Xv, Yv, batch_size=spec["batch_size"],
                                 verbose=0)
@@ -55,8 +56,12 @@ def _train_fn(spec):
 
     weights = model.get_weights()
     if r == 0:
-        np.savez(os.path.join(spec["ckpt_path"], "model_weights.npz"),
-                 *weights)
+        ckpt = os.path.join(spec["ckpt_path"], "model_weights.npz")
+        if store is not None:
+            with store.open_write(ckpt) as f:
+                np.savez(f, *weights)
+        else:
+            np.savez(ckpt, *weights)
     hvd.shutdown()
     return {
         "history": {k: [float(x) for x in v]
@@ -112,6 +117,7 @@ class KerasEstimator(EstimatorParams):
             "train_path": train_path,
             "val_path": val_path,
             "ckpt_path": ckpt_path,
+            "store": store,
         }
         results = self._run(_train_fn, spec)
         rank0 = results[0]

@@ -1,0 +1,227 @@
+"""LightningEstimator — estimator-style data-parallel training of a
+PyTorch-Lightning-protocol module (reference:
+``horovod/spark/lightning/estimator.py`` ``TorchEstimator`` — the
+lightning estimator family — and ``lightning/datamodule.py``).
+
+The reference drives a real ``pytorch_lightning.Trainer`` with a Horovod
+accelerator plugin. pytorch_lightning is not installed in this
+environment, so this build consumes the Lightning *protocol* instead of
+the library: anything whose module implements the LightningModule core
+contract —
+
+- ``training_step(batch, batch_idx) -> loss | {"loss": loss, ...}``
+- ``configure_optimizers() -> optimizer | [optimizers] |
+  ([optimizers], [schedulers]) | {"optimizer": ...}``
+- optional ``validation_step(batch, batch_idx) -> loss | {...}``
+- ``forward`` for inference (it is a torch ``nn.Module``)
+
+— trains data-parallel through the torch binding
+(``broadcast_parameters`` + ``DistributedOptimizer`` gradient hooks), so
+a real ``pl.LightningModule`` works unmodified (it satisfies the same
+protocol), and so does the conformance shim in
+``tests/shims/pytorch_lightning``. Rank 0 checkpoints the state_dict to
+the store; a :class:`LightningModel` transformer comes back.
+"""
+import os
+
+import cloudpickle
+import numpy as np
+
+from .params import EstimatorParams, HorovodModel, load_shard
+
+
+def _first_optimizer(configured):
+    """Normalize every configure_optimizers() return shape the Lightning
+    contract allows down to (optimizer, scheduler_or_None). Multi-optimizer
+    setups (GAN-style manual optimization) are rejected loudly — the
+    reference's Horovod accelerator has the same single-optimizer limit."""
+    def unwrap_sched(s):
+        # Lightning also allows an lr_scheduler CONFIG dict
+        # ({"scheduler": sch, "interval": ..., ...}); only the scheduler
+        # itself is actionable here (per-epoch stepping).
+        if isinstance(s, dict):
+            return s.get("scheduler")
+        return s
+
+    sched = None
+    c = configured
+    if isinstance(c, dict):
+        sched = unwrap_sched(c.get("lr_scheduler"))
+        c = c["optimizer"]
+    if isinstance(c, tuple) and len(c) == 2 and isinstance(c[0], (list,
+                                                                  tuple)):
+        opts, scheds = c
+        if len(opts) != 1:
+            raise ValueError("multi-optimizer LightningModules are not "
+                             "supported (single-optimizer limit, as in the "
+                             "reference's Horovod accelerator)")
+        if scheds:
+            sched = unwrap_sched(scheds[0])
+        return opts[0], sched
+    if isinstance(c, (list, tuple)):
+        if len(c) != 1:
+            raise ValueError("multi-optimizer LightningModules are not "
+                             "supported (single-optimizer limit, as in the "
+                             "reference's Horovod accelerator)")
+        return c[0], sched
+    return c, sched
+
+
+def _step_loss(out):
+    """training_step may return a tensor or a {"loss": ...} dict."""
+    if isinstance(out, dict):
+        return out["loss"]
+    return out
+
+
+def _train_fn(spec):
+    """Per-rank training body (fresh process, slot env already set)."""
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    torch.manual_seed(spec["seed"] + r)
+
+    module = cloudpickle.loads(spec["module"])
+    hvd.broadcast_parameters(module.state_dict(), root_rank=0)
+    optimizer, scheduler = _first_optimizer(module.configure_optimizers())
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=module.named_parameters())
+
+    store = spec.get("store")
+    X, Y = load_shard(spec["train_path"], r, store)
+    X, Y = torch.from_numpy(X), torch.from_numpy(Y)
+    bs, n = spec["batch_size"], len(X)
+
+    history = []
+    for epoch in range(spec["epochs"]):
+        order = torch.randperm(n) if spec["shuffle"] else torch.arange(n)
+        total, seen = 0.0, 0
+        module.train()
+        for batch_idx, i in enumerate(range(0, n, bs)):
+            idx = order[i:i + bs]
+            optimizer.zero_grad()
+            loss = _step_loss(module.training_step((X[idx], Y[idx]),
+                                                   batch_idx))
+            loss.backward()
+            optimizer.step()
+            total += float(loss) * len(idx)
+            seen += len(idx)
+        if scheduler is not None:
+            scheduler.step()
+        history.append(hvd.metric_average(total / max(seen, 1),
+                                          f"est_loss_{epoch}"))
+
+    val = None
+    Xv, Yv = load_shard(spec["val_path"], r, store)
+    if len(Xv) and hasattr(module, "validation_step"):
+        module.eval()
+        with torch.no_grad():
+            out = module.validation_step(
+                (torch.from_numpy(Xv), torch.from_numpy(Yv)), 0)
+        try:
+            val = hvd.metric_average(float(_step_loss(out)), "est_val_loss")
+        except (KeyError, TypeError):
+            val = None  # validation_step returned nothing loss-shaped
+
+    state = {k: v.cpu() for k, v in module.state_dict().items()}
+    if r == 0:
+        ckpt = os.path.join(spec["ckpt_path"], "module.pt")
+        if store is not None:
+            with store.open_write(ckpt) as f:
+                torch.save(state, f)
+        else:
+            torch.save(state, ckpt)
+    hvd.shutdown()
+    return {"loss_history": history, "val_loss": val,
+            "state_dict": state if r == 0 else None}
+
+
+class LightningEstimator(EstimatorParams):
+    """Data-parallel estimator over a LightningModule-protocol object
+    (reference: horovod/spark/lightning/estimator.py).
+
+    ``model`` is the module; loss and optimizer live INSIDE it
+    (``training_step`` / ``configure_optimizers``), so the base
+    estimator's ``loss``/``optimizer`` parameters do not apply.
+    """
+
+    def _check_params(self):
+        if self.model is None:
+            raise ValueError("model (a LightningModule-protocol object) "
+                             "is required")
+        for method in ("training_step", "configure_optimizers"):
+            if not callable(getattr(self.model, method, None)):
+                raise ValueError(
+                    f"model must implement {method}() — the "
+                    f"LightningModule core protocol (see module docstring)")
+        if not self.feature_cols or not self.label_cols:
+            raise ValueError("feature_cols and label_cols are required")
+        if self.num_proc < 1:
+            raise ValueError("num_proc must be >= 1")
+
+    def fit(self, df):
+        self._check_params()
+        store, run_id = self._prepare_store()
+        train_path, val_path, _ = self._materialize(df, run_id)
+        ckpt_path = store.get_checkpoint_path(run_id)
+
+        spec = {
+            "module": cloudpickle.dumps(self.model),
+            "batch_size": self.batch_size,
+            "epochs": self.epochs,
+            "shuffle": self.shuffle,
+            "seed": self.seed,
+            "train_path": train_path,
+            "val_path": val_path,
+            "ckpt_path": ckpt_path,
+            "store": store,
+        }
+        results = self._run(_train_fn, spec)
+        rank0 = results[0]
+        module = cloudpickle.loads(spec["module"])
+        module.load_state_dict(rank0["state_dict"])
+        return LightningModel(
+            model=module, feature_cols=self.feature_cols,
+            label_cols=self.label_cols, history=rank0["loss_history"],
+            val_loss=rank0["val_loss"], checkpoint_path=ckpt_path)
+
+
+class LightningModel(HorovodModel):
+    """Fitted transformer over the trained module (reference:
+    lightning/estimator.py TorchModel)."""
+
+    def __init__(self, model, feature_cols, label_cols, history=None,
+                 val_loss=None, checkpoint_path=None, output_cols=None):
+        super().__init__(feature_cols, label_cols, output_cols)
+        self.model = model
+        self.history = history or []
+        self.val_loss = val_loss
+        self.checkpoint_path = checkpoint_path
+
+    def _predict(self, X):
+        import torch
+
+        self.model.eval()
+        with torch.no_grad():
+            x = torch.from_numpy(np.array(X, dtype=np.float32, copy=True))
+            return self.model(x).numpy()
+
+    @classmethod
+    def load(cls, model, checkpoint_path, feature_cols, label_cols,
+             output_cols=None, store=None):
+        """Rebuild from a store checkpoint written by fit(): ``model`` is
+        an architecture instance to load the state_dict into."""
+        import torch
+
+        ckpt = os.path.join(checkpoint_path, "module.pt")
+        if store is not None:
+            with store.open_read(ckpt) as f:
+                state = torch.load(f, weights_only=True)
+        else:
+            state = torch.load(ckpt, weights_only=True)
+        model.load_state_dict(state)
+        return cls(model, feature_cols, label_cols,
+                   checkpoint_path=checkpoint_path, output_cols=output_cols)

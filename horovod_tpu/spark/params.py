@@ -100,6 +100,13 @@ class EstimatorParams:
                 tempfile.mkdtemp(prefix="hvd-estimator-"))
         elif not isinstance(self.store, Store):
             self.store = Store.create(self.store)
+        if getattr(getattr(self.store, "fs", None), "process_local",
+                   False):
+            raise ValueError(
+                "this store's filesystem is process-local (e.g. "
+                "InMemoryFilesystem): rank subprocesses would checkpoint "
+                "into pickled copies that are thrown away — use a store "
+                "whose filesystem is shared across processes")
         # uuid suffix: wall-clock alone collides when two fits share a
         # store in the same millisecond, silently cross-contaminating
         # shards and checkpoints.
@@ -159,10 +166,14 @@ class EstimatorParams:
         for r in range(self.num_proc):
             tr = train_idx[r::self.num_proc]
             va = val_idx[r::self.num_proc]
-            np.savez(os.path.join(train_path, f"shard-{r}.npz"),
-                     X=X[tr], Y=Y[tr])
-            np.savez(os.path.join(val_path, f"shard-{r}.npz"),
-                     X=X[va], Y=Y[va])
+            # All shard IO rides the store's filesystem adapter, so
+            # remote stores (store.py FilesystemStore) work unchanged.
+            with self.store.open_write(
+                    os.path.join(train_path, f"shard-{r}.npz")) as f:
+                np.savez(f, X=X[tr], Y=Y[tr])
+            with self.store.open_write(
+                    os.path.join(val_path, f"shard-{r}.npz")) as f:
+                np.savez(f, X=X[va], Y=Y[va])
         return train_path, val_path, val_per_rank
 
     def _run(self, fn, spec):
@@ -184,9 +195,19 @@ def _as_pandas(df):
                     f"{type(df).__name__}")
 
 
-def load_shard(path, rank):
-    """Read rank's materialized shard → (X, Y) float32 arrays."""
-    with np.load(os.path.join(path, f"shard-{rank}.npz")) as z:
+def load_shard(path, rank, store=None):
+    """Read rank's materialized shard → (X, Y) float32 arrays. With a
+    store, bytes come through its filesystem adapter (remote stores);
+    without one, plain local IO (the shards a LocalStore wrote are
+    ordinary files)."""
+    import io
+
+    name = os.path.join(path, f"shard-{rank}.npz")
+    if store is not None:
+        with store.open_read(name) as f:
+            with np.load(io.BytesIO(f.read())) as z:
+                return z["X"], z["Y"]
+    with np.load(name) as z:
         return z["X"], z["Y"]
 
 

@@ -35,7 +35,8 @@ def _train_fn(spec):
     optimizer = hvd.DistributedOptimizer(
         optimizer, named_parameters=model.named_parameters())
 
-    X, Y = load_shard(spec["train_path"], r)
+    store = spec.get("store")
+    X, Y = load_shard(spec["train_path"], r, store)
     X, Y = torch.from_numpy(X), torch.from_numpy(Y)
     bs, n = spec["batch_size"], len(X)
 
@@ -56,7 +57,7 @@ def _train_fn(spec):
                                           f"est_loss_{epoch}"))
 
     val = None
-    Xv, Yv = load_shard(spec["val_path"], r)
+    Xv, Yv = load_shard(spec["val_path"], r, store)
     if len(Xv):
         model.eval()
         with torch.no_grad():
@@ -66,7 +67,12 @@ def _train_fn(spec):
 
     state = {k: v.cpu() for k, v in model.state_dict().items()}
     if r == 0:
-        torch.save(state, os.path.join(spec["ckpt_path"], "model.pt"))
+        ckpt = os.path.join(spec["ckpt_path"], "model.pt")
+        if store is not None:
+            with store.open_write(ckpt) as f:
+                torch.save(state, f)
+        else:
+            torch.save(state, ckpt)
     hvd.shutdown()
     return {"loss_history": history, "val_loss": val,
             "state_dict": state if r == 0 else None}
@@ -122,6 +128,7 @@ class TorchEstimator(EstimatorParams):
             "train_path": train_path,
             "val_path": val_path,
             "ckpt_path": ckpt_path,
+            "store": store,
         }
         results = self._run(_train_fn, spec)
         rank0 = results[0]

@@ -1,0 +1,33 @@
+"""CI-only pytorch_lightning conformance shim (NOT part of horovod_tpu).
+
+Implements exactly the LightningModule core protocol that
+``horovod_tpu.spark.lightning.LightningEstimator`` consumes —
+``LightningModule`` as a ``torch.nn.Module`` with ``training_step`` /
+``configure_optimizers`` / optional ``validation_step`` hooks and a
+no-op ``log`` — so a test can subclass it the way real user code
+subclasses ``pl.LightningModule`` and prove the estimator drives the
+protocol end-to-end. pytorch_lightning itself is not installable here
+(no network). Trainer machinery (loops, callbacks, logging backends,
+distributed strategies) is explicitly NOT simulated: the estimator IS
+the trainer in this build. See tests/shims/README.md.
+"""
+import torch
+
+
+class LightningModule(torch.nn.Module):
+    """The core-protocol subset of pytorch_lightning.LightningModule."""
+
+    def log(self, name, value, **kwargs):  # metrics sink: no-op in CI
+        pass
+
+    def log_dict(self, metrics, **kwargs):
+        pass
+
+    def training_step(self, batch, batch_idx):
+        raise NotImplementedError
+
+    def configure_optimizers(self):
+        raise NotImplementedError
+
+
+__version__ = "0.0-horovod-tpu-ci-shim"
