@@ -461,10 +461,16 @@ def _hostplane_worker():
         alg = x.nbytes * iters / dt / 1e9
         bus = alg * 2.0 * (s - 1) / s
         with open(os.environ["_BENCH_HOSTPLANE_OUT"], "w") as f:
+            # cpu_cores contextualizes the figure: on a 1-core container
+            # (this CI box) all ranks time-slice one core, so the number
+            # measures the box, not the ring (measured: bus bw *drops*
+            # with rank count here, 0.36 -> 0.08 GB/s from 2 -> 8 ranks,
+            # exactly the serialization signature).
             json.dump({"metric": "allreduce_hostplane_bus_bandwidth",
                        "value": round(bus, 3),
                        "unit": "GB/s (bus bw, loopback TCP)",
                        "alg_gbps": round(alg, 3), "n_ranks": s,
+                       "cpu_cores": len(os.sched_getaffinity(0)),
                        "nbytes": x.nbytes, "iters": iters,
                        "vs_baseline": 1.0}, f)
     hvd.barrier()

@@ -1020,16 +1020,31 @@ void EstablishMesh() {
       accept_err = std::current_exception();
     }
   });
-  for (int j = 0; j < g->rank; j++) {
-    Socket s = ConnectRetry(hosts[j], ports[j], timeout);
-    s.SetRecvTimeout(std::max(remaining(), 0.5));
-    AuthConnect(s, secret);
-    uint32_t me = (uint32_t)g->rank;
-    s.SendAll(&me, 4);
-    s.SetRecvTimeout(0);
-    peers[j] = std::move(s);
+  // A dial failure (ConnectRetry timeout, AuthConnect mismatch on a
+  // squatted port) must surface as a catchable init error. Throwing past
+  // the joinable acceptor thread would std::terminate the process, so:
+  // capture, close the listener (its poll/accept then fails, unblocking
+  // the acceptor), join, THEN rethrow.
+  std::exception_ptr dial_err;
+  try {
+    for (int j = 0; j < g->rank; j++) {
+      Socket s = ConnectRetry(hosts[j], ports[j], timeout);
+      s.SetRecvTimeout(std::max(remaining(), 0.5));
+      AuthConnect(s, secret);
+      uint32_t me = (uint32_t)g->rank;
+      s.SendAll(&me, 4);
+      s.SetRecvTimeout(0);
+      peers[j] = std::move(s);
+    }
+  } catch (...) {
+    dial_err = std::current_exception();
+    // Shutdown (not Close): wakes the acceptor's poll/accept immediately
+    // and keeps the fd valid until after the join, so there is no
+    // cross-thread fd race and no waiting out the rendezvous deadline.
+    g->data_listener.Shutdown();
   }
   acceptor.join();
+  if (dial_err) std::rethrow_exception(dial_err);
   if (accept_err) std::rethrow_exception(accept_err);
   g->data.Init(g->rank, g->size, std::move(peers));
 }

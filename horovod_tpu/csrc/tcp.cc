@@ -232,6 +232,10 @@ Socket Listener::Accept() {
   }
 }
 
+void Listener::Shutdown() {
+  if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+}
+
 void Listener::Close() {
   if (fd_ >= 0) {
     ::close(fd_);

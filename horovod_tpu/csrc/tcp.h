@@ -79,6 +79,11 @@ class Listener {
   // Poll-bounded accept: false on timeout (no connection) instead of
   // blocking forever, so accept loops can re-check their deadlines.
   bool AcceptTimeout(double sec, Socket* out);
+  // Wake and fail any thread blocked in Accept/AcceptTimeout WITHOUT
+  // closing the fd (no fd_ race with the blocked thread): shutdown(2)
+  // makes the pending poll/accept fail immediately. Call, join the
+  // accept thread, then Close().
+  void Shutdown();
   int port() const { return port_; }
   void Close();
   ~Listener() { Close(); }

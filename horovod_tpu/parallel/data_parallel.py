@@ -20,7 +20,8 @@ from jax import shard_map  # requires jax >= 0.8
 
 
 def make_train_step(loss_fn, tx, mesh, data_axis="data", extra_reduce=None,
-                    jit=True, donate=True, accum_steps=1):
+                    jit=True, donate=True, accum_steps=1,
+                    grad_reduce="mean"):
     """Build `step(params, opt_state, batch) -> (params, opt_state, loss)`.
 
     - `loss_fn(params, batch) -> scalar loss` written for ONE shard of the
@@ -38,15 +39,33 @@ def make_train_step(loss_fn, tx, mesh, data_axis="data", extra_reduce=None,
       gradient for a MEAN-type ``loss_fn`` (mean over examples — the
       usual case). A SUM-type loss changes scale by 1/N under
       accumulation; normalize inside ``loss_fn`` if you use one.
+    - ``grad_reduce="adasum"`` replaces the pmean with the device-plane
+      Adasum (ops/jax_ops.py `adasum` — the op=hvd.Adasum analog, VHDD
+      over ICI; requires power-of-two axis sizes). The loss stays
+      pmean-averaged either way.
     """
     axes = (data_axis,) if isinstance(data_axis, str) else tuple(data_axis)
     accum_steps = int(accum_steps)
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if grad_reduce not in ("mean", "adasum"):
+        raise ValueError(f"grad_reduce must be 'mean' or 'adasum', "
+                         f"got {grad_reduce!r}")
 
     def _pmean_all(x):
         for ax in axes:
             x = jax.lax.pmean(x, ax)
+        return x
+
+    def _grad_reduce_all(x):
+        from ..ops import jax_ops
+
+        for ax in axes:
+            # "adasum" = the device-plane Adasum (ops/jax_ops.py `adasum`
+            # — op=hvd.Adasum analog, VHDD on ICI); "mean" = pmean ring.
+            # The LOSS is always pmean'd — adasum applies to gradients.
+            x = jax_ops.adasum(x, ax) if grad_reduce == "adasum" \
+                else jax.lax.pmean(x, ax)
         return x
 
     def _shard_grad(params, batch):
@@ -92,7 +111,7 @@ def make_train_step(loss_fn, tx, mesh, data_axis="data", extra_reduce=None,
     )
     def step(params, opt_state, batch):
         loss, grads = _shard_grad(params, batch)
-        grads = jax.tree.map(_pmean_all, grads)
+        grads = jax.tree.map(_grad_reduce_all, grads)
         if extra_reduce is not None:
             grads = extra_reduce(grads)
         updates, opt_state = tx.update(grads, opt_state, params)

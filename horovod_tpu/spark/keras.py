@@ -14,7 +14,8 @@ import os
 
 import numpy as np
 
-from .params import EstimatorParams, HorovodModel, load_shard
+from .params import (EstimatorParams, HorovodModel, load_shard,
+                     open_artifact)
 
 
 def _train_fn(spec):
@@ -56,12 +57,9 @@ def _train_fn(spec):
 
     weights = model.get_weights()
     if r == 0:
-        ckpt = os.path.join(spec["ckpt_path"], "model_weights.npz")
-        if store is not None:
-            with store.open_write(ckpt) as f:
-                np.savez(f, *weights)
-        else:
-            np.savez(ckpt, *weights)
+        with open_artifact(store, os.path.join(spec["ckpt_path"],
+                                               "model_weights.npz")) as f:
+            np.savez(f, *weights)
     hvd.shutdown()
     return {
         "history": {k: [float(x) for x in v]
@@ -161,11 +159,17 @@ class KerasModel(HorovodModel):
 
     @classmethod
     def load(cls, model_json, checkpoint_path, feature_cols, label_cols,
-             custom_objects=None, output_cols=None):
-        """Rebuild a fitted model from a store checkpoint written by fit."""
-        with np.load(os.path.join(checkpoint_path,
-                                  "model_weights.npz")) as z:
-            weights = [z[k] for k in z.files]
+             custom_objects=None, output_cols=None, store=None):
+        """Rebuild a fitted model from a store checkpoint written by fit.
+        Pass the ``store`` for checkpoints living behind a remote
+        filesystem adapter."""
+        import io
+
+        with open_artifact(store, os.path.join(checkpoint_path,
+                                               "model_weights.npz"),
+                           "rb") as f:
+            with np.load(io.BytesIO(f.read())) as z:
+                weights = [z[k] for k in z.files]
         return cls(model_json, weights, custom_objects, feature_cols,
                    label_cols, checkpoint_path=checkpoint_path,
                    output_cols=output_cols)

@@ -27,7 +27,8 @@ import os
 import cloudpickle
 import numpy as np
 
-from .params import EstimatorParams, HorovodModel, load_shard
+from .params import (EstimatorParams, HorovodModel, load_shard,
+                     open_artifact)
 
 
 def _first_optimizer(configured):
@@ -43,28 +44,33 @@ def _first_optimizer(configured):
             return s.get("scheduler")
         return s
 
-    sched = None
+    def reject_multi():
+        raise ValueError("multi-optimizer LightningModules are not "
+                         "supported (single-optimizer limit, as in the "
+                         "reference's Horovod accelerator)")
+
     c = configured
     if isinstance(c, dict):
+        # "optimizer" may itself be a single optimizer or a (length-1)
+        # list of them — recurse so both unwrap/validate the same way.
+        opt, inner = _first_optimizer(c["optimizer"])
         sched = unwrap_sched(c.get("lr_scheduler"))
-        c = c["optimizer"]
-    if isinstance(c, tuple) and len(c) == 2 and isinstance(c[0], (list,
-                                                                  tuple)):
-        opts, scheds = c
-        if len(opts) != 1:
-            raise ValueError("multi-optimizer LightningModules are not "
-                             "supported (single-optimizer limit, as in the "
-                             "reference's Horovod accelerator)")
-        if scheds:
-            sched = unwrap_sched(scheds[0])
-        return opts[0], sched
+        return opt, sched if sched is not None else inner
     if isinstance(c, (list, tuple)):
+        # Two-sequence form — Lightning's ([opts], [scheds]), which user
+        # code also writes as a list of two lists.
+        if len(c) == 2 and isinstance(c[0], (list, tuple)):
+            opts, scheds = c
+            if len(opts) != 1:
+                reject_multi()
+            sched = unwrap_sched(scheds[0]) if scheds else None
+            opt, inner_sched = _first_optimizer(opts[0])
+            return opt, sched if sched is not None else inner_sched
+        # Flat sequence of optimizers (or of per-optimizer config dicts).
         if len(c) != 1:
-            raise ValueError("multi-optimizer LightningModules are not "
-                             "supported (single-optimizer limit, as in the "
-                             "reference's Horovod accelerator)")
-        return c[0], sched
-    return c, sched
+            reject_multi()
+        return _first_optimizer(c[0])
+    return c, None
 
 
 def _step_loss(out):
@@ -128,12 +134,9 @@ def _train_fn(spec):
 
     state = {k: v.cpu() for k, v in module.state_dict().items()}
     if r == 0:
-        ckpt = os.path.join(spec["ckpt_path"], "module.pt")
-        if store is not None:
-            with store.open_write(ckpt) as f:
-                torch.save(state, f)
-        else:
-            torch.save(state, ckpt)
+        with open_artifact(store, os.path.join(spec["ckpt_path"],
+                                               "module.pt")) as f:
+            torch.save(state, f)
     hvd.shutdown()
     return {"loss_history": history, "val_loss": val,
             "state_dict": state if r == 0 else None}
@@ -216,12 +219,9 @@ class LightningModel(HorovodModel):
         an architecture instance to load the state_dict into."""
         import torch
 
-        ckpt = os.path.join(checkpoint_path, "module.pt")
-        if store is not None:
-            with store.open_read(ckpt) as f:
-                state = torch.load(f, weights_only=True)
-        else:
-            state = torch.load(ckpt, weights_only=True)
+        with open_artifact(store, os.path.join(checkpoint_path,
+                                               "module.pt"), "rb") as f:
+            state = torch.load(f, weights_only=True)
         model.load_state_dict(state)
         return cls(model, feature_cols, label_cols,
                    checkpoint_path=checkpoint_path, output_cols=output_cols)

@@ -195,6 +195,17 @@ def _as_pandas(df):
                     f"{type(df).__name__}")
 
 
+def open_artifact(store, path, mode="wb"):
+    """Checkpoint/artifact IO through the store's filesystem adapter —
+    the ONE place the store-vs-bare-IO choice lives (estimator specs
+    always carry the store; the bare branch serves direct _train_fn use
+    outside an estimator)."""
+    if store is not None:
+        return store.open_write(path) if "w" in mode \
+            else store.open_read(path)
+    return open(path, mode)
+
+
 def load_shard(path, rank, store=None):
     """Read rank's materialized shard → (X, Y) float32 arrays. With a
     store, bytes come through its filesystem adapter (remote stores);

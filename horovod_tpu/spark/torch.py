@@ -13,7 +13,8 @@ import os
 import cloudpickle
 import numpy as np
 
-from .params import EstimatorParams, HorovodModel, load_shard
+from .params import (EstimatorParams, HorovodModel, load_shard,
+                     open_artifact)
 
 
 def _train_fn(spec):
@@ -67,12 +68,9 @@ def _train_fn(spec):
 
     state = {k: v.cpu() for k, v in model.state_dict().items()}
     if r == 0:
-        ckpt = os.path.join(spec["ckpt_path"], "model.pt")
-        if store is not None:
-            with store.open_write(ckpt) as f:
-                torch.save(state, f)
-        else:
-            torch.save(state, ckpt)
+        with open_artifact(store, os.path.join(spec["ckpt_path"],
+                                               "model.pt")) as f:
+            torch.save(state, f)
     hvd.shutdown()
     return {"loss_history": history, "val_loss": val,
             "state_dict": state if r == 0 else None}
@@ -163,13 +161,16 @@ class TorchModel(HorovodModel):
 
     @classmethod
     def load(cls, model, checkpoint_path, feature_cols, label_cols,
-             output_cols=None):
+             output_cols=None, store=None):
         """Rebuild a fitted model from a store checkpoint written by fit:
-        ``model`` is an architecture instance to load the state_dict into."""
+        ``model`` is an architecture instance to load the state_dict into.
+        Pass the ``store`` for checkpoints living behind a remote
+        filesystem adapter."""
         import torch
 
-        state = torch.load(os.path.join(checkpoint_path, "model.pt"),
-                           weights_only=True)
+        with open_artifact(store, os.path.join(checkpoint_path,
+                                               "model.pt"), "rb") as f:
+            state = torch.load(f, weights_only=True)
         model.load_state_dict(state)
         return cls(model, feature_cols, label_cols,
                    checkpoint_path=checkpoint_path, output_cols=output_cols)
