@@ -26,9 +26,12 @@
 // reference's build/runtime gate. It must be loaded after
 // libhvd_tf_ops.so, which owns the REGISTER_OP definitions.
 //
-// Note: XLA:CPU logs a deprecation E-line for API_VERSION_STATUS_RETURNING
-// custom calls (slated post-TF-2.21 for the typed FFI); the call executes
-// correctly, and this tree pins TF 2.21.
+// ABI: the call target is registered under BOTH custom-call mechanisms —
+// the typed FFI registry (API_VERSION_TYPED_FFI, the supported path and
+// the default emission) and the legacy CustomCallTargetRegistry
+// (API_VERSION_STATUS_RETURNING, selected by HVD_XLA_LEGACY_CUSTOM_CALL=1
+// as an escape hatch; XLA:CPU logs a removal warning for it). Both ABIs
+// share one execution body (RunCollective).
 
 #include <cstdint>
 #include <cstring>
@@ -47,6 +50,17 @@
 // hand-copied struct was an ABI/ODR hazard across TF upgrades).
 #include "xla/service/custom_call_status_internal.h"
 #include "xla/service/custom_call_target_registry.h"
+// Typed FFI (the supported custom-call mechanism): header-only C++
+// binding. GetXlaFfiApi() is exported by libtensorflow_framework but its
+// declaring header (xla/ffi/ffi_api.h) drags MLIR headers the wheel
+// doesn't ship — forward-declare it against the C-API type instead.
+#include "xla/ffi/api/ffi.h"
+
+namespace xla {
+namespace ffi {
+const XLA_FFI_Api* GetXlaFfiApi();
+}  // namespace ffi
+}  // namespace xla
 
 #include "common.h"
 #include "tf_dtype.h"
@@ -194,37 +208,32 @@ Meta UnpackMeta(const uint8_t* p) {
 }
 
 // ---------------------------------------------------------------------------
-// Custom-call target (XLA:CPU, API_VERSION_STATUS_RETURNING):
-// target(out, ins, status). ins[0] = data, ins[1] = metadata blob.
+// Collective execution body, shared by BOTH custom-call ABIs: the typed
+// FFI handler (the supported path) and the legacy
+// API_VERSION_STATUS_RETURNING target (escape hatch,
+// HVD_XLA_LEGACY_CUSTOM_CALL=1). Returns "" on success, else the error
+// message (without the "horovod_tpu collective failed: " prefix).
 
-void Fail(XlaCustomCallStatus* status, const std::string& msg) {
-  // "horovod_tpu collective failed" matches tf_ops.cc's wording; the core's
-  // shutdown/HorovodInternalError markers inside `msg` are what
-  // elastic._is_native_op_failure keys on.
-  std::string full = "horovod_tpu collective failed: " + msg;
-  XlaCustomCallStatusSetFailure(status, full.c_str(), full.size());
-}
-
-extern "C" void hvd_tpu_xla_collective(void* out, const void** ins,
-                                       XlaCustomCallStatus* status) {
-  Meta m = UnpackMeta(reinterpret_cast<const uint8_t*>(ins[1]));
+std::string RunCollective(const void* data, const uint8_t* metab,
+                          void* out) {
+  Meta m = UnpackMeta(metab);
   int h = -1;
   bool core_owned_out = false;
   if (m.kind == kAllreduce) {
-    h = hvd_allreduce_async(m.name.c_str(), ins[0], out, m.dims.data(),
+    h = hvd_allreduce_async(m.name.c_str(), data, out, m.dims.data(),
                             (int)m.dims.size(), m.dtype, m.red_op_or_root,
                             m.prescale, m.postscale, m.process_set, -1, 0);
   } else if (m.kind == kBroadcast) {
-    h = hvd_broadcast_async(m.name.c_str(), ins[0], out, m.dims.data(),
+    h = hvd_broadcast_async(m.name.c_str(), data, out, m.dims.data(),
                             (int)m.dims.size(), m.dtype, m.red_op_or_root,
                             m.process_set);
   } else if (m.kind == kAllgather) {
-    h = hvd_allgather_async(m.name.c_str(), ins[0], m.dims.data(),
+    h = hvd_allgather_async(m.name.c_str(), data, m.dims.data(),
                             (int)m.dims.size(), m.dtype, m.process_set,
                             -1, 0);
     core_owned_out = true;
   } else if (m.kind == kReducescatter) {
-    h = hvd_reducescatter_async(m.name.c_str(), ins[0], m.dims.data(),
+    h = hvd_reducescatter_async(m.name.c_str(), data, m.dims.data(),
                                 (int)m.dims.size(), m.dtype,
                                 m.red_op_or_root, m.prescale, m.postscale,
                                 m.process_set, -1, 0);
@@ -232,15 +241,14 @@ extern "C" void hvd_tpu_xla_collective(void* out, const void** ins,
   }
   if (h < 0) {
     const char* e = hvd_last_error();
-    Fail(status, std::string("enqueue failed: ") + (e ? e : "unknown"));
-    return;
+    return std::string("enqueue failed: ") + (e && *e ? e : "unknown");
   }
   int rc = hvd_wait(h);
   if (rc != 1) {
     const char* e = hvd_last_error();
-    Fail(status, e ? e : "unknown");
+    std::string msg = e && *e ? e : "unknown";
     hvd_release(h);
-    return;
+    return msg;
   }
   if (core_owned_out) {
     // XLA's output buffer size is FIXED at the shape the program was
@@ -255,18 +263,37 @@ extern "C" void hvd_tpu_xla_collective(void* out, const void** ins,
     expect[0] = m.out_dim0;
     if (ondim != (int)expect.size() ||
         !std::equal(expect.begin(), expect.end(), oshape.begin())) {
-      Fail(status,
-           "in-XLA allgather/reducescatter requires uniform shards: the "
-           "collective's actual output shape differs from the compiled "
-           "static shape (ragged inputs must use the eager/graph path)");
       hvd_release(h);
-      return;
+      return "in-XLA allgather/reducescatter requires uniform shards: "
+             "the collective's actual output shape differs from the "
+             "compiled static shape (ragged inputs must use the "
+             "eager/graph path)";
     }
     int64_t bytes = (int64_t)hvd::DataTypeSize((hvd::DataType)m.dtype);
     for (long long d : oshape) bytes *= d;
     if (bytes) memcpy(out, hvd_output_ptr(h), bytes);
   }
   hvd_release(h);
+  return "";
+}
+
+// -- legacy ABI (API_VERSION_STATUS_RETURNING) ------------------------------
+// Kept as an escape hatch (HVD_XLA_LEGACY_CUSTOM_CALL=1 switches emission
+// back) while the typed-FFI path below is the default: XLA:CPU logs a
+// removal warning for this ABI and the FFI registry is the supported
+// mechanism.
+
+extern "C" void hvd_tpu_xla_collective(void* out, const void** ins,
+                                       XlaCustomCallStatus* status) {
+  // "horovod_tpu collective failed" matches tf_ops.cc's wording; the
+  // core's shutdown/HorovodInternalError markers inside the message are
+  // what elastic._is_native_op_failure keys on.
+  std::string err = RunCollective(
+      ins[0], reinterpret_cast<const uint8_t*>(ins[1]), out);
+  if (!err.empty()) {
+    std::string full = "horovod_tpu collective failed: " + err;
+    XlaCustomCallStatusSetFailure(status, full.c_str(), full.size());
+  }
 }
 
 struct TargetRegisterer {
@@ -277,6 +304,33 @@ struct TargetRegisterer {
   }
 };
 TargetRegisterer target_registerer;
+
+// -- typed FFI ABI (API_VERSION_TYPED_FFI, the supported path) --------------
+// Same wire: arg0 = data buffer, arg1 = u8[] metadata blob, ret0 = out.
+// Registered in the FFI registry under the same target name (separate
+// namespace from the legacy CustomCallTargetRegistry).
+
+namespace xf = ::xla::ffi;
+
+xf::Error HvdCollectiveFfi(xf::AnyBuffer data, xf::AnyBuffer meta,
+                           xf::Result<xf::AnyBuffer> out) {
+  std::string err = RunCollective(
+      data.untyped_data(),
+      reinterpret_cast<const uint8_t*>(meta.untyped_data()),
+      out->untyped_data());
+  if (!err.empty())
+    return xf::Error::Internal("horovod_tpu collective failed: " + err);
+  return xf::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER(kHvdCollectiveFfi, HvdCollectiveFfi,
+                       xf::Ffi::Bind()
+                           .Arg<xf::AnyBuffer>()
+                           .Arg<xf::AnyBuffer>()
+                           .Ret<xf::AnyBuffer>());
+XLA_FFI_REGISTER_HANDLER(::xla::ffi::GetXlaFfiApi(),
+                         "hvd_tpu_xla_collective", "Host",
+                         kHvdCollectiveFfi);
 
 // ---------------------------------------------------------------------------
 // XlaOpKernels. Registered for the SAME op names tf_ops.cc defines, so
@@ -293,11 +347,16 @@ xla::XlaOp EmitCollective(XlaOpKernelContext* ctx, const Meta& m,
   if (out_dim0 >= 0) out_shape.set_dimensions(0, out_dim0);
   // has_side_effect: a collective must not be CSE'd or dead-code-eliminated
   // — every rank's program must enqueue it exactly once.
+  static const bool legacy = [] {
+    const char* v = getenv("HVD_XLA_LEGACY_CUSTOM_CALL");
+    return v && v[0] == '1';
+  }();
   return xla::CustomCall(
       b, "hvd_tpu_xla_collective", {x, meta}, out_shape, /*opaque=*/"",
       /*has_side_effect=*/true, /*output_operand_aliasing=*/{},
       /*literal=*/nullptr, xla::CustomCallSchedule::SCHEDULE_NONE,
-      xla::CustomCallApiVersion::API_VERSION_STATUS_RETURNING);
+      legacy ? xla::CustomCallApiVersion::API_VERSION_STATUS_RETURNING
+             : xla::CustomCallApiVersion::API_VERSION_TYPED_FFI);
 }
 
 class HvdTpuAllreduceXlaOp : public XlaOpKernel {

@@ -52,20 +52,23 @@ def make_train_step(loss_fn, tx, mesh, data_axis="data", extra_reduce=None,
         raise ValueError(f"grad_reduce must be 'mean' or 'adasum', "
                          f"got {grad_reduce!r}")
 
+    # Gradient reducer picked ONCE at build time: "adasum" = the
+    # device-plane Adasum (ops/jax_ops.py `adasum` — op=hvd.Adasum
+    # analog, VHDD on ICI); "mean" = pmean ring. The LOSS is always
+    # pmean'd — adasum applies to gradients.
+    if grad_reduce == "adasum":
+        from ..ops.jax_ops import adasum as _reduce_one
+    else:
+        _reduce_one = jax.lax.pmean
+
     def _pmean_all(x):
         for ax in axes:
             x = jax.lax.pmean(x, ax)
         return x
 
     def _grad_reduce_all(x):
-        from ..ops import jax_ops
-
         for ax in axes:
-            # "adasum" = the device-plane Adasum (ops/jax_ops.py `adasum`
-            # — op=hvd.Adasum analog, VHDD on ICI); "mean" = pmean ring.
-            # The LOSS is always pmean'd — adasum applies to gradients.
-            x = jax_ops.adasum(x, ax) if grad_reduce == "adasum" \
-                else jax.lax.pmean(x, ax)
+            x = _reduce_one(x, ax)
         return x
 
     def _shard_grad(params, batch):

@@ -207,19 +207,15 @@ def open_artifact(store, path, mode="wb"):
 
 
 def load_shard(path, rank, store=None):
-    """Read rank's materialized shard → (X, Y) float32 arrays. With a
-    store, bytes come through its filesystem adapter (remote stores);
-    without one, plain local IO (the shards a LocalStore wrote are
-    ordinary files)."""
+    """Read rank's materialized shard → (X, Y) float32 arrays, through
+    :func:`open_artifact` (store adapter when present, local IO
+    otherwise)."""
     import io
 
     name = os.path.join(path, f"shard-{rank}.npz")
-    if store is not None:
-        with store.open_read(name) as f:
-            with np.load(io.BytesIO(f.read())) as z:
-                return z["X"], z["Y"]
-    with np.load(name) as z:
-        return z["X"], z["Y"]
+    with open_artifact(store, name, "rb") as f:
+        with np.load(io.BytesIO(f.read())) as z:
+            return z["X"], z["Y"]
 
 
 class HorovodModel:

@@ -166,11 +166,15 @@ class TorchModel(HorovodModel):
         ``model`` is an architecture instance to load the state_dict into.
         Pass the ``store`` for checkpoints living behind a remote
         filesystem adapter."""
+        import io
+
         import torch
 
         with open_artifact(store, os.path.join(checkpoint_path,
                                                "model.pt"), "rb") as f:
-            state = torch.load(f, weights_only=True)
+            # Buffer: torch.load needs a seekable file, and the adapter
+            # contract doesn't promise one (streaming object stores).
+            state = torch.load(io.BytesIO(f.read()), weights_only=True)
         model.load_state_dict(state)
         return cls(model, feature_cols, label_cols,
                    checkpoint_path=checkpoint_path, output_cols=output_cols)

@@ -59,6 +59,17 @@ def test_tf_xla_ops_fallback():
     run_worker_job(2, "tf_xla_worker.py", timeout=300)
 
 
+def test_tf_xla_ops_legacy_abi_2proc():
+    """The legacy API_VERSION_STATUS_RETURNING ABI stays selectable
+    (HVD_XLA_LEGACY_CUSTOM_CALL=1) behind the typed-FFI default — both
+    ABIs share RunCollective, so the full worker matrix must pass under
+    either emission."""
+    pytest.importorskip("tensorflow")
+    run_worker_job(2, "tf_xla_worker.py", timeout=300,
+                   extra_env={"HVD_ENABLE_XLA_OPS": "1",
+                              "HVD_XLA_LEGACY_CUSTOM_CALL": "1"})
+
+
 def test_mxnet_binding_2proc():
     """The full mxnet surface (collectives, broadcast_parameters,
     DistributedOptimizer, DistributedTrainer) executes end-to-end over the
