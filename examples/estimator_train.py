@@ -44,4 +44,38 @@ out = fitted.transform(df.head(3))
 print(out[["y", "y__output"]].round(3).to_string())
 if EPOCHS > 1:  # CI may run a single tiny epoch; only then is there a trend
     assert fitted.history[-1] < fitted.history[0]
+
+# --- LightningEstimator: the module owns loss + optimizer ----------------
+# (reference: horovod/spark/lightning/estimator.py). The estimator
+# consumes the LightningModule core PROTOCOL — a real pl.LightningModule
+# works unmodified, and so does this plain nn.Module with the hooks:
+from horovod_tpu.spark.lightning import LightningEstimator
+
+
+class LinRegModule(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.lin = torch.nn.Linear(4, 1)
+
+    def forward(self, x):
+        return self.lin(x)
+
+    def training_step(self, batch, batch_idx):
+        x, y = batch
+        return torch.nn.functional.mse_loss(self(x), y)
+
+    def configure_optimizers(self):
+        return torch.optim.SGD(self.parameters(), lr=0.1)
+
+
+lest = LightningEstimator(
+    model=LinRegModule(),
+    feature_cols=["f0", "f1", "f2", "f3"], label_cols=["y"],
+    batch_size=32, epochs=EPOCHS, num_proc=NP,
+    store=LocalStore(os.environ.get("STORE",
+                                    "/tmp/estimator-demo-store")))
+lfit = lest.fit(df)
+print(f"lightning loss: {lfit.history[0]:.4f} -> {lfit.history[-1]:.4f}")
+if EPOCHS > 1:
+    assert lfit.history[-1] < lfit.history[0]
 print("estimator demo OK")

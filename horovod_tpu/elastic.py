@@ -24,6 +24,7 @@ epoch's rank/size/controller assignment from the driver's KV store, and
 re-init (see `horovod_tpu.runner.elastic.worker`).
 """
 
+import os
 import copy
 import functools
 
@@ -195,7 +196,50 @@ def _is_native_op_failure(e):
     if not isinstance(e, tuple(wrapper_types)):
         return False
     msg = str(e)
-    return "HorovodInternalError" in msg or "shutdown" in msg
+    # Markers, in two families:
+    # - the core's own elastic signals ("HorovodInternalError",
+    #   "shutdown");
+    # - the transport-death spellings a peer failure surfaces as when it
+    #   strikes mid-collective, before the core has marked shutdown —
+    #   e.g. "recv: peer closed" reaching a compiled step through the
+    #   native kernels (timing-dependent; caught live by
+    #   test_elastic_resize_under_compiled_xla_predivide). These come
+    #   from csrc/tcp.cc ("<op>: peer closed", errno spellings) and
+    #   csrc/collectives.cc ("data-plane peer failed/closed",
+    #   "data-plane poll timeout").
+    # DETERMINISTIC native failures (bad dtype, unknown process set, the
+    # ragged-shard XLA error) match neither family and must surface —
+    # looping restore/rendezvous on them would retry forever.
+    transient = ("HorovodInternalError", "shutdown", "peer closed",
+                 "peer failed", "poll timeout", "background loop failed",
+                 "Connection reset", "Broken pipe")
+    return any(t in msg for t in transient)
+
+
+def _retry_reset(reset):
+    """Run `reset()` (shutdown → new assignment → init), retrying when the
+    rendezvous itself fails. Membership can change AGAIN while a reset is
+    in flight — e.g. a just-spawned replacement is excluded because
+    discovery shrank, so the epoch this worker is re-initializing for
+    never completes registration. That is a normal elastic transition,
+    not a worker bug: ask the driver for the newer assignment and try
+    again instead of crashing a healthy worker (observed live in
+    test_elastic_resize_under_compiled_xla_predivide; the reference's
+    driver/worker rendezvous loops the same way)."""
+    # max(1, ·): zero/negative would skip reset() entirely and hand the
+    # caller a dead core.
+    attempts = max(1, int(os.environ.get("HVD_ELASTIC_RESET_ATTEMPTS",
+                                         "3")))
+    for attempt in range(attempts):
+        try:
+            return reset()
+        except (SystemExit, KeyboardInterrupt):
+            raise
+        except Exception as e:  # noqa: BLE001 — any rendezvous failure
+            if attempt + 1 >= attempts:
+                raise
+            print(f"[hvd elastic] reset attempt {attempt + 1} failed "
+                  f"({e}); re-entering rendezvous", flush=True)
 
 
 def run_fn(func, reset):
@@ -215,7 +259,7 @@ def run_fn(func, reset):
             while True:
                 if reset_required:
                     state.prepare_reset()
-                    reset()
+                    _retry_reset(reset)
                     state.on_reset()
                     reset_required = False
                 state.sync()

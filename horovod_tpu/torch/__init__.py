@@ -469,11 +469,13 @@ class _DistributedOptimizerMixin:
 
     def _hvd_init(self, named_parameters, op, compression,
                   backward_passes_per_step, process_set,
-                  gradient_predivide_factor=1.0, num_groups=0):
+                  gradient_predivide_factor=1.0, num_groups=0,
+                  sparse_as_dense=False):
         self._hvd_op = op
         self._hvd_compression = compression
         self._hvd_bpps = backward_passes_per_step
         self._hvd_process_set = process_set
+        self._hvd_sparse_as_dense = bool(sparse_as_dense)
         self._hvd_predivide = float(gradient_predivide_factor)
         _core.validate_predivide(op, self._hvd_predivide)
         self._hvd_step_count = 0
@@ -571,6 +573,18 @@ class _DistributedOptimizerMixin:
             return
         if p in self._hvd_handles:
             return
+        if p.grad is not None and p.grad.is_sparse:
+            # Reference semantics (horovod/torch sparse_as_dense):
+            # densify before the dense allreduce, or fail loudly — a
+            # sparse layout silently fed to the dense plane would be
+            # garbage.
+            if not self._hvd_sparse_as_dense:
+                raise ValueError(
+                    f"parameter {self._hvd_names.get(p, id(p))} produced "
+                    f"a sparse gradient (e.g. nn.Embedding(sparse=True)); "
+                    f"pass sparse_as_dense=True to DistributedOptimizer "
+                    f"to densify it before allreduce")
+            p.grad = p.grad.coalesce().to_dense()
         # Execution-time factors (shared helper): elastic resizes are
         # honored and an unknown process set fails loudly.
         op, pre, post = _core.predivide_factors(
@@ -626,7 +640,7 @@ class _DistributedOptimizerMixin:
 def DistributedOptimizer(optimizer, named_parameters=None, op=Average,
                          compression=None, backward_passes_per_step=1,
                          process_set=0, gradient_predivide_factor=1.0,
-                         num_groups=0):
+                         num_groups=0, sparse_as_dense=False):
     """Wrap a torch optimizer: backward hooks launch async allreduces per
     gradient (overlapped with the rest of backward); step() synchronizes
     then applies (reference: horovod/torch DistributedOptimizer).
@@ -637,14 +651,17 @@ def DistributedOptimizer(optimizer, named_parameters=None, op=Average,
     its gradients arrived (reference: num_groups / group_table.cc).
     ``compression=Compression.fp16``/``bf16`` stays on the native
     extension (wire-buffer cast in csrc/torch_ops.cc); custom compressors
-    use the numpy bridge."""
+    use the numpy bridge. ``sparse_as_dense=True`` densifies sparse
+    gradients (nn.Embedding(sparse=True)) before allreduce (reference:
+    the torch optimizer's sparse_as_dense flag); without it a sparse
+    gradient fails loudly."""
     cls = type("DistributedOptimizer",
                (_DistributedOptimizerMixin, optimizer.__class__), {})
     dist = cls.__new__(cls)
     dist.__dict__.update(optimizer.__dict__)
     dist._hvd_init(named_parameters, op, compression,
                    backward_passes_per_step, process_set,
-                   gradient_predivide_factor, num_groups)
+                   gradient_predivide_factor, num_groups, sparse_as_dense)
     return dist
 
 

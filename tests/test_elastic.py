@@ -274,3 +274,44 @@ def test_elastic_tf_failure_recovery(tmp_path):
     finals = [line for line in log.splitlines() if line.startswith("final")]
     assert len(finals) == 2, f"expected 2 finishers:\n{log}\n{out}"
     assert all("iter=6" in line for line in finals), log
+
+
+TF_XLA_WORKER = os.path.join(os.path.dirname(__file__), "workers",
+                             "elastic_tf_xla_worker.py")
+
+
+def test_elastic_resize_under_compiled_xla_predivide(tmp_path):
+    """ADVICE r4 medium, live: a jit_compile=True step with
+    gradient_predivide_factor traced at size 2 must keep producing exact
+    averages after the world SHRINKS to 1 (no stale size in the trace —
+    the core divides by the negotiated member count at execution time).
+    The rank death also drives the typed-FFI error path through
+    elastic._is_native_op_failure."""
+    import pytest
+
+    pytest.importorskip("tensorflow")
+    marker = tmp_path / "xla-died.marker"
+
+    def shrink(hosts_file):
+        # Once the injected death happened, take the slot out of
+        # discovery so the driver re-meshes at size 1 instead of
+        # respawning back to 2.
+        deadline = time.time() + 90
+        while time.time() < deadline and not marker.exists():
+            time.sleep(0.1)
+        hosts_file.write_text("localhost:1\n")
+
+    rc, log, out = _run_elastic(
+        tmp_path, "localhost:2",
+        {"TEST_ITERS": "6", "TEST_SLEEP": "0.2",
+         "TEST_FAIL_SLOT": "1", "TEST_MARKER": str(marker),
+         "HVD_ENABLE_XLA_OPS": "1", "JAX_PLATFORMS": "cpu"},
+        min_np=1, max_np=2, worker=TF_XLA_WORKER, timeout=300,
+        mutate=shrink)
+    assert rc == 0, f"job failed rc={rc}\n{out}"
+    assert marker.exists(), "failure was never injected"
+    finals = [line for line in log.splitlines() if line.startswith("final")]
+    assert len(finals) >= 1, f"no finisher:\n{log}\n{out}"
+    sizes = finals[0].split("sizes=")[1].split(",")
+    # The same compiled function ran (asserted in-worker) at BOTH sizes.
+    assert "2" in sizes and "1" in sizes, finals[0]

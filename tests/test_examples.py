@@ -29,3 +29,25 @@ def test_torch_bert_compression_graded_config():
     _run_example("torch_synthetic_benchmark.py",
                  {"MODEL": "bert", "FP16": 1, "NUM_GROUPS": 2,
                   "STEPS": 2, "BATCH": 2, "SEQ": 32})
+
+
+def test_estimator_example_torch_and_lightning():
+    """examples/estimator_train.py end-to-end tiny: TorchEstimator and
+    LightningEstimator (protocol module, no pytorch_lightning import)
+    both fit and transform. The script spawns its own ranks."""
+    import subprocess
+    import sys
+    import tempfile
+
+    from .util import tpu_isolated_env
+
+    env = dict(os.environ)
+    env.update(tpu_isolated_env())
+    env.update({"ROWS": "64", "EPOCHS": "2", "NP": "2",
+                "STORE": tempfile.mkdtemp(prefix="hvd-ex-store-")})
+    p = subprocess.run(
+        [sys.executable, os.path.join(_EXAMPLES, "estimator_train.py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr}"
+    assert "estimator demo OK" in p.stdout
+    assert "lightning loss" in p.stdout

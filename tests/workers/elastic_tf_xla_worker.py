@@ -1,0 +1,90 @@
+"""Elastic resize under a fully XLA-compiled predivide step.
+
+The live version of the ADVICE r4 medium contract: a
+`tf.function(jit_compile=True)` train step with
+``gradient_predivide_factor`` is traced once (at the starting world
+size), a rank dies AND the discovery output shrinks, and THE SAME
+compiled program keeps producing exact averages at the new size — the
+trace bakes only the size-free ``(1/f, f)`` pair; Average's 1/members
+comes from the core at collective-execution time
+(csrc/core.cc `EffectivePostscale`). Also exercises the typed-FFI
+error path end-to-end: the peer death surfaces from INSIDE the compiled
+program as tf.errors with the core's failure markers, which
+elastic._is_native_op_failure must map to restore-and-rendezvous.
+"""
+import os
+import time
+
+import numpy as np
+
+import horovod_tpu.tensorflow as hvd
+
+hvd.init()
+import tensorflow as tf  # noqa: E402
+
+from horovod_tpu.tensorflow import native_ops  # noqa: E402
+
+assert native_ops.xla_enabled(), "worker requires HVD_ENABLE_XLA_OPS=1"
+
+ITERS = int(os.environ.get("TEST_ITERS", "8"))
+SLEEP = float(os.environ.get("TEST_SLEEP", "0.2"))
+FAIL_SLOT = os.environ.get("TEST_FAIL_SLOT")
+MARKER = os.environ.get("TEST_MARKER", "")
+WID = os.environ.get("HVD_WORKER_ID", "?")
+
+w = tf.Variable(tf.ones([4]))
+
+
+@tf.function(jit_compile=True)
+def grad_step(x):
+    with tf.GradientTape() as t:
+        loss = tf.reduce_sum(w * x)
+    dtape = hvd.DistributedGradientTape(t, gradient_predivide_factor=4.0)
+    (g,) = dtape.gradient(loss, [w])
+    return g
+
+
+def _should_die(it):
+    if FAIL_SLOT is None or not MARKER or os.path.exists(MARKER):
+        return False
+    return it == 2 and WID.startswith(f"localhost-{FAIL_SLOT}-")
+
+
+state = hvd.elastic.ObjectState(iteration=0, sizes=[])
+
+
+@hvd.elastic.run
+def train(state):
+    while state.iteration < ITERS:
+        r, s = hvd.rank(), hvd.size()
+        if _should_die(state.iteration):
+            open(MARKER, "w").write("died\n")
+            os._exit(1)
+        g = grad_step(tf.fill([4], float(r + 1)))
+        # d(loss)/dw = x = r+1 on rank r; Average over the CURRENT
+        # members = mean(1..s) = (s+1)/2, independent of f=4. A stale
+        # size baked at trace time would break this after the resize.
+        assert np.allclose(g.numpy(), (s + 1) / 2.0), (g.numpy(), s)
+        state.sizes = state.sizes + [s]
+        state.iteration += 1
+        state.commit()
+        time.sleep(SLEEP)
+
+
+train(state)
+
+# The central claim — no stale size in the trace — requires that the
+# SAME compiled program served both world sizes: a silent retrace after
+# the resize would re-bake factors and pass the numeric asserts
+# vacuously.
+assert grad_step.experimental_get_tracing_count() == 1, \
+    grad_step.experimental_get_tracing_count()
+
+log = os.environ.get("TEST_LOG")
+if log:
+    with open(log, "a") as f:
+        f.write(f"final iter={state.iteration} "
+                f"sizes={','.join(map(str, state.sizes))}\n")
+print(f"rank {hvd.rank()}: elastic-xla PASS sizes={state.sizes}",
+      flush=True)
+hvd.shutdown()

@@ -190,5 +190,38 @@ opt_cc.synchronize()
 assert opt_cc._hvd_stats["bridge"] == 1, opt_cc._hvd_stats
 assert np.allclose(p_cc.grad.numpy(), (s + 1) / 2.0, atol=1e-2)
 
+# sparse gradients (reference: sparse_as_dense): an Embedding(sparse=True)
+# grad is densified before the dense allreduce; without the flag it must
+# fail loudly, never feed a sparse layout to the wire.
+emb = torch.nn.Embedding(6, 4, sparse=True)
+with torch.no_grad():
+    emb.weight.fill_(0.0)
+opt_sp = hvd.DistributedOptimizer(
+    torch.optim.SGD(emb.parameters(), lr=1.0), sparse_as_dense=True)
+idx = torch.tensor([r, r + 1])  # rank-dependent rows
+emb(idx).sum().backward()
+opt_sp.synchronize()
+g = emb.weight.grad
+assert not g.is_sparse
+# row k's dense grad on rank r is 1 iff k in {r, r+1}; averaged over
+# ranks it is count(k in {r, r+1} for r in ranks) / s.
+expect = np.zeros((6, 4), np.float32)
+for q in range(s):
+    expect[q] += 1.0
+    expect[q + 1] += 1.0
+expect /= s
+assert np.allclose(g.numpy(), expect, atol=1e-6), g.numpy()
+
+opt_sp2 = hvd.DistributedOptimizer(
+    torch.optim.SGD([torch.nn.Parameter(torch.zeros(6, 4))], lr=1.0))
+p_sp = opt_sp2.param_groups[0]["params"][0]
+p_sp.grad = torch.sparse_coo_tensor(
+    torch.tensor([[0], [0]]), torch.ones(1), (6, 4))
+try:
+    opt_sp2._hvd_hook(p_sp)
+    raise SystemExit("sparse grad without sparse_as_dense must raise")
+except ValueError as e:
+    assert "sparse_as_dense" in str(e), e
+
 print(f"rank {r}: TORCH PASS", flush=True)
 hvd.shutdown()
