@@ -153,12 +153,19 @@ class Reader {
   }
   std::vector<int64_t> i64vec() {
     uint32_t n = u32();
+    // Validate the claimed count against the bytes actually present
+    // BEFORE allocating: a corrupt/hostile length must throw the normal
+    // truncation error, not attempt a multi-GB vector first.
+    if ((size_t)n * 8 > (size_t)(end_ - p_))
+      throw std::runtime_error("wire: truncated message");
     std::vector<int64_t> v(n);
     for (uint32_t i = 0; i < n; i++) v[i] = i64();
     return v;
   }
   std::vector<uint32_t> u32vec() {
     uint32_t n = u32();
+    if ((size_t)n * 4 > (size_t)(end_ - p_))
+      throw std::runtime_error("wire: truncated message");
     std::vector<uint32_t> v(n);
     for (uint32_t i = 0; i < n; i++) v[i] = u32();
     return v;

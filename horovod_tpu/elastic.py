@@ -211,9 +211,17 @@ def _is_native_op_failure(e):
     # ragged-shard XLA error) match neither family and must surface —
     # looping restore/rendezvous on them would retry forever.
     transient = ("HorovodInternalError", "shutdown", "peer closed",
-                 "peer failed", "poll timeout", "background loop failed",
-                 "Connection reset", "Broken pipe")
-    return any(t in msg for t in transient)
+                 "peer failed", "poll timeout", "background loop failed")
+    if any(t in msg for t in transient):
+        return True
+    # Bare errno spellings are too generic on their own — a tf.data read
+    # from a dead GCS endpoint also says "Connection reset by peer" and
+    # must SURFACE, not loop. Accept them only inside the native
+    # kernels' own message prefix (emitted solely by csrc/tf_ops.cc /
+    # tf_xla_ops.cc wrapping the core's transport error).
+    return "horovod_tpu collective failed" in msg and any(
+        t in msg for t in ("Connection reset", "Broken pipe",
+                           "recv:", "send:"))
 
 
 def _retry_reset(reset):

@@ -31,20 +31,21 @@ def test_torch_bert_compression_graded_config():
                   "STEPS": 2, "BATCH": 2, "SEQ": 32})
 
 
-def test_estimator_example_torch_and_lightning():
+def test_estimator_example_torch_and_lightning(tmp_path):
     """examples/estimator_train.py end-to-end tiny: TorchEstimator and
     LightningEstimator (protocol module, no pytorch_lightning import)
     both fit and transform. The script spawns its own ranks."""
     import subprocess
     import sys
-    import tempfile
 
     from .util import tpu_isolated_env
 
+    pytest.importorskip("torch")
+    pytest.importorskip("pandas")
     env = dict(os.environ)
     env.update(tpu_isolated_env())
     env.update({"ROWS": "64", "EPOCHS": "2", "NP": "2",
-                "STORE": tempfile.mkdtemp(prefix="hvd-ex-store-")})
+                "STORE": str(tmp_path / "store")})
     p = subprocess.run(
         [sys.executable, os.path.join(_EXAMPLES, "estimator_train.py")],
         env=env, capture_output=True, text=True, timeout=420)

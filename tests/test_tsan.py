@@ -4,9 +4,9 @@ threads enqueueing into a single background thread over lock-protected
 queues — is exactly what TSAN validates cheaply).
 
 Builds libhvd_tpu_tsan.so (`make tsan`), preloads libtsan into python,
-points HVD_LIB at the instrumented core, and runs the full 2-rank
-collective matrix. Any data race inside the core shows up as a
-ThreadSanitizer report naming hvd:: frames / the tsan lib.
+points HVD_LIB at the instrumented core, and runs multi-rank jobs. Any
+data race inside the core shows up as a ThreadSanitizer report naming
+hvd:: frames / the tsan lib.
 """
 import os
 import subprocess
@@ -30,7 +30,9 @@ def _libtsan():
         return None
 
 
-def test_core_collective_matrix_under_tsan(tmp_path):
+def _run_under_tsan(tmp_path, worker, np_, extra_env=None):
+    """Shared harness: instrumented core + preload, run `worker` with
+    np_ ranks, return (proc, core_reports)."""
     libtsan = _libtsan()
     if libtsan is None:
         pytest.skip("gcc/libtsan unavailable")
@@ -44,18 +46,17 @@ def test_core_collective_matrix_under_tsan(tmp_path):
         "HVD_LIB": TSAN_CORE,
         # exitcode=0: we grade on the reports we parse, so an unrelated
         # race in a third-party lib can't fail the job spuriously.
-        # log_path=%p-suffixed files: both ranks share the runner's stderr
+        # log_path=%p-suffixed files: all ranks share the runner's stderr
         # pipe, where concurrent reports could interleave and tear past
         # the 'hvd' filter below.
         "TSAN_OPTIONS": f"exitcode=0:log_path={tmp_path}/tsan",
     })
+    env.update({k: str(v) for k, v in (extra_env or {}).items()})
     p = subprocess.run(
-        [sys.executable, "-m", "horovod_tpu.runner.local", "-np", "2",
-         sys.executable, os.path.join(WORKERS, "collective_worker.py")],
+        [sys.executable, "-m", "horovod_tpu.runner.local", "-np",
+         str(np_), sys.executable, os.path.join(WORKERS, worker)],
         env=env, capture_output=True, text=True, timeout=600)
-    assert p.returncode == 0, p.stderr[-3000:]
-    assert p.stdout.count("PASS") == 2, p.stdout
-    # A failed preload runs everything UNinstrumented with exit 0 — the
+    # A failed preload runs everything UNinstrumented with exit 0 — a
     # green result would be vacuous. ld.so names the failure on stderr.
     assert "cannot be preloaded" not in p.stderr, p.stderr[-2000:]
 
@@ -68,5 +69,30 @@ def test_core_collective_matrix_under_tsan(tmp_path):
                         if "WARNING: ThreadSanitizer" in b]
     core_reports = [b for b in reports
                     if "hvd" in b or "libhvd_tpu_tsan" in b]
+    return p, core_reports
+
+
+def test_core_collective_matrix_under_tsan(tmp_path):
+    p, core_reports = _run_under_tsan(tmp_path, "collective_worker.py", 2)
+    assert p.returncode == 0, p.stderr[-3000:]
+    assert p.stdout.count("PASS") == 2, p.stdout
+    assert not core_reports, "TSAN races in the core:\n" + \
+        "\n".join(core_reports[:3])
+
+
+def test_reinit_and_auth_under_tsan(tmp_path):
+    """The round-5 rendezvous additions under the sanitizer: rebind
+    backoff + worker re-dial (rapid re-init cycles) and the connect-time
+    HMAC handshake, including the acceptor thread + dial loop interplay
+    (Listener::Shutdown wake path). 4 ranks x 2 unstaggered cycles with
+    a job secret."""
+    import secrets
+
+    p, core_reports = _run_under_tsan(
+        tmp_path, "reinit_worker.py", 4,
+        extra_env={"HVD_RENDEZVOUS_SECRET": secrets.token_hex(16),
+                   "REINIT_CYCLES": "2"})
+    assert p.returncode == 0, p.stderr[-3000:]
+    assert p.stdout.count("PASS") == 4, p.stdout
     assert not core_reports, "TSAN races in the core:\n" + \
         "\n".join(core_reports[:3])
