@@ -56,7 +56,10 @@ import time
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_CACHE_PATH = os.path.join(_HERE, "bench_cache.json")
+# BENCH_CACHE_PATH override exists for the harness tests (seeding a temp
+# cache without clobbering the repo's real round record).
+_CACHE_PATH = os.environ.get("BENCH_CACHE_PATH",
+                             os.path.join(_HERE, "bench_cache.json"))
 
 # bf16 peak TFLOP/s by PJRT device_kind prefix (longest match wins).
 _PEAK_TFLOPS = {
@@ -572,6 +575,8 @@ def _probe_relay(timeout=_PROBE_TIMEOUT):
     code = ("import jax, jax.numpy as jnp, numpy as np; "
             "x = jax.jit(lambda a: a*2+1)(jnp.ones((128,128))); "
             "print('PROBE_OK', float(np.asarray(x).sum()))")
+    if os.environ.get("_BENCH_TEST_HANG") == "probe":
+        code = "import time; time.sleep(1e6)"  # test hook: wedged relay
     t0 = time.perf_counter()
     rc, out = _run_subprocess([sys.executable, "-c", code],
                               dict(os.environ), timeout)
@@ -606,6 +611,13 @@ def _error_line(name, note, **extra_fields):
          "vs_baseline": 0.0, "error": note}
     d.update(extra_fields)
     return d
+
+
+def _cap(name):
+    """Per-config sub-deadline; BENCH_CAP_<NAME> overrides (tests shrink
+    them to exercise the kill path in seconds)."""
+    return float(os.environ.get(f"BENCH_CAP_{name.upper()}",
+                                _CONFIG_CAPS[name]))
 
 
 def _run_config_child(name, timeout):
@@ -656,6 +668,8 @@ def main():
     if os.environ.get("_BENCH_CHILD") == "1":
         if which not in _CONFIG_FNS:
             raise SystemExit(f"unknown BENCH_CONFIG={which!r}")
+        if os.environ.get("_BENCH_TEST_HANG") == which:
+            time.sleep(1e6)  # test hook: simulate a wedged config
         _emit(_retry_transient(_CONFIG_FNS[which]))
         return
 
@@ -667,8 +681,7 @@ def main():
     # Single-config mode: still subprocess-isolated so a wedge mid-config
     # cannot hang the caller.
     if which in _CONFIG_FNS:
-        d = _run_config_child(which, max(30, min(_CONFIG_CAPS[which],
-                                                 remaining())))
+        d = _run_config_child(which, max(5, min(_cap(which), remaining())))
         _emit(d)
         return
     if which != "all":
@@ -677,7 +690,8 @@ def main():
 
     # Full run. Probe the relay first — a wedge costs _PROBE_TIMEOUT
     # seconds here instead of the whole driver budget.
-    ok, info = _probe_relay(min(_PROBE_TIMEOUT, max(30, remaining() - 30)))
+    probe_to = float(os.environ.get("BENCH_PROBE_TIMEOUT", _PROBE_TIMEOUT))
+    ok, info = _probe_relay(max(5.0, min(probe_to, remaining() - 10)))
     if not ok:
         _wedged_fallback(str(info))
         return
@@ -685,7 +699,7 @@ def main():
     results = {}
     order = ["resnet50", "transformer", "allreduce", "longctx", "hostplane"]
     for name in order:
-        cap = _CONFIG_CAPS[name]
+        cap = _cap(name)
         left = remaining() - 15  # reserve for final assembly
         if left < 45:
             results[name] = _error_line(
@@ -701,9 +715,13 @@ def main():
     final = dict(results["resnet50"])
     final["extra"] = {k: results[k] for k in order if k != "resnet50"}
     final["probe_seconds"] = info
-    # Cache only real-accelerator runs: a CPU smoke run must never become
-    # the wedge-fallback record.
-    if "error" not in final and final.get("platform") not in (None, "cpu"):
+    # Cache only CLEAN real-accelerator runs: a CPU smoke run must never
+    # become the wedge-fallback record, and neither may a round where any
+    # config errored/was killed — _wedged_fallback would replay that
+    # degraded line as if it were a good baseline.
+    any_error = ("error" in final or
+                 any("error" in v for v in final["extra"].values()))
+    if not any_error and final.get("platform") not in (None, "cpu"):
         cache_rec = dict(final)
         cache_rec["cached_note"] = (
             "last successful full bench run; re-emitted with "
