@@ -126,9 +126,15 @@ def _bench_resnet50():
     steps = 3 if on_cpu else 30
     warmup = 1 if on_cpu else 5
     stem = os.environ.get("HVD_BENCH_STEM", "s2d")
+    norm = os.environ.get("HVD_BENCH_NORM", "flax")
+    if norm not in ("flax", "pallas"):
+        # A typo'd value would silently measure flax BN under a bogus
+        # label in the recorded line.
+        raise SystemExit(f"HVD_BENCH_NORM={norm!r}: choose flax|pallas")
 
     model, variables = resnet.create_train_state(
-        jax.random.PRNGKey(0), image_size=image, num_classes=1000, stem=stem)
+        jax.random.PRNGKey(0), image_size=image, num_classes=1000,
+        stem=stem, norm=norm)
     params, batch_stats = variables["params"], variables["batch_stats"]
     tx = optax.sgd(0.1, momentum=0.9)
     opt_state = tx.init(params)
@@ -176,7 +182,8 @@ def _bench_resnet50():
         * (image / 224.0) ** 2
     out = {"metric": "resnet50_synthetic_train_throughput",
            "value": round(ips, 2), "unit": "images/sec/chip",
-           "stem": stem, "batch": batch, "platform": dev.platform,
+           "stem": stem, "batch": batch, "norm": norm,
+           "platform": dev.platform,
            "model_tflops_per_sec": round(model_tflops, 1)}
     if xla_flops > 0:
         out["xla_tflops_per_sec"] = round(xla_flops * steps / dt / 1e12, 1)
