@@ -90,6 +90,32 @@ assert np.allclose(rsx.numpy(), s * gx.numpy()[2 * r:2 * r + 2]), \
     rsx.numpy()
 
 
+# Process-set collectives compile too: even/odd singleton sets at s=2 —
+# the metadata blob carries the set id, the gather family's static shape
+# derives from the SET size (not world size), and the reduction runs
+# over set members only.
+evens = hvd.add_process_set([i for i in range(s) if i % 2 == 0])
+odds = hvd.add_process_set([i for i in range(s) if i % 2 == 1])
+mine = evens if r % 2 == 0 else odds
+members = [i for i in range(s) if i % 2 == r % 2]
+
+
+@tf.function(jit_compile=True)
+def compiled_ps(x):
+    y = hvd.allreduce(x, op=hvd.Sum, name="xla.ps",
+                      process_set=mine.process_set_id)
+    g = hvd.allgather(tf.reshape(x, [1, -1]), name="xla.psg",
+                      process_set=mine.process_set_id)
+    return y, g
+
+
+yps, gps = compiled_ps(tf.fill([4], float(r + 1)))
+assert np.allclose(yps.numpy(), sum(m + 1 for m in members)), yps.numpy()
+assert gps.shape == (len(members), 4), gps.shape
+hvd.remove_process_set(evens)
+hvd.remove_process_set(odds)
+
+
 # gradient_predivide_factor through the XLA per-tensor path (ADVICE r4):
 # the compiled graph bakes only the size-free (1/f, f) pair; Average's
 # 1/member_count is applied by the core at collective-execution time
