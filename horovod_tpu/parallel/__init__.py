@@ -22,3 +22,8 @@ from .expert_parallel import (  # noqa: F401
     moe_dispatch_combine,
     moe_dispatch_combine_ragged,
 )
+from .pipeline import (  # noqa: F401
+    make_pipeline_train_step,
+    pipeline_apply,
+    shard_stage_params,
+)

@@ -1,0 +1,150 @@
+"""Pipeline parallelism over a mesh axis (BEYOND REFERENCE).
+
+The reference has no pipeline parallelism and no p2p send/recv API at
+all (SURVEY.md §2.4: "PP — absent; no send/recv"). On TPU the natural
+p2p primitive is `lax.ppermute` over an ICI-adjacent mesh axis, and the
+natural schedule is the GPipe microbatch pipeline expressed as ONE
+`lax.scan` inside `shard_map` — every stage runs the same compiled
+program, activations hop stage→stage with a single collective-permute
+per tick, and XLA overlaps the permute with the next tick's compute.
+Autodiff flows through the whole schedule (scan + ppermute are both
+differentiable; the transpose of a forward hop is the reverse hop), so
+the backward pipeline comes for free instead of being hand-scheduled
+the way GPU frameworks do it.
+
+Scope: `pipeline_apply` is the forward primitive (differentiable — take
+`jax.grad` of a loss on its outputs to train);
+`make_pipeline_train_step` packages the standard loss/grad/update loop.
+`stage_fn` must be shape-preserving ([mb, ...] -> [mb, ...]): classic
+homogeneous-stack pipelining (transformer blocks). The pipeline bubble
+is the usual (S-1)/(M+S-1) fraction — pick n_microbatches >> stages.
+"""
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn, stage_params, x, mesh, axis="pipe",
+                   n_microbatches=None):
+    """Run ``x`` through S pipeline stages laid out on ``mesh[axis]``.
+
+    Args:
+      stage_fn: ``(params_for_one_stage, h) -> h`` with ``h`` of shape
+        ``[microbatch, ...]`` (shape-preserving).
+      stage_params: pytree whose leaves have a leading stage dim of size
+        S == mesh.shape[axis] (stage s uses ``leaf[s]``).
+      x: ``[batch, ...]`` input; ``batch`` must divide into
+        ``n_microbatches`` equal microbatches.
+      n_microbatches: number of microbatches M (default: S, the minimum
+        that keeps every stage busy in steady state).
+
+    Returns ``[batch, ...]`` outputs (replicated across the axis).
+    """
+    S = int(mesh.shape[axis])
+    M = int(n_microbatches or S)
+    B = x.shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible into {M} microbatches")
+    # A stage-count mismatch would SILENTLY compute the wrong function:
+    # shard_map hands each device shape[0]/S rows and `a[0]` would drop
+    # the rest (e.g. 8 stage slices on 4 devices = even stages only).
+    for leaf in jax.tree.leaves(stage_params):
+        if leaf.shape[0] != S:
+            raise ValueError(
+                f"stage_params leading dim {leaf.shape[0]} != pipeline "
+                f"stages {S} (mesh axis {axis!r})")
+    mb = B // M
+    xm = x.reshape((M, mb) + x.shape[1:])
+
+    fwd = [(i, i + 1) for i in range(S - 1)]
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(P(axis), P()), out_specs=P(),
+                       check_vma=False)
+    def run(params, xm):
+        # Each shard sees its own stage slice with a leading dim of 1.
+        p_s = jax.tree.map(lambda a: a[0], params)
+        s = lax.axis_index(axis)
+        last = S - 1
+
+        def tick(carry, t):
+            cur, out = carry
+            active = (t - s >= 0) & (t - s < M)
+            y = stage_fn(p_s, cur)
+            # Mask the bubble: inactive ticks contribute nothing (and
+            # their gradients vanish through the where).
+            y = jnp.where(active, y, jnp.zeros_like(y))
+            # Last stage records its finished microbatch. Mask the VALUE,
+            # not the buffer: selecting between two full copies of `out`
+            # would defeat in-place dynamic_update_slice inside the scan
+            # (O(M) full-output copies). Non-recording ticks write zeros
+            # into slot 0 of an all-zero buffer before its real (later)
+            # write, so results are identical.
+            m_out = t - last
+            rec = (s == last) & (m_out >= 0)
+            idx = jnp.clip(m_out, 0, M - 1)
+            out = lax.dynamic_update_slice(
+                out, jnp.where(rec, y, jnp.zeros_like(y))[None],
+                (idx,) + (0,) * y.ndim)
+            # Hop forward one stage; stage 0 ingests the next microbatch.
+            shifted = lax.ppermute(y, axis, fwd) if S > 1 else y
+            nxt = xm[jnp.clip(t + 1, 0, M - 1)]
+            nxt = jnp.where(t + 1 < M, nxt, jnp.zeros_like(nxt))
+            cur = jnp.where(s == 0, nxt, shifted)
+            return (cur, out), None
+
+        cur0 = jnp.where(s == 0, xm[0], jnp.zeros_like(xm[0]))
+        out0 = jnp.zeros_like(xm)
+        (cur, out), _ = lax.scan(tick, (cur0, out0),
+                                 jnp.arange(M + S - 1))
+        # Only the last stage holds real outputs; psum replicates them
+        # (every other shard contributes zeros).
+        return lax.psum(out, axis)
+
+    out = run(stage_params, xm)
+    return out.reshape((B,) + out.shape[2:])
+
+
+def shard_stage_params(stage_params, mesh, axis="pipe"):
+    """Place a [S, ...]-leading pytree with stage s's slice on the
+    axis's s-th device row (host->mesh placement helper)."""
+    S = int(mesh.shape[axis])
+
+    def place(a):
+        a = np.asarray(a)
+        if a.ndim < 1 or a.shape[0] != S:
+            raise ValueError(
+                f"stage param leaf shape {a.shape} must lead with the "
+                f"stage count {S} (mesh axis {axis!r})")
+        sh = NamedSharding(mesh, P(axis))
+        return jax.make_array_from_callback(a.shape, sh,
+                                            lambda idx: a[idx])
+    return jax.tree.map(place, stage_params)
+
+
+def make_pipeline_train_step(stage_fn, loss_fn, tx, mesh, axis="pipe",
+                             n_microbatches=None, jit=True):
+    """Standard train step over the pipeline: ``loss_fn(outputs, batch)``
+    -> scalar; grads w.r.t. the stage-sharded params; optimizer applies
+    per-stage updates in place. Returns
+    ``step(stage_params, opt_state, batch) -> (params, opt_state, loss)``.
+    """
+    def objective(params, batch):
+        out = pipeline_apply(stage_fn, params, batch["x"], mesh, axis,
+                             n_microbatches)
+        return loss_fn(out, batch)
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(objective)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        import optax
+
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1)) if jit else step

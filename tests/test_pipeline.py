@@ -1,0 +1,121 @@
+"""Pipeline parallelism (parallel/pipeline.py — beyond reference: the
+reference has no PP or p2p send/recv at all). Correctness bar: the GPipe
+schedule must match the sequential composition, forward AND gradients,
+on the virtual CPU mesh."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+
+def test_pipeline_forward_matches_sequential():
+    """parallel/pipeline.py (beyond reference — the reference has no PP
+    or p2p at all): a 4-stage GPipe schedule over a 'pipe' mesh axis
+    must reproduce running the same 4 layers sequentially on one
+    device, for several microbatch counts (bubble masking correct at
+    M == S and M > S)."""
+    from horovod_tpu.parallel.pipeline import (pipeline_apply,
+                                               shard_stage_params)
+
+    S, D = 4, 16
+    cpus = jax.devices("cpu")
+    assert len(cpus) >= S
+    mesh = Mesh(np.asarray(cpus[:S]), ("pipe",))
+
+    rng = np.random.default_rng(0)
+    W = rng.normal(size=(S, D, D)).astype(np.float32) / np.sqrt(D)
+    b = rng.normal(size=(S, D)).astype(np.float32) * 0.1
+    x = rng.normal(size=(8, D)).astype(np.float32)
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    def sequential(x):
+        h = x
+        for s in range(S):
+            h = np.tanh(h @ W[s] + b[s])
+        return h
+
+    params = shard_stage_params({"w": W, "b": b}, mesh, "pipe")
+    for M in (4, 8):
+        out = np.asarray(pipeline_apply(stage_fn, params, jnp.asarray(x),
+                                        mesh, "pipe", n_microbatches=M))
+        assert np.allclose(out, sequential(x), atol=1e-5), (M, out[0][:4])
+
+
+def test_pipeline_train_step_learns():
+    """Gradients flow through the scan+ppermute schedule: jax.grad of a
+    loss on pipeline outputs trains all four stages (loss falls 10x),
+    and the per-stage grads match the sequential model's grads."""
+    import optax
+
+    from horovod_tpu.parallel.pipeline import (make_pipeline_train_step,
+                                               pipeline_apply,
+                                               shard_stage_params)
+
+    S, D = 4, 8
+    cpus = jax.devices("cpu")
+    mesh = Mesh(np.asarray(cpus[:S]), ("pipe",))
+    rng = np.random.default_rng(1)
+    W = (rng.normal(size=(S, D, D)).astype(np.float32) / np.sqrt(D))
+    x = rng.normal(size=(16, D)).astype(np.float32)
+    y = np.roll(x, 1, axis=1) * 0.5  # a learnable linear-ish target
+
+    def stage_fn(p, h):
+        return h @ p["w"]
+
+    def loss_fn(out, batch):
+        return jnp.mean((out - batch["y"]) ** 2)
+
+    # Grad parity vs the sequential composition, same loss.
+    def seq_loss(Wflat):
+        h = jnp.asarray(x)
+        for s in range(S):
+            h = h @ Wflat[s]
+        return jnp.mean((h - jnp.asarray(y)) ** 2)
+
+    params = shard_stage_params({"w": W}, mesh)
+    def pipe_loss(p):
+        out = pipeline_apply(stage_fn, p, jnp.asarray(x), mesh,
+                             n_microbatches=4)
+        return jnp.mean((out - jnp.asarray(y)) ** 2)
+
+    g_pipe = jax.grad(pipe_loss)(params)["w"]
+    g_seq = jax.grad(seq_loss)(jnp.asarray(W))
+    assert np.allclose(np.asarray(g_pipe), np.asarray(g_seq),
+                       atol=1e-5), np.abs(
+        np.asarray(g_pipe) - np.asarray(g_seq)).max()
+
+    # End-to-end training through make_pipeline_train_step.
+    tx = optax.adam(3e-3)
+    step = make_pipeline_train_step(stage_fn, loss_fn, tx, mesh,
+                                    n_microbatches=4)
+    opt_state = tx.init(params)
+    batch = {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+    losses = []
+    for _ in range(200):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
+
+
+def test_pipeline_stage_count_mismatch_rejected():
+    """A stage stack whose leading dim disagrees with the mesh axis must
+    fail LOUDLY — shard_map would otherwise hand each device a slice of
+    stages and silently compute the wrong (e.g. even-stages-only)
+    composition."""
+    import pytest
+
+    from horovod_tpu.parallel.pipeline import (pipeline_apply,
+                                               shard_stage_params)
+
+    cpus = jax.devices("cpu")
+    mesh = Mesh(np.asarray(cpus[:4]), ("pipe",))
+    W8 = np.zeros((8, 4, 4), np.float32)
+    with pytest.raises(ValueError, match="stage"):
+        shard_stage_params({"w": W8}, mesh)
+    with pytest.raises(ValueError, match="stage"):
+        pipeline_apply(lambda p, h: h, {"w": jnp.zeros((8, 4, 4))},
+                       jnp.zeros((8, 4)), mesh, n_microbatches=4)
