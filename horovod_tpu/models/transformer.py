@@ -335,6 +335,37 @@ def resolve_attn(cfg: TransformerConfig, seq_len: int, mesh=None) -> str:
     return "flash" if seq_len >= 1024 else "gather"
 
 
+def _constrain(v, spec):
+    """with_sharding_constraint when a spec is present (mesh mode)."""
+    return jax.lax.with_sharding_constraint(v, spec) \
+        if spec is not None else v
+
+
+def apply_block(layer, x, cfg: TransformerConfig, mesh=None, impl=None,
+                seq_spec=None, full_spec=None):
+    """One transformer block as a standalone ``(layer_params, x) -> x`` —
+    the unit `forward` stacks, and the natural pipeline-parallel stage
+    (parallel/pipeline.py `pipeline_apply` with the per-layer params
+    stacked on a leading stage dim; see tests/test_pipeline.py)."""
+    if impl is None:
+        impl = resolve_attn(cfg, x.shape[1], mesh)
+
+    h = _layer_norm(x, layer["ln1"])
+    if (impl == "ring" and mesh is not None
+            and cfg.seq_axis in mesh.axis_names):
+        x = x + _attention_ring(h, layer, cfg, mesh, seq_spec)
+    elif impl == "flash":
+        x = x + _attention_flash(h, layer, cfg, mesh, seq_spec)
+    else:
+        x = x + _attention(h, layer, cfg, seq_spec, full_spec)
+    h = _layer_norm(x, layer["ln2"])
+    if cfg.n_experts > 0:
+        x = x + _moe_ffn(h, layer, cfg)
+    else:
+        x = x + _ffn(h, layer, cfg)
+    return _constrain(x, seq_spec)
+
+
 def forward(params, tokens, cfg: TransformerConfig, mesh=None,
             return_hidden=False):
     """tokens [B, S] int32 → logits [B, S, vocab] (compute dtype), or the
@@ -354,32 +385,16 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None,
     else:
         seq_spec = full_spec = None
 
-    def constrain(x, spec):
-        return jax.lax.with_sharding_constraint(x, spec) if spec is not None \
-            else x
-
     B, S = tokens.shape
     x = params["embed"].astype(dt)[tokens]
     x = x + params["pos_embed"].astype(dt)[:S][None]
-    x = constrain(x, seq_spec)
+    x = _constrain(x, seq_spec)
 
     impl = resolve_attn(cfg, S, mesh)
 
     def block(x, layer):
-        h = _layer_norm(x, layer["ln1"])
-        if (impl == "ring" and mesh is not None
-                and cfg.seq_axis in mesh.axis_names):
-            x = x + _attention_ring(h, layer, cfg, mesh, seq_spec)
-        elif impl == "flash":
-            x = x + _attention_flash(h, layer, cfg, mesh, seq_spec)
-        else:
-            x = x + _attention(h, layer, cfg, seq_spec, full_spec)
-        h = _layer_norm(x, layer["ln2"])
-        if cfg.n_experts > 0:
-            x = x + _moe_ffn(h, layer, cfg)
-        else:
-            x = x + _ffn(h, layer, cfg)
-        return constrain(x, seq_spec)
+        return apply_block(layer, x, cfg, mesh=mesh, impl=impl,
+                           seq_spec=seq_spec, full_spec=full_spec)
 
     if cfg.remat:
         block = jax.checkpoint(block)

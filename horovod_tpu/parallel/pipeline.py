@@ -54,10 +54,10 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis="pipe",
     # shard_map hands each device shape[0]/S rows and `a[0]` would drop
     # the rest (e.g. 8 stage slices on 4 devices = even stages only).
     for leaf in jax.tree.leaves(stage_params):
-        if leaf.shape[0] != S:
+        if leaf.ndim < 1 or leaf.shape[0] != S:
             raise ValueError(
-                f"stage_params leading dim {leaf.shape[0]} != pipeline "
-                f"stages {S} (mesh axis {axis!r})")
+                f"stage_params leaf shape {jnp.shape(leaf)} must lead "
+                f"with the pipeline stage count {S} (mesh axis {axis!r})")
     mb = B // M
     xm = x.reshape((M, mb) + x.shape[1:])
 
@@ -139,11 +139,11 @@ def make_pipeline_train_step(stage_fn, loss_fn, tx, mesh, axis="pipe",
                              n_microbatches)
         return loss_fn(out, batch)
 
+    import optax
+
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(objective)(params, batch)
         updates, opt_state = tx.update(grads, opt_state, params)
-        import optax
-
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
