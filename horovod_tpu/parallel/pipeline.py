@@ -30,7 +30,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 
 def pipeline_apply(stage_fn, stage_params, x, mesh, axis="pipe",
-                   n_microbatches=None):
+                   n_microbatches=None, batch_axis=None):
     """Run ``x`` through S pipeline stages laid out on ``mesh[axis]``.
 
     Args:
@@ -42,8 +42,18 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis="pipe",
         ``n_microbatches`` equal microbatches.
       n_microbatches: number of microbatches M (default: S, the minimum
         that keeps every stage busy in steady state).
+      batch_axis: optional second mesh axis composing DATA parallelism
+        with the pipeline (pp x dp): each microbatch's rows shard over
+        it, every data replica runs the same pipeline schedule on its
+        shard, and the per-tick ppermute stays within the pipe axis.
+        Gradients need NO extra collective: params are replicated over
+        ``batch_axis``, so shard_map's transpose already psums their
+        cotangent across the data shards — ``jax.grad`` of a loss on
+        these outputs IS the full-batch gradient (asserted in
+        tests/test_pipeline.py); adding a manual psum would double-count.
 
-    Returns ``[batch, ...]`` outputs (replicated across the axis).
+    Returns ``[batch, ...]`` outputs (replicated across the pipe axis;
+    sharded over ``batch_axis`` when given).
     """
     S = int(mesh.shape[axis])
     M = int(n_microbatches or S)
@@ -62,9 +72,12 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis="pipe",
     xm = x.reshape((M, mb) + x.shape[1:])
 
     fwd = [(i, i + 1) for i in range(S - 1)]
+    # Microbatch rows shard over batch_axis (dp compose); the stage dim
+    # of the params shards over the pipe axis either way.
+    x_spec = P(None, batch_axis) if batch_axis else P()
 
     @functools.partial(shard_map, mesh=mesh,
-                       in_specs=(P(axis), P()), out_specs=P(),
+                       in_specs=(P(axis), x_spec), out_specs=x_spec,
                        check_vma=False)
     def run(params, xm):
         # Each shard sees its own stage slice with a leading dim of 1.
@@ -128,15 +141,17 @@ def shard_stage_params(stage_params, mesh, axis="pipe"):
 
 
 def make_pipeline_train_step(stage_fn, loss_fn, tx, mesh, axis="pipe",
-                             n_microbatches=None, jit=True):
+                             n_microbatches=None, batch_axis=None,
+                             jit=True):
     """Standard train step over the pipeline: ``loss_fn(outputs, batch)``
     -> scalar; grads w.r.t. the stage-sharded params; optimizer applies
-    per-stage updates in place. Returns
+    per-stage updates in place. ``batch_axis`` composes data parallelism
+    (see pipeline_apply — grads come out already reduced). Returns
     ``step(stage_params, opt_state, batch) -> (params, opt_state, loss)``.
     """
     def objective(params, batch):
         out = pipeline_apply(stage_fn, params, batch["x"], mesh, axis,
-                             n_microbatches)
+                             n_microbatches, batch_axis=batch_axis)
         return loss_fn(out, batch)
 
     import optax
