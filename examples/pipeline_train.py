@@ -1,0 +1,75 @@
+"""Pipeline-parallel training demo (beyond reference — the reference has
+no pipeline parallelism or p2p send/recv at all; see
+docs/parallelism.md). Four transformer blocks run as four GPipe stages
+over a 'pipe' mesh axis, optionally composed with data parallelism on a
+second axis; gradients flow through the scan+ppermute schedule with no
+hand-written backward.
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+         python examples/pipeline_train.py      (4-stage x 2-way dp)
+     python examples/pipeline_train.py          (real chips: uses up to
+                                                 4 for the pipe axis)
+"""
+import dataclasses
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from horovod_tpu.models import transformer as tfm
+from horovod_tpu.parallel.pipeline import (make_pipeline_train_step,
+                                           shard_stage_params)
+
+STEPS = int(os.environ.get("STEPS", 30))
+BATCH = int(os.environ.get("BATCH", 16))
+
+devices = jax.devices()
+S = min(4, len(devices))
+dp = 2 if len(devices) >= 2 * S else 1
+mesh = Mesh(np.asarray(devices[:S * dp]).reshape(S, dp), ("pipe", "data"))
+print(f"mesh: {S} pipeline stages x {dp}-way data parallel")
+
+cfg = dataclasses.replace(tfm.tiny(), n_layers=S, dtype="float32")
+params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+stacked = jax.tree.map(lambda *xs: np.stack([np.asarray(a) for a in xs]),
+                       *params["layers"])
+stage_params = shard_stage_params(stacked, mesh, "pipe")
+
+
+def stage_fn(layer, h):
+    return tfm.apply_block(layer, h, cfg)
+
+
+def loss_fn(out, batch):
+    # Simple regression head on the block stack's output — the demo
+    # trains the pipelined stages only (embed/head stay frozen outside).
+    return jnp.mean((out - batch["y"]) ** 2)
+
+
+tx = optax.adam(1e-3)
+step = make_pipeline_train_step(stage_fn, loss_fn, tx, mesh,
+                                n_microbatches=4,
+                                batch_axis="data" if dp > 1 else None)
+
+rng = np.random.default_rng(0)
+tokens = rng.integers(0, cfg.vocab_size, (BATCH, 16))
+x = np.asarray(params["embed"])[tokens] + \
+    np.asarray(params["pos_embed"])[:16][None]
+y = np.roll(x, 1, axis=2) * 0.5
+xs = jnp.asarray(x, jnp.float32)
+if dp > 1:
+    xs = jax.device_put(xs, NamedSharding(mesh, P("data")))
+batch = {"x": xs, "y": jnp.asarray(y, jnp.float32)}
+
+opt_state = tx.init(stage_params)
+losses = []
+for i in range(STEPS):
+    stage_params, opt_state, loss = step(stage_params, opt_state, batch)
+    losses.append(float(loss))
+print(f"loss: {losses[0]:.4f} -> {losses[-1]:.4f} over {STEPS} steps")
+assert losses[-1] < losses[0], "pipeline training did not reduce loss"
+print("pipeline demo OK")

@@ -52,3 +52,22 @@ def test_estimator_example_torch_and_lightning(tmp_path):
     assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr}"
     assert "estimator demo OK" in p.stdout
     assert "lightning loss" in p.stdout
+
+
+def test_pipeline_example():
+    """examples/pipeline_train.py: 4 transformer-block GPipe stages x
+    2-way dp on the virtual mesh, loss falls."""
+    import subprocess
+    import sys
+
+    from .util import tpu_isolated_env
+
+    env = dict(os.environ)
+    env.update(tpu_isolated_env())
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["STEPS"] = "10"
+    p = subprocess.run(
+        [sys.executable, os.path.join(_EXAMPLES, "pipeline_train.py")],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr}"
+    assert "pipeline demo OK" in p.stdout
