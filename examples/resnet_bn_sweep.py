@@ -59,7 +59,13 @@ def main():
         if names and v["name"] not in names:
             continue
         env = dict(os.environ)
-        env.update({"PYTHONPATH": _REPO, "BENCH_CONFIG": "resnet50",
+        # Prepend the repo, never overwrite: the TPU platform plugin may
+        # itself be distributed via PYTHONPATH (as on the relay image,
+        # where clobbering it makes every child fail backend init).
+        ambient = env.get("PYTHONPATH")
+        env.update({"PYTHONPATH": (_REPO + os.pathsep + ambient) if ambient
+                                  else _REPO,
+                    "BENCH_CONFIG": "resnet50",
                     "BENCH_DEADLINE": "420"})
         overrides = dict(v["env"])
         vflags = overrides.pop("XLA_FLAGS", None)
