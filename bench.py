@@ -22,7 +22,7 @@ that outlived the driver's timeout):
 - Each config's JSON line is printed the moment it completes; the final
   cumulative line (headline + ``extra``) is printed last, so the driver's
   tail always holds the newest completed measurement.
-- Total wall is bounded by ``BENCH_DEADLINE`` (default 1140 s — inside
+- Total wall is bounded by ``BENCH_DEADLINE`` (default 1200 s — inside
   any plausible driver budget); configs that no longer fit are skipped
   with an explicit note rather than silently hanging.
 
@@ -39,11 +39,14 @@ retired.
 The default run also captures ``transformer`` (bert-large-scale decoder),
 ``allreduce`` (marginal-method algorithm bandwidth, resident 97 MB set +
 streaming 512 MB set), ``longctx`` (4096-token flash-attention training),
-and ``hostplane`` (8-rank fake-pod allreduce bus bandwidth through the
+``hostplane`` (8-rank fake-pod allreduce bus bandwidth through the
 C++ TCP host plane — CPU-only, relay-immune, the multi-rank scaling
-signal) in the same final JSON line under ``"extra"``. Set
-BENCH_CONFIG={resnet50, transformer, allreduce, longctx, hostplane} to
-run exactly one.
+signal), ``moe`` (expert-parallel alltoall dispatch throughput, dense +
+ragged wire formats — the BASELINE MoE graded config), and ``elastic``
+(measured rank-death-to-recovery seconds on a real localhost elastic
+job — the BASELINE elastic graded config) in the same final JSON line
+under ``"extra"``. Set BENCH_CONFIG to one of those names to run
+exactly one.
 """
 
 import json
@@ -60,6 +63,18 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 # cache without clobbering the repo's real round record).
 _CACHE_PATH = os.environ.get("BENCH_CACHE_PATH",
                              os.path.join(_HERE, "bench_cache.json"))
+
+# The iteration at which the elastic bench's doomed slot dies; the
+# recovery filter and the worker body must agree on it.
+_ELASTIC_DEATH_IT = 3
+
+
+def _repo_pythonpath(ambient):
+    """PYTHONPATH with the repo prepended, never clobbering what is
+    already there: on the relay image the TPU platform plugin itself
+    rides PYTHONPATH, and overwriting it makes every child fail backend
+    init (measured, round 5)."""
+    return (_HERE + os.pathsep + ambient) if ambient else _HERE
 
 # bf16 peak TFLOP/s by PJRT device_kind prefix (longest match wins).
 _PEAK_TFLOPS = {
@@ -161,8 +176,16 @@ def _bench_resnet50():
 
     # AOT-compile once; the loops call the compiled executable directly so
     # the step is not XLA-compiled a second time through the jit cache.
-    compiled = train_step.lower(params, batch_stats, opt_state, images,
-                                labels).compile()
+    # HVD_BENCH_COMPILER_OPTIONS (JSON dict) rides PJRT to the backend
+    # compiler — the only way TPU-side XLA options reach a remote-compile
+    # relay, whose local XLA_FLAGS parser knows only CPU flags (measured:
+    # --xla_tpu_* in XLA_FLAGS aborts the process here).
+    copts = json.loads(os.environ.get("HVD_BENCH_COMPILER_OPTIONS") or
+                       "null")
+    lowered = train_step.lower(params, batch_stats, opt_state, images,
+                               labels)
+    compiled = lowered.compile(compiler_options=copts) if copts \
+        else lowered.compile()
     xla_flops = _xla_flops(compiled)
 
     for _ in range(warmup):
@@ -432,7 +455,8 @@ def _bench_hostplane():
     fd, out_path = tempfile.mkstemp(prefix="hvd_bench_hostplane_")
     os.close(fd)
     try:
-        env = {"PYTHONPATH": _HERE, "JAX_PLATFORMS": "cpu",
+        env = {"PYTHONPATH": _repo_pythonpath(os.environ.get("PYTHONPATH")),
+               "JAX_PLATFORMS": "cpu",
                "_BENCH_HOSTPLANE_WORKER": "1",
                "_BENCH_HOSTPLANE_OUT": out_path}
         codes = run_local(np_, [sys.executable, os.path.abspath(__file__)],
@@ -487,6 +511,171 @@ def _hostplane_worker():
     hvd.shutdown()
 
 
+def _bench_moe():
+    """MoE expert-parallel dispatch throughput — the BASELINE.md graded
+    config "alltoall + allgather (MoE expert-parallel dispatch)"
+    (reference pattern: `hvd.alltoall` as the dispatch primitive,
+    `ops/mpi_operations.cc` `MPIAlltoall`'s alltoallv splits).
+
+    Times the jitted top-1 Switch layer from parallel/expert_parallel.py
+    over the local device mesh in BOTH wire formats: dense (fixed
+    [E, C, D] slots, one XLA AllToAll each way) and ragged (alltoallv-
+    style — only routed tokens cross the wire, via ops.jax_ops.
+    ragged_alltoall). On one chip the exchange is local, so the figure is
+    the per-chip dispatch-pipeline rate (routing one-hots, pack/combine
+    einsums, expert FFN) that a pod overlaps with its ICI alltoall; on a
+    multi-device mesh the identical programs measure the ICI rate."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from horovod_tpu.parallel import make_moe_layer
+
+    devices = jax.devices()
+    on_cpu = devices[0].platform == "cpu"
+    mesh = Mesh(np.asarray(devices), ("expert",))
+    nd = len(devices)
+    if on_cpu:
+        T, D, F, steps, warmup = 64 * nd, 32, 64, 2, 1
+    else:
+        T, D, F, steps, warmup = 4096 * nd, 1024, 4096, 12, 3
+    E = 8 if 8 % nd == 0 else nd
+
+    rng = np.random.default_rng(0)
+    w_in = jnp.asarray(rng.standard_normal((E, D, F)) * 0.02, jnp.bfloat16)
+    w_out = jnp.asarray(rng.standard_normal((E, F, D)) * 0.02, jnp.bfloat16)
+    x = jnp.asarray(rng.standard_normal((T, D)), jnp.bfloat16)
+    logits = jnp.asarray(rng.standard_normal((T, E)), jnp.float32)
+
+    def timed(layer):
+        out = layer(x, logits)  # compile
+        for _ in range(warmup):
+            out = layer(x, logits)
+        _sync(out)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = layer(x, logits)
+        _sync(out)
+        return T * steps / (time.perf_counter() - t0)
+
+    dense_tps = timed(make_moe_layer(mesh, "expert", w_in, w_out,
+                                     capacity_factor=1.25))
+    ragged_tps = timed(make_moe_layer(mesh, "expert", w_in, w_out,
+                                      capacity_factor=1.25, ragged=True))
+
+    return {"metric": "moe_dispatch_throughput",
+            "value": round(dense_tps, 1),
+            "unit": "tokens/sec (dense alltoall dispatch)",
+            "ragged_tokens_per_sec": round(ragged_tps, 1),
+            "tokens": T, "d_model": D, "d_ff": F, "experts": E,
+            "capacity_factor": 1.25, "n_devices": nd,
+            "vs_baseline": 1.0}
+
+
+def _bench_elastic():
+    """Measured elastic recovery — the BASELINE.md graded config "elastic
+    resize: recovers without restart" (reference:
+    `test/integration/test_elastic_torch.py` failure harness +
+    `runner/elastic/driver.py` respawn path).
+
+    Runs a real 2-slot localhost elastic job (CPU host plane — relay-
+    immune); slot 1 dies once mid-run; value = seconds from the death to
+    the first completed post-failure collective, i.e. detection +
+    re-rendezvous + replacement respawn + state restore, end to end."""
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="hvd_bench_elastic_")
+    hosts = os.path.join(tmp, "hosts.txt")
+    with open(hosts, "w") as f:
+        f.write("localhost:2\n")
+    log_path = os.path.join(tmp, "iters.log")
+    marker = os.path.join(tmp, "died.marker")
+    iters = int(os.environ.get("_BENCH_ELASTIC_ITERS", "8"))
+    if iters <= _ELASTIC_DEATH_IT:
+        raise SystemExit(f"_BENCH_ELASTIC_ITERS={iters} must exceed the "
+                         f"injection iteration {_ELASTIC_DEATH_IT} or the "
+                         f"death never happens")
+    env = dict(os.environ)
+    # Workers run on the CPU host plane. The inherited child-mode markers
+    # must not leak into the re-entered bench.py.
+    env.pop("_BENCH_CHILD", None)
+    env.pop("BENCH_CONFIG", None)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": _repo_pythonpath(env.get("PYTHONPATH")),
+                "_BENCH_ELASTIC_WORKER": "1",
+                "_BENCH_ELASTIC_LOG": log_path,
+                "_BENCH_ELASTIC_MARKER": marker,
+                "_BENCH_ELASTIC_ITERS": str(iters)})
+    cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
+           "--min-np", "2", "--max-np", "2",
+           "--host-discovery-script", f"cat {hosts}",
+           sys.executable, os.path.abspath(__file__)]
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=75)
+    if p.returncode != 0:
+        raise RuntimeError(f"elastic job rc={p.returncode}; "
+                           f"tail: {p.stdout[-300:]} {p.stderr[-300:]}")
+    with open(marker) as f:
+        t_death = float(f.read())
+    stamps = []
+    with open(log_path) as f:
+        for line in f:
+            ts, it = line.split()
+            stamps.append((float(ts), int(it.split("=")[1])))
+    # Only iterations >= the death point count as recovery evidence: the
+    # survivor's bookkeeping for the iteration BEFORE the death can land
+    # microseconds after the death stamp (both ranks run unsynchronized
+    # user code between collectives).
+    post = sorted(t for t, it in stamps
+                  if t > t_death and it >= _ELASTIC_DEATH_IT)
+    if not post:
+        raise RuntimeError("no post-failure iterations logged")
+    return {"metric": "elastic_recovery_seconds",
+            "value": round(post[0] - t_death, 2),
+            "unit": "s (rank death -> first post-failure collective)",
+            "ranks": 2, "iters": iters,
+            "note": "detection + re-rendezvous + respawn + state restore, "
+                    "measured on a localhost fake pod",
+            "vs_baseline": 1.0}
+
+
+def _elastic_worker():
+    """Rank body for _bench_elastic (re-entered with _BENCH_ELASTIC_WORKER
+    set, under the real elastic launcher): timestamped log line per
+    completed collective; slot 1 dies once at iteration 3, stamping the
+    death time into the marker file."""
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+
+    hvd.init()
+    iters = int(os.environ["_BENCH_ELASTIC_ITERS"])
+    log_path = os.environ["_BENCH_ELASTIC_LOG"]
+    marker = os.environ["_BENCH_ELASTIC_MARKER"]
+    wid = os.environ.get("HVD_WORKER_ID", "?")
+
+    state = elastic.ObjectState(iteration=0)
+
+    @elastic.run
+    def train(state):
+        while state.iteration < iters:
+            if (state.iteration == _ELASTIC_DEATH_IT
+                    and not os.path.exists(marker)
+                    and wid.startswith("localhost-1-")):
+                with open(marker, "w") as f:
+                    f.write(repr(time.time()))
+                os._exit(1)
+            hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                          name=f"it.{state.iteration}")
+            with open(log_path, "a") as f:
+                f.write(f"{time.time()} it={state.iteration}\n")
+            state.iteration += 1
+            state.commit()
+            time.sleep(0.05)
+
+    train(state)
+    hvd.shutdown()
+
+
 # --------------------------------------------------------------------------
 # Wedge-proof driver layer (pure Python — no jax in this process).
 # --------------------------------------------------------------------------
@@ -497,6 +686,8 @@ _CONFIG_FNS = {
     "allreduce": _bench_allreduce,
     "longctx": _bench_longctx,
     "hostplane": _bench_hostplane,
+    "moe": _bench_moe,
+    "elastic": _bench_elastic,
 }
 
 _METRIC_NAMES = {
@@ -505,18 +696,22 @@ _METRIC_NAMES = {
     "allreduce": ("allreduce_bus_bandwidth_97MB", "GB/s"),
     "longctx": ("longctx_flash_train_throughput", "tokens/sec/chip"),
     "hostplane": ("allreduce_hostplane_bus_bandwidth", "GB/s"),
+    "moe": ("moe_dispatch_throughput", "tokens/sec"),
+    "elastic": ("elastic_recovery_seconds", "s"),
 }
 
 # Per-config wall caps (seconds). Only bind when something hangs; healthy
-# runs finish far inside them. probe (75) + caps sum to 1125 <= the
-# default BENCH_DEADLINE=1140, so even an every-config-hangs run emits
-# all five lines inside the budget.
+# runs finish far inside them (the full round-5 healthy run took ~6 min).
+# probe (75) + caps sum to 1170 <= the default BENCH_DEADLINE=1200, so
+# even an every-config-hangs run emits all lines inside the budget.
 _CONFIG_CAPS = {
-    "resnet50": 300,
-    "transformer": 210,
-    "allreduce": 210,
-    "longctx": 240,
-    "hostplane": 90,
+    "resnet50": 270,
+    "transformer": 180,
+    "allreduce": 180,
+    "longctx": 180,
+    "hostplane": 75,
+    "moe": 120,
+    "elastic": 90,
 }
 
 _PROBE_TIMEOUT = 75
@@ -680,7 +875,7 @@ def main():
         _emit(_retry_transient(_CONFIG_FNS[which]))
         return
 
-    deadline = time.time() + float(os.environ.get("BENCH_DEADLINE", "1140"))
+    deadline = time.time() + float(os.environ.get("BENCH_DEADLINE", "1200"))
 
     def remaining():
         return deadline - time.time()
@@ -704,7 +899,8 @@ def main():
         return
 
     results = {}
-    order = ["resnet50", "transformer", "allreduce", "longctx", "hostplane"]
+    order = ["resnet50", "transformer", "allreduce", "longctx", "hostplane",
+             "moe", "elastic"]
     for name in order:
         cap = _cap(name)
         left = remaining() - 15  # reserve for final assembly
@@ -741,5 +937,7 @@ def main():
 if __name__ == "__main__":
     if os.environ.get("_BENCH_HOSTPLANE_WORKER") == "1":
         _hostplane_worker()
+    elif os.environ.get("_BENCH_ELASTIC_WORKER") == "1":
+        _elastic_worker()
     else:
         main()

@@ -173,19 +173,24 @@ def moe_dispatch_combine_ragged(x, logits, expert_fn, axis,
     return y.astype(x.dtype), aux
 
 
-def make_moe_layer(mesh, axis, w_in, w_out, capacity_factor=1.25):
+def make_moe_layer(mesh, axis, w_in, w_out, capacity_factor=1.25,
+                   ragged=False):
     """Convenience: build a jitted MoE FFN over `mesh`.
 
     w_in: [E, D, F], w_out: [E, F, D] — sharded on dim0 over `axis`.
     Returns fn(x [T, D], logits [T, E]) -> [T, D] where T is the global
     token count (flatten any batch/sequence dims into T first; T must be
-    divisible by the axis size).
+    divisible by the axis size). ``ragged=True`` dispatches through
+    :func:`moe_dispatch_combine_ragged` (alltoallv-style wire format)
+    instead of the dense fixed-slot exchange.
     """
     import functools
 
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    dispatch = moe_dispatch_combine_ragged if ragged \
+        else moe_dispatch_combine
     espec = P(axis, None, None)
 
     @jax.jit
@@ -201,8 +206,8 @@ def make_moe_layer(mesh, axis, w_in, w_out, capacity_factor=1.25):
             return jnp.einsum("enf,efd->end", h,
                               w_out_l.astype(jnp.float32)).astype(buf.dtype)
 
-        out, _ = moe_dispatch_combine(x, logits, expert_fn, axis,
-                                      capacity_factor=capacity_factor)
+        out, _ = dispatch(x, logits, expert_fn, axis,
+                          capacity_factor=capacity_factor)
         return out
 
     return lambda x, logits: fn(x, logits, w_in, w_out)

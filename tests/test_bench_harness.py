@@ -59,6 +59,10 @@ def test_hung_config_is_killed_and_rest_still_measure():
     assert "extra" in final, final
     assert "sub-deadline" in final["extra"]["transformer"].get("error", "")
     assert final["extra"]["hostplane"]["value"] > 0, final["extra"]
+    # The BASELINE graded configs added in round 5 ride the same record:
+    # MoE dispatch throughput and measured elastic recovery.
+    assert final["extra"]["moe"]["value"] > 0, final["extra"]
+    assert final["extra"]["elastic"]["value"] > 0, final["extra"]
 
 
 def test_wedged_probe_emits_cached_fallback(tmp_path):

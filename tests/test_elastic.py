@@ -295,8 +295,12 @@ def test_elastic_resize_under_compiled_xla_predivide(tmp_path):
     def shrink(hosts_file):
         # Once the injected death happened, take the slot out of
         # discovery so the driver re-meshes at size 1 instead of
-        # respawning back to 2.
-        deadline = time.time() + 90
+        # respawning back to 2. The wait must sit INSIDE the test's own
+        # 300 s timeout but comfortably above worker startup: under full
+        # machine load the TF import + jit_compile trace can take >90 s
+        # to reach the injection point, and shrinking before the death
+        # skips the injection entirely (observed flake, round 5).
+        deadline = time.time() + 240
         while time.time() < deadline and not marker.exists():
             time.sleep(0.1)
         hosts_file.write_text("localhost:1\n")

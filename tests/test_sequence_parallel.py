@@ -240,6 +240,27 @@ def _ragged_moe_layer(mesh, axis, w_in, w_out, **kw):
     return lambda x, logits: fn(x, logits, w_in, w_out)
 
 
+def test_make_moe_layer_ragged_flag_matches_dense():
+    """make_moe_layer(ragged=True) — the bench's entry point to the
+    alltoallv wire format — agrees with the dense-slot layer when
+    capacity is generous (same routing, same experts, different wire)."""
+    rng = np.random.default_rng(13)
+    E, D, F, T = 8, 16, 32, 64
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("expert",))
+    w_in = jnp.asarray(rng.standard_normal((E, D, F)) * 0.1, jnp.float32)
+    w_out = jnp.asarray(rng.standard_normal((E, F, D)) * 0.1, jnp.float32)
+    x = jnp.asarray(rng.standard_normal((8 * T, D)), jnp.float32)
+    logits = jnp.asarray(rng.standard_normal((8 * T, E)), jnp.float32)
+
+    dense = make_moe_layer(mesh, "expert", w_in, w_out,
+                           capacity_factor=float(E))
+    ragged = make_moe_layer(mesh, "expert", w_in, w_out,
+                            capacity_factor=float(E), ragged=True)
+    np.testing.assert_allclose(np.asarray(ragged(x, logits)),
+                               np.asarray(dense(x, logits)),
+                               rtol=2e-3, atol=2e-3)
+
+
 def test_moe_ragged_matches_dense():
     """Ragged (wire-following) dispatch == dense one-hot routing when
     capacities are lossless — including under IMBALANCED routing."""
