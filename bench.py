@@ -70,6 +70,17 @@ _CACHE_PATH = os.environ.get("BENCH_CACHE_PATH",
 _ELASTIC_DEATH_IT = 3
 
 
+def _compile_with_bench_opts(lowered):
+    """Compile an AOT-lowered step, forwarding HVD_BENCH_COMPILER_OPTIONS
+    (JSON dict) as PJRT compiler options — the only way TPU-side XLA
+    options reach a remote-compile relay, whose local XLA_FLAGS parser
+    knows only CPU flags (measured: --xla_tpu_* in XLA_FLAGS aborts)."""
+    copts = json.loads(os.environ.get("HVD_BENCH_COMPILER_OPTIONS") or
+                       "null")
+    return lowered.compile(compiler_options=copts) if copts \
+        else lowered.compile()
+
+
 def _repo_pythonpath(ambient):
     """PYTHONPATH with the repo prepended, never clobbering what is
     already there: on the relay image the TPU platform plugin itself
@@ -177,16 +188,8 @@ def _bench_resnet50():
 
     # AOT-compile once; the loops call the compiled executable directly so
     # the step is not XLA-compiled a second time through the jit cache.
-    # HVD_BENCH_COMPILER_OPTIONS (JSON dict) rides PJRT to the backend
-    # compiler — the only way TPU-side XLA options reach a remote-compile
-    # relay, whose local XLA_FLAGS parser knows only CPU flags (measured:
-    # --xla_tpu_* in XLA_FLAGS aborts the process here).
-    copts = json.loads(os.environ.get("HVD_BENCH_COMPILER_OPTIONS") or
-                       "null")
-    lowered = train_step.lower(params, batch_stats, opt_state, images,
-                               labels)
-    compiled = lowered.compile(compiler_options=copts) if copts \
-        else lowered.compile()
+    compiled = _compile_with_bench_opts(
+        train_step.lower(params, batch_stats, opt_state, images, labels))
     xla_flops = _xla_flops(compiled)
 
     for _ in range(warmup):
@@ -246,7 +249,8 @@ def _timed_transformer_train(cfg, batch, seq, steps, warmup):
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq + 1)),
                          jnp.int32)
-    compiled = step.lower(params, opt_state, {"tokens": tokens}).compile()
+    compiled = _compile_with_bench_opts(
+        step.lower(params, opt_state, {"tokens": tokens}))
     xla_flops = _xla_flops(compiled)
 
     for _ in range(warmup):
