@@ -19,6 +19,8 @@ Two complementary paths, mirroring the reference's two binding styles:
    chip/host), carries DCN-crossing traffic, and drives elastic training.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -193,6 +195,40 @@ def _is_traced(x):
     return isinstance(x, jax.core.Tracer)
 
 
+def _bridge_callback(cb, result_shape, *args):
+    """``io_callback`` with a trace-time guard for remote-compile relay
+    backends. On a relay-attached chip (the ``axon`` PJRT plugin — it
+    reports platform "tpu", so ``JAX_PLATFORMS`` is the only signal) a
+    program carrying ANY host callback hangs forever in the remote
+    compile (measured round 5: a 4-element io_callback program did not
+    compile in 7 minutes, while pure-XLA programs compile in seconds).
+    Failing at trace time with the supported alternative beats an
+    undebuggable hang. ``HVD_INJIT_CALLBACKS=1`` overrides (e.g. a
+    future relay that hosts callbacks); ``=0`` forces the error on any
+    platform."""
+    allow = os.environ.get("HVD_INJIT_CALLBACKS")
+    # Platform may be selected via env OR jax.config (the config value is
+    # seeded from the env var but also settable directly — e.g. this
+    # repo's own jax.config.update platform selection).
+    platforms = os.environ.get("JAX_PLATFORMS", "") or \
+        str(getattr(jax.config, "jax_platforms", None) or "")
+    relay = "axon" in platforms
+    if allow != "1" and (relay or allow == "0"):
+        why = (f"this remote-compile relay backend (platforms="
+               f"{platforms!r}) hangs forever compiling programs that "
+               f"carry host callbacks" if relay else
+               "HVD_INJIT_CALLBACKS=0 forces this error on every "
+               "platform")
+        raise RuntimeError(
+            "in-jit core-bridged collectives lower to a host callback "
+            f"(io_callback), and {why}. Use the pure-XLA "
+            "in-mesh collectives instead (horovod_tpu.parallel / "
+            "ops.jax_ops in-mesh ops, e.g. make_train_step), call the "
+            "op OUTSIDE jit (eager arrays take the direct core path), "
+            "or set HVD_INJIT_CALLBACKS=1 to override.")
+    return io_callback(cb, result_shape, *args, ordered=True)
+
+
 def hvd_allreduce(x, op=Average, name=None, process_set=0,
                   prescale_factor=1.0, postscale_factor=1.0):
     """Allreduce through the native core's negotiation + fused ring.
@@ -211,8 +247,8 @@ def hvd_allreduce(x, op=Average, name=None, process_set=0,
                                process_set=process_set)
 
     if _is_traced(x):
-        return io_callback(cb, jax.ShapeDtypeStruct(x.shape, x.dtype), x,
-                           ordered=True)
+        return _bridge_callback(cb, jax.ShapeDtypeStruct(x.shape, x.dtype),
+                                x)
     out = cb(np.asarray(x))
     return jnp.asarray(out)
 
@@ -239,7 +275,7 @@ def hvd_allreduce_pytree(tree, op=Average, name=None, process_set=0,
 
     if any(_is_traced(l) for l in leaves):
         shapes = tuple(jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves)
-        outs = io_callback(cb, shapes, *leaves, ordered=True)
+        outs = _bridge_callback(cb, shapes, *leaves)
     else:
         outs = cb(*leaves)
         outs = tuple(jnp.asarray(o) for o in outs)
@@ -272,8 +308,8 @@ def hvd_allgather(x, name=None, process_set=0):
                     f"path for ragged gathers.")
             return out
 
-        return io_callback(cb_checked, jax.ShapeDtypeStruct(shape, x.dtype),
-                           x, ordered=True)
+        return _bridge_callback(cb_checked,
+                                jax.ShapeDtypeStruct(shape, x.dtype), x)
     return jnp.asarray(_core.allgather(np.asarray(x), name=name,
                                        process_set=process_set))
 
@@ -317,8 +353,8 @@ def hvd_alltoall(x, splits=None, name=None, process_set=0):
                     f"the eager path for ragged alltoall.")
             return out
 
-        return io_callback(cb, jax.ShapeDtypeStruct(x.shape, x.dtype), x,
-                           ordered=True)
+        return _bridge_callback(cb, jax.ShapeDtypeStruct(x.shape, x.dtype),
+                                x)
     out, rs = _core.synchronize(_core.alltoall_async(
         np.asarray(x), splits, name, process_set))
     if splits is None:
@@ -345,8 +381,8 @@ def hvd_reducescatter(x, op=Average, name=None, process_set=0,
         r = _core._lib.hvd_process_set_rank(process_set)
         rows = x.shape[0] // n + (1 if r < x.shape[0] % n else 0)
         shape = (rows,) + tuple(x.shape[1:])
-        return io_callback(cb, jax.ShapeDtypeStruct(shape, x.dtype), x,
-                           ordered=True)
+        return _bridge_callback(cb, jax.ShapeDtypeStruct(shape, x.dtype),
+                                x)
     return jnp.asarray(cb(np.asarray(x)))
 
 
@@ -358,8 +394,8 @@ def hvd_broadcast(x, root_rank=0, name=None, process_set=0):
                                process_set=process_set)
 
     if _is_traced(x):
-        return io_callback(cb, jax.ShapeDtypeStruct(x.shape, x.dtype), x,
-                           ordered=True)
+        return _bridge_callback(cb, jax.ShapeDtypeStruct(x.shape, x.dtype),
+                                x)
     return jnp.asarray(cb(np.asarray(x)))
 
 
@@ -382,7 +418,7 @@ def hvd_broadcast_pytree(tree, root_rank=0, name=None, process_set=0):
 
     if any(_is_traced(l) for l in leaves):
         shapes = tuple(jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves)
-        outs = io_callback(cb, shapes, *leaves, ordered=True)
+        outs = _bridge_callback(cb, shapes, *leaves)
     else:
         outs = tuple(jnp.asarray(o) for o in cb(*leaves))
     return jax.tree.unflatten(treedef, outs)

@@ -288,3 +288,30 @@ def test_make_train_step_adasum_reduction():
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
     assert np.isfinite(losses[-1])
+
+
+def test_bridge_callback_relay_gate(monkeypatch):
+    """The in-jit core-bridge ops must fail AT TRACE TIME on a
+    remote-compile relay backend (io_callback programs hang forever in
+    its compiler — measured round 5) instead of hanging, and the
+    override knob must restore the normal lowering."""
+    import pytest
+
+    from horovod_tpu.ops import jax_ops as jo
+
+    # Forced-error knob stands in for the relay (JAX_PLATFORMS can't be
+    # changed after backend init in this process).
+    monkeypatch.setenv("HVD_INJIT_CALLBACKS", "0")
+    with pytest.raises(RuntimeError, match="io_callback"):
+        jax.jit(lambda x: jo.hvd_allreduce(x))(jnp.ones(4))
+
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")  # relay signature
+    monkeypatch.delenv("HVD_INJIT_CALLBACKS", raising=False)
+    with pytest.raises(RuntimeError, match="remote-compile relay"):
+        jax.jit(lambda x: jo.hvd_allreduce(x))(jnp.ones(4))
+
+    # Override re-opens the gate: tracing/lowering succeeds again (the
+    # gate fires at trace time; execution would need an initialized
+    # core, which single-process pytest doesn't have).
+    monkeypatch.setenv("HVD_INJIT_CALLBACKS", "1")
+    jax.jit(lambda x: jo.hvd_allreduce(x, op=jo.Sum)).lower(jnp.ones(4))
