@@ -4,6 +4,11 @@ every rank trains the same MLP on synthetic data; gradients ride the
 native core's fused allreduce; rank 0 reports images/sec.
 
 Run: tpurun -np 4 python examples/jax_synthetic_benchmark.py
+
+The in-jit gradient allreduce lowers to a host callback; on a
+remote-compile relay backend (see docs/running.md) it raises at trace
+time with guidance — use examples/jax_mesh_train.py (pure-XLA in-mesh
+path) on such platforms.
 """
 import os
 import time
