@@ -51,6 +51,7 @@ exactly one.
 
 import json
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -655,10 +656,17 @@ def _bench_elastic():
     with open(marker) as f:
         t_death = float(f.read())
     stamps = []
+    torn = 0
     with open(log_path) as f:
         for line in f:
-            ts, it = line.split()
-            stamps.append((float(ts), int(it.split("=")[1])))
+            # Two unsynchronized ranks append concurrently; a rare torn/
+            # interleaved line must degrade one data point, not fail the
+            # whole config (ADVICE r5).
+            m = re.fullmatch(r"(\d+\.?\d*)\s+it=(\d+)\s*", line)
+            if m is None:
+                torn += 1
+                continue
+            stamps.append((float(m.group(1)), int(m.group(2))))
     # Only iterations >= the death point count as recovery evidence: the
     # survivor's bookkeeping for the iteration BEFORE the death can land
     # microseconds after the death stamp (both ranks run unsynchronized
@@ -667,13 +675,16 @@ def _bench_elastic():
                   if t > t_death and it >= _ELASTIC_DEATH_IT)
     if not post:
         raise RuntimeError("no post-failure iterations logged")
-    return {"metric": "elastic_recovery_seconds",
-            "value": round(post[0] - t_death, 2),
-            "unit": "s (rank death -> first post-failure collective)",
-            "ranks": 2, "iters": iters,
-            "note": "detection + re-rendezvous + respawn + state restore, "
-                    "measured on a localhost fake pod",
-            "vs_baseline": 1.0}
+    out = {"metric": "elastic_recovery_seconds",
+           "value": round(post[0] - t_death, 2),
+           "unit": "s (rank death -> first post-failure collective)",
+           "ranks": 2, "iters": iters,
+           "note": "detection + re-rendezvous + respawn + state restore, "
+                   "measured on a localhost fake pod",
+           "vs_baseline": 1.0}
+    if torn:
+        out["torn_log_lines_skipped"] = torn
+    return out
 
 
 def _elastic_worker():
@@ -861,6 +872,22 @@ def _cap(name):
                                 _CONFIG_CAPS[name]))
 
 
+def _jax_cache_dir():
+    """Compilation-cache dir for config children. The legacy shared name
+    is reused while it belongs to us (keeps an already-warm cache warm);
+    otherwise fall back to a per-user path — a fixed shared /tmp dir
+    created by another user would make every later user's cache writes
+    fail with EACCES (ADVICE r5)."""
+    shared = os.path.join(tempfile.gettempdir(), "hvd-bench-jaxcache")
+    try:
+        if os.stat(shared).st_uid == os.getuid() \
+                and os.access(shared, os.W_OK):
+            return shared
+    except OSError:
+        pass  # absent: claim the per-user name, never the shared one
+    return f"{shared}-{os.getuid()}"
+
+
 def _run_config_child(name, timeout):
     """One config in a kill-able subprocess; returns its JSON dict or an
     error dict. The child re-enters this file with _BENCH_CHILD=1."""
@@ -872,9 +899,7 @@ def _run_config_child(name, timeout):
     # in-jit loops alone cost ~135 s of remote compile per cold process,
     # and a frozen executable also removes compile-schedule variance
     # between runs. Verified to work through the remote-compile relay.
-    env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                   os.path.join(tempfile.gettempdir(),
-                                "hvd-bench-jaxcache"))
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", _jax_cache_dir())
     rc, out = _run_subprocess([sys.executable, os.path.abspath(__file__)],
                               env, timeout)
     if rc == 0:
@@ -890,6 +915,24 @@ def _run_config_child(name, timeout):
 
 def _emit(d):
     print(json.dumps(d), flush=True)
+
+
+def _attach_metrics_snapshot(d):
+    """With HVD_METRICS=1, fold this config child's metrics registry into
+    its recorded line (so each BENCH_*.json payload carries the op-level
+    byte/latency/elastic counters behind its headline number). Runs in
+    the measuring child only — the wedge-proof parent stays jax-free and
+    the import here is the jax-free observability package."""
+    if os.environ.get("HVD_METRICS") != "1" or not isinstance(d, dict):
+        return
+    try:
+        from horovod_tpu import observability
+
+        snap = observability.metrics.snapshot()
+        # Drop families that never recorded: keep the payload readable.
+        d["metrics"] = {k: v for k, v in snap.items() if v["samples"]}
+    except Exception as e:  # noqa: BLE001 — observability is best-effort
+        d["metrics"] = {"error": str(e)}
 
 
 def _wedged_fallback(reason):
@@ -919,7 +962,9 @@ def main():
             raise SystemExit(f"unknown BENCH_CONFIG={which!r}")
         if os.environ.get("_BENCH_TEST_HANG") == which:
             time.sleep(1e6)  # test hook: simulate a wedged config
-        _emit(_retry_transient(_CONFIG_FNS[which]))
+        d = _retry_transient(_CONFIG_FNS[which])
+        _attach_metrics_snapshot(d)
+        _emit(d)
         return
 
     deadline = time.time() + float(os.environ.get("BENCH_DEADLINE", "1200"))

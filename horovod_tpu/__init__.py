@@ -99,6 +99,7 @@ def init():
     collectives cross process boundaries over ICI."""
     import os as _os
 
+    observability.maybe_start_endpoint()
     if _os.environ.get("HVD_ELASTIC") == "1":
         from .runner.elastic import worker as _worker
 
@@ -164,3 +165,4 @@ def tpu_built():
 
 from . import elastic  # noqa: F401,E402  (hvd.elastic.run / State / ObjectState)
 from . import profiler  # noqa: F401,E402  (xplane trace windows + op ranges)
+from . import observability  # noqa: F401,E402  (metrics / stall / spans)

@@ -1,0 +1,54 @@
+"""Bounded acquisition of the shared csrc build lock.
+
+The build lock (``csrc/.build.lock``) serializes native rebuilds across
+concurrently-importing ranks (see :func:`horovod_tpu.basics._maybe_build`).
+A plain blocking ``flock`` turns one orphaned holder — e.g. an elastic
+worker SIGKILLed mid-build whose re-parented child keeps the fd — into a
+machine-wide wedge where every later ``import horovod_tpu`` blocks
+forever.  Acquire with ``LOCK_NB`` in a bounded retry loop instead; the
+caller decides what a timeout means (use the existing library, fall back
+to the numpy bridge, skip make).  A holder that outlives the timeout is
+wedged, not building: a full core rebuild takes well under a minute.
+"""
+import fcntl
+import logging
+import os
+import time
+
+log = logging.getLogger("horovod_tpu.build")
+
+
+def timeout_from_env(default=600.0):
+    """Lock-wait budget in seconds (``HVD_BUILD_LOCK_TIMEOUT``).
+
+    ``0`` or negative restores the legacy block-forever behavior."""
+    try:
+        return float(os.environ.get("HVD_BUILD_LOCK_TIMEOUT", default))
+    except ValueError:
+        return default
+
+
+def acquire(lock_file, timeout, poll=0.5, name="csrc/.build.lock"):
+    """flock(LOCK_EX) ``lock_file``, giving up after ``timeout`` seconds.
+
+    Returns True when the lock was taken.  On timeout logs a warning
+    naming the suspected-orphaned holder and returns False — the caller
+    proceeds without the lock.  ``timeout <= 0`` blocks indefinitely.
+    """
+    if timeout <= 0:
+        fcntl.flock(lock_file, fcntl.LOCK_EX)
+        return True
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            fcntl.flock(lock_file, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return True
+        except OSError:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                log.warning(
+                    "gave up waiting for %s after %.0fs — held by another "
+                    "process (possibly an orphaned build worker); "
+                    "proceeding without the lock", name, timeout)
+                return False
+            time.sleep(min(poll, remaining))

@@ -27,8 +27,11 @@ re-init (see `horovod_tpu.runner.elastic.worker`).
 import os
 import copy
 import functools
+import time
 
 from .exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from .observability import metrics as _metrics
+from .observability import spans as _spans
 from .ops import collective_ops as _core
 
 
@@ -246,6 +249,8 @@ def _retry_reset(reset):
         except Exception as e:  # noqa: BLE001 — any rendezvous failure
             if attempt + 1 >= attempts:
                 raise
+            if _metrics.enabled():
+                _metrics.ELASTIC_EVENTS.labels(event="reset_retry").inc()
             print(f"[hvd elastic] reset attempt {attempt + 1} failed "
                   f"({e}); re-entering rendezvous", flush=True)
 
@@ -267,16 +272,31 @@ def run_fn(func, reset):
             while True:
                 if reset_required:
                     state.prepare_reset()
-                    _retry_reset(reset)
+                    if _metrics.enabled():
+                        t0 = time.perf_counter()
+                        with _spans.span("elastic.reset", cat="elastic"):
+                            _retry_reset(reset)
+                        _metrics.ELASTIC_EVENTS.labels(
+                            event="reset").inc()
+                        _metrics.ELASTIC_RESET_SECONDS.observe(
+                            time.perf_counter() - t0)
+                    else:
+                        _retry_reset(reset)
                     state.on_reset()
                     reset_required = False
                 state.sync()
                 try:
                     return func(state, *args, **kwargs)
                 except HorovodInternalError:
+                    if _metrics.enabled():
+                        _metrics.ELASTIC_EVENTS.labels(
+                            event="failure").inc()
                     state.restore()
                     reset_required = True
                 except HostsUpdatedInterrupt:
+                    if _metrics.enabled():
+                        _metrics.ELASTIC_EVENTS.labels(
+                            event="host_update").inc()
                     reset_required = True
                 except Exception as e:  # noqa: BLE001
                     # The native TF custom ops (csrc/tf_ops.cc) surface a
@@ -290,6 +310,9 @@ def run_fn(func, reset):
                     # restore/rendezvous forever.
                     if not _is_native_op_failure(e):
                         raise
+                    if _metrics.enabled():
+                        _metrics.ELASTIC_EVENTS.labels(
+                            event="failure").inc()
                     state.restore()
                     reset_required = True
         finally:

@@ -1,0 +1,399 @@
+"""Process-local metrics registry: Counter / Gauge / Histogram.
+
+The runtime-health counterpart of the reference's timeline + stall
+inspector pair: where the timeline answers "what happened when", the
+registry answers "how much, how often, how slow" — bytes moved per
+collective, call latency, elastic resize events — scrapeable from a live
+job through the Prometheus text exposition served at ``/metrics``
+(:mod:`horovod_tpu.runner.http_server`).
+
+Discipline (the same register-once-and-noop rule ``profiler.py`` follows
+for NVTX/xplane ranges): everything is **off unless ``HVD_METRICS=1``**
+(or :func:`enable` was called), and the disabled path costs one module
+attribute check per call — no lock acquisition, no label lookup, no jax
+import anywhere in this module (guarded by
+tests/test_observability.py::test_disabled_path_touches_no_lock).
+
+Threading: one registry per process (each rank serves its own
+``/metrics``; aggregate across ranks in the scraper, which is how
+per-process exporters compose in Prometheus). All mutation is
+lock-protected, so the background progress threads (stall inspector,
+elastic reset loop) and user threads can record concurrently.
+
+Labels: every predefined hvd metric is labeled by op name and process
+set so per-op / per-subcommunicator series stay separable.
+"""
+
+import os
+import threading
+import time
+
+_enabled = os.environ.get("HVD_METRICS", "0") == "1"
+
+
+def enabled():
+    """One attribute read — THE hot-path gate every instrumentation site
+    checks before doing any metric work."""
+    return _enabled
+
+
+def enable():
+    global _enabled
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+# ---------------------------------------------------------------------------
+# Metric types
+
+class _NoopChild:
+    """Shared do-nothing child returned by ``labels()`` while disabled:
+    a call site that skipped the ``enabled()`` gate still performs no
+    lock acquisition and mutates nothing."""
+
+    __slots__ = ()
+
+    def inc(self, amount=1):
+        pass
+
+    def dec(self, amount=1):
+        pass
+
+    def set(self, value):
+        pass
+
+    def observe(self, value):
+        pass
+
+
+_NOOP_CHILD = _NoopChild()
+
+
+class _CounterChild:
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock):
+        self._lock = lock
+        self.value = 0.0
+
+    def inc(self, amount=1):
+        if not _enabled:
+            return
+        if amount < 0:
+            raise ValueError("counters can only increase")
+        with self._lock:
+            self.value += amount
+
+
+class _GaugeChild:
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock):
+        self._lock = lock
+        self.value = 0.0
+
+    def set(self, value):
+        if not _enabled:
+            return
+        with self._lock:
+            self.value = float(value)
+
+    def inc(self, amount=1):
+        if not _enabled:
+            return
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount=1):
+        self.inc(-amount)
+
+
+# Prometheus' default latency buckets (seconds) — collective calls span
+# sub-ms (cached negotiation) to tens of seconds (elastic re-rendezvous).
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0)
+
+
+class _HistogramChild:
+    __slots__ = ("_lock", "buckets", "counts", "sum", "count")
+
+    def __init__(self, lock, buckets):
+        self._lock = lock
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value):
+        if not _enabled:
+            return
+        value = float(value)
+        with self._lock:
+            i = 0
+            for b in self.buckets:
+                if value <= b:
+                    break
+                i += 1
+            self.counts[i] += 1
+            self.sum += value
+            self.count += 1
+
+
+_CHILD_TYPES = {"counter": _CounterChild, "gauge": _GaugeChild,
+                "histogram": _HistogramChild}
+
+
+class Metric:
+    """One named family; per-label-set children created on first use."""
+
+    def __init__(self, name, help_, kind, labelnames=(), buckets=None):
+        self.name = name
+        self.help = help_
+        self.kind = kind
+        self.labelnames = tuple(labelnames)
+        self._buckets = tuple(buckets or DEFAULT_BUCKETS)
+        self._lock = threading.Lock()
+        self._children = {}
+
+    def labels(self, **kv):
+        """Child for one label set. Returns the shared no-op child while
+        disabled so even a caller that skipped the enabled() gate never
+        takes this lock on a disabled hot path."""
+        if not _enabled:
+            return _NOOP_CHILD
+        if set(kv) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name} takes labels {self.labelnames}, "
+                f"got {tuple(kv)}")
+        key = tuple(str(kv[n]) for n in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._make_child()
+                self._children[key] = child
+            return child
+
+    def _make_child(self):
+        if self.kind == "histogram":
+            return _HistogramChild(self._lock, self._buckets)
+        return _CHILD_TYPES[self.kind](self._lock)
+
+    # Label-less convenience: metric.inc() == metric.labels().inc()
+    def inc(self, amount=1):
+        self.labels().inc(amount)
+
+    def dec(self, amount=1):
+        self.labels().dec(amount)
+
+    def set(self, value):
+        self.labels().set(value)
+
+    def observe(self, value):
+        self.labels().observe(value)
+
+    def collect(self):
+        """Snapshot [(labelvalues, child_state_dict)] under the lock."""
+        with self._lock:
+            out = []
+            for key, c in sorted(self._children.items()):
+                if self.kind == "histogram":
+                    out.append((key, {"buckets": list(c.counts),
+                                      "sum": c.sum, "count": c.count}))
+                else:
+                    out.append((key, {"value": c.value}))
+            return out
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _register(self, name, help_, kind, labelnames, buckets=None):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if m.kind != kind or m.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name} re-registered with a different "
+                        f"type/labels ({m.kind}{m.labelnames} vs "
+                        f"{kind}{tuple(labelnames)})")
+                return m
+            m = Metric(name, help_, kind, labelnames, buckets)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help_="", labelnames=()):
+        return self._register(name, help_, "counter", labelnames)
+
+    def gauge(self, name, help_="", labelnames=()):
+        return self._register(name, help_, "gauge", labelnames)
+
+    def histogram(self, name, help_="", labelnames=(), buckets=None):
+        return self._register(name, help_, "histogram", labelnames,
+                              buckets)
+
+    def metrics(self):
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def clear(self):
+        """Drop every recorded sample (tests). Families stay registered —
+        module-level metric objects keep working."""
+        with self._lock:
+            families = list(self._metrics.values())
+        for m in families:
+            with m._lock:
+                m._children.clear()
+
+
+REGISTRY = Registry()
+
+# Module-level registration shorthand (mirrors prometheus_client).
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram
+
+
+# ---------------------------------------------------------------------------
+# Exposition
+
+def _escape(v):
+    return (v.replace("\\", "\\\\").replace("\n", "\\n")
+             .replace('"', '\\"'))
+
+
+def _fmt_labels(names, values, extra=()):
+    pairs = [f'{n}="{_escape(v)}"' for n, v in zip(names, values)]
+    pairs += [f'{n}="{_escape(v)}"' for n, v in extra]
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def _fmt_value(v):
+    if v == int(v):
+        return str(int(v))
+    return repr(float(v))
+
+
+def render_text():
+    """Prometheus text exposition (format version 0.0.4) of every family
+    in the process registry."""
+    lines = []
+    for m in REGISTRY.metrics():
+        lines.append(f"# HELP {m.name} {m.help}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        for key, state in m.collect():
+            if m.kind == "histogram":
+                cum = 0
+                for b, c in zip(m._buckets + (float("inf"),),
+                                state["buckets"]):
+                    cum += c
+                    le = "+Inf" if b == float("inf") else _fmt_value(b)
+                    lines.append(
+                        f"{m.name}_bucket"
+                        f"{_fmt_labels(m.labelnames, key, [('le', le)])}"
+                        f" {cum}")
+                lines.append(f"{m.name}_sum"
+                             f"{_fmt_labels(m.labelnames, key)}"
+                             f" {_fmt_value(state['sum'])}")
+                lines.append(f"{m.name}_count"
+                             f"{_fmt_labels(m.labelnames, key)}"
+                             f" {state['count']}")
+            else:
+                lines.append(f"{m.name}{_fmt_labels(m.labelnames, key)}"
+                             f" {_fmt_value(state['value'])}")
+    return "\n".join(lines) + "\n"
+
+
+def snapshot():
+    """JSON-able dump of the registry — what bench.py attaches to each
+    config's recorded line under ``"metrics"``."""
+    out = {}
+    for m in REGISTRY.metrics():
+        samples = []
+        for key, state in m.collect():
+            samples.append({"labels": dict(zip(m.labelnames, key)),
+                            **state})
+        out[m.name] = {"type": m.kind, "help": m.help, "samples": samples}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The standard hvd instrument set. Families are registered at import
+# (cheap, once); they record nothing until enabled.
+
+OP_CALLS = counter(
+    "hvd_op_calls_total",
+    "Collective API calls through ops.collective_ops",
+    ("op", "process_set"))
+OP_BYTES = counter(
+    "hvd_op_bytes_total",
+    "Input payload bytes submitted to collectives",
+    ("op", "process_set"))
+OP_SECONDS = histogram(
+    "hvd_op_latency_seconds",
+    "Wall time of collective API calls (async ops: enqueue; sync "
+    "wrappers and synchronize: full completion wait)",
+    ("op", "process_set"))
+BRIDGE_TRACES = counter(
+    "hvd_bridge_traces_total",
+    "In-jit core-bridged collectives lowered to an io_callback "
+    "(trace-time count; per-step execution is counted by hvd_op_* "
+    "when the callback runs)",
+    ("op",))
+ELASTIC_EVENTS = counter(
+    "hvd_elastic_events_total",
+    "Elastic lifecycle events (failure / host_update / reset / "
+    "reset_retry)",
+    ("event",))
+ELASTIC_RESET_SECONDS = histogram(
+    "hvd_elastic_reset_seconds",
+    "Re-rendezvous duration (shutdown -> new assignment -> init)",
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
+PIPELINE_TRACES = counter(
+    "hvd_pipeline_traces_total",
+    "pipeline_apply schedule constructions (trace-time: one per "
+    "compile, not per step)",
+    ("stages", "microbatches"))
+PIPELINE_BUBBLE = gauge(
+    "hvd_pipeline_bubble_fraction",
+    "Bubble fraction (S-1)/(M+S-1) of the last-built pipeline schedule")
+STALL_WARNINGS = counter(
+    "hvd_stall_warnings_total",
+    "Python-side stall inspector warnings", ("op",))
+
+
+def record_call(op, seconds, nbytes, process_set=0):
+    """One instrumented collective call — called by ops.collective_ops
+    ONLY when :func:`enabled` (the caller holds the gate so the disabled
+    path never reaches this function, pays no perf_counter, no nbytes)."""
+    ps = str(process_set)
+    OP_CALLS.labels(op=op, process_set=ps).inc()
+    if nbytes:
+        OP_BYTES.labels(op=op, process_set=ps).inc(nbytes)
+    OP_SECONDS.labels(op=op, process_set=ps).observe(seconds)
+
+
+class _Timer:
+    """``with metrics.timer(hist_child):`` — records on exit."""
+
+    __slots__ = ("_child", "_t0")
+
+    def __init__(self, child):
+        self._child = child
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._child.observe(time.perf_counter() - self._t0)
+        return False
+
+
+def timer(child):
+    return _Timer(child)

@@ -1,0 +1,183 @@
+"""Python-side stall / straggler inspector.
+
+Mirrors the reference's ``horovod/common/stall_inspector.cc`` (rebuilt in
+this repo's core as ``csrc/controller.cc StallInspector`` for collectives
+the C++ coordinator negotiates): a table of per-op last-progress
+timestamps and a background watcher that flags ops stalled past a
+configurable warning threshold and optionally kills the job past a
+shutdown threshold.
+
+The C++ inspector only sees tensors that reached the coordinator; this
+one watches the *Python* side — an enqueue that never completed its
+``synchronize``, a bridged in-jit callback that never returned, an
+elastic reset stuck in rendezvous — i.e. the straggler half the core
+cannot observe. ops.collective_ops reports starts/completions into the
+process-wide :data:`inspector` whenever metrics are enabled (same
+``HVD_METRICS=1`` gate, so the disabled hot path pays nothing).
+
+Thresholds share the core's knobs: ``HVD_STALL_CHECK_TIME_SECONDS``
+(warn; default 60, <=0 disables warnings), ``HVD_STALL_SHUTDOWN_TIME_SECONDS``
+(default -1 = never shut down), plus
+``HVD_STALL_CHECK_INTERVAL_SECONDS`` for the watcher period.
+"""
+
+import logging
+import os
+import threading
+import time
+
+from . import metrics as _metrics
+
+LOG = logging.getLogger("horovod_tpu.stall")
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class StallError(RuntimeError):
+    """Raised (by the default shutdown action) from the watcher thread's
+    owner via :meth:`StallInspector.check_shutdown`."""
+
+
+class StallInspector:
+    """Watches per-op last-progress timestamps from a daemon thread.
+
+    Lifecycle: lazily started on the first :meth:`report_start` (so a
+    process that never runs a collective never spawns the thread);
+    :meth:`stop` joins it. ``on_warn(op, stalled_seconds)`` fires once
+    per op per stall episode (re-arms when the op progresses);
+    ``on_shutdown(op, stalled_seconds)`` fires at most once, then the
+    inspector records a pending :class:`StallError` that
+    :meth:`check_shutdown` re-raises on the caller's thread — a daemon
+    thread cannot usefully raise into user code itself.
+    """
+
+    def __init__(self, warning_sec=None, shutdown_sec=None,
+                 check_interval=None, on_warn=None, on_shutdown=None):
+        self.warning_sec = (
+            _env_float("HVD_STALL_CHECK_TIME_SECONDS", 60.0)
+            if warning_sec is None else float(warning_sec))
+        self.shutdown_sec = (
+            _env_float("HVD_STALL_SHUTDOWN_TIME_SECONDS", -1.0)
+            if shutdown_sec is None else float(shutdown_sec))
+        if check_interval is None:
+            check_interval = _env_float(
+                "HVD_STALL_CHECK_INTERVAL_SECONDS", 0.0)
+        if check_interval <= 0:
+            # Half the tightest active threshold, clamped sane.
+            active = [t for t in (self.warning_sec, self.shutdown_sec)
+                      if t > 0]
+            check_interval = min(10.0, max(0.05, min(active) / 2.0)) \
+                if active else 10.0
+        self.check_interval = check_interval
+        self.on_warn = on_warn
+        self.on_shutdown = on_shutdown
+        self._lock = threading.Lock()
+        self._ops = {}      # name -> last-progress monotonic timestamp
+        self._warned = set()
+        self._thread = None
+        self._stop = threading.Event()
+        self.shutdown_fired = False
+        self._pending_error = None
+
+    # -- reporting surface (instrumentation sites) -----------------------
+    def report_start(self, name):
+        """An op entered flight (e.g. its async enqueue returned)."""
+        with self._lock:
+            self._ops[name] = time.monotonic()
+            self._warned.discard(name)
+            if self._thread is None and not self._stop.is_set():
+                self._thread = threading.Thread(
+                    target=self._watch, name="hvd-stall-inspector",
+                    daemon=True)
+                self._thread.start()
+
+    def report_progress(self, name):
+        """The op moved (bytes flowed, a retry round completed, ...)."""
+        with self._lock:
+            if name in self._ops:
+                self._ops[name] = time.monotonic()
+                self._warned.discard(name)
+
+    def report_done(self, name):
+        with self._lock:
+            self._ops.pop(name, None)
+            self._warned.discard(name)
+
+    def check_shutdown(self):
+        """Re-raise a watcher-detected fatal stall on the caller's
+        thread. Instrumented synchronize() calls this so a stalled job
+        dies with a diagnosable error instead of hanging forever."""
+        err = self._pending_error
+        if err is not None:
+            self._pending_error = None
+            raise err
+
+    def stalled(self):
+        """[(name, seconds_since_progress)] — the live straggler view."""
+        now = time.monotonic()
+        with self._lock:
+            return sorted(((n, now - t) for n, t in self._ops.items()),
+                          key=lambda p: -p[1])
+
+    # -- watcher ---------------------------------------------------------
+    def _watch(self):
+        while not self._stop.wait(self.check_interval):
+            self._scan()
+
+    def _scan(self, now=None):
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            items = [(n, now - t) for n, t in self._ops.items()]
+            warned = set(self._warned)
+        worst_name, worst = None, -1.0
+        for name, dt in items:
+            if dt > worst:
+                worst_name, worst = name, dt
+            if (self.warning_sec > 0 and dt >= self.warning_sec
+                    and name not in warned):
+                with self._lock:
+                    self._warned.add(name)
+                _metrics.STALL_WARNINGS.labels(op=name).inc()
+                LOG.warning(
+                    "potential stall: op '%s' has made no progress for "
+                    "%.1fs (HVD_STALL_CHECK_TIME_SECONDS=%g)",
+                    name, dt, self.warning_sec)
+                if self.on_warn is not None:
+                    self.on_warn(name, dt)
+        if (self.shutdown_sec > 0 and worst >= self.shutdown_sec
+                and not self.shutdown_fired):
+            self.shutdown_fired = True
+            LOG.error(
+                "stall shutdown: op '%s' stalled %.1fs, past "
+                "HVD_STALL_SHUTDOWN_TIME_SECONDS=%g", worst_name, worst,
+                self.shutdown_sec)
+            if self.on_shutdown is not None:
+                self.on_shutdown(worst_name, worst)
+            else:
+                self._pending_error = StallError(
+                    f"op '{worst_name}' stalled {worst:.1f}s, past the "
+                    f"{self.shutdown_sec:g}s shutdown threshold")
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+            self._thread = None
+
+    def reset(self):
+        """Forget all state (tests / elastic re-init)."""
+        with self._lock:
+            self._ops.clear()
+            self._warned.clear()
+        self.shutdown_fired = False
+        self._pending_error = None
+
+
+# The process-wide inspector the instrumented op layer reports into.
+inspector = StallInspector()

@@ -486,29 +486,92 @@ def remove_process_set_collective(process_set_id):
 
 
 # ---------------------------------------------------------------------------
-# Profiler ranges around the user-facing op calls (reference:
-# horovod/common/nvtx_op_range.h wraps every Enqueue-level API call in an
-# NVTX range for nsys; the TPU mapping is an xplane TraceAnnotation — see
-# horovod_tpu/profiler.py). Applied by rebinding so internal callers
-# (sync wrappers, grouped fan-out) go through the ranges too; a shared
-# no-op context when HVD_PROFILER is off keeps the disabled cost at one
-# flag check per call.
+# Profiler ranges + observability instrumentation around the user-facing
+# op calls (reference: horovod/common/nvtx_op_range.h wraps every
+# Enqueue-level API call in an NVTX range for nsys; the TPU mapping is an
+# xplane TraceAnnotation — see horovod_tpu/profiler.py — plus this
+# build's metrics registry and Python-side stall inspector,
+# horovod_tpu/observability/). Applied by rebinding so internal callers
+# (sync wrappers, grouped fan-out, the JAX bridge's callbacks) go through
+# it too. Disabled-path discipline: with HVD_PROFILER and HVD_METRICS
+# both off, a call costs two flag checks — no clock read, no nbytes
+# access, no lock, no jax import (guarded by
+# tests/test_observability.py).
 
-def _profiled(fn, range_name):
-    import functools
+import functools
+import time as _time
 
-    from .. import profiler as _profiler
+from .. import profiler as _profiler
+from ..observability import metrics as _obs_metrics
+from ..observability import stall as _obs_stall
+
+# Positional index of `process_set` per instrumented op (grouped fan-out
+# passes it positionally); tensor payloads are always args[0].
+_PS_ARG_INDEX = {"allreduce": 5, "allgather": 2, "broadcast": 3,
+                 "alltoall": 3, "reducescatter": 5, "join": 0,
+                 "barrier": 0}
+_TENSOR_OPS = frozenset(
+    ("allreduce", "allgather", "broadcast", "alltoall", "reducescatter"))
+
+
+def _instrumented(fn, op):
+    range_name = "hvd." + op
+    ps_index = _PS_ARG_INDEX[op]
+    has_tensor = op in _TENSOR_OPS
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
-        with _profiler.op_range(range_name):
-            return fn(*args, **kwargs)
+        if not _obs_metrics.enabled():
+            with _profiler.op_range(range_name):
+                return fn(*args, **kwargs)
+        nbytes = 0
+        if has_tensor and args:
+            nbytes = getattr(args[0], "nbytes", 0) or 0
+        ps = kwargs.get("process_set")
+        if ps is None:
+            ps = args[ps_index] if len(args) > ps_index else 0
+        t0 = _time.perf_counter()
+        try:
+            with _profiler.op_range(range_name):
+                result = fn(*args, **kwargs)
+        finally:
+            _obs_metrics.record_call(op, _time.perf_counter() - t0,
+                                     nbytes, ps)
+        if isinstance(result, Handle):
+            # In-flight op enters the straggler table; synchronize()
+            # clears it (join/barrier/sync wrappers return results, not
+            # handles, and are already complete here).
+            _obs_stall.inspector.report_start(result.name)
+        return result
+    return wrapper
+
+
+def _instrumented_synchronize(fn):
+    @functools.wraps(fn)
+    def wrapper(handle, *args, **kwargs):
+        if not _obs_metrics.enabled():
+            with _profiler.op_range("hvd.synchronize"):
+                return fn(handle, *args, **kwargs)
+        # A watcher-detected fatal stall surfaces here, on a thread that
+        # can propagate it, instead of the job hanging forever.
+        _obs_stall.inspector.check_shutdown()
+        kind = getattr(handle, "kind", "group")
+        t0 = _time.perf_counter()
+        try:
+            with _profiler.op_range("hvd.synchronize"):
+                return fn(handle, *args, **kwargs)
+        finally:
+            _obs_metrics.record_call(kind + ".wait",
+                                     _time.perf_counter() - t0, 0, 0)
+            if isinstance(handle, Handle):
+                _obs_stall.inspector.report_done(handle.name)
+            # Lists recurse through this wrapper per element.
     return wrapper
 
 
 for _op in ("allreduce_async", "allgather_async", "broadcast_async",
-            "alltoall_async", "reducescatter_async", "join", "barrier",
-            "synchronize"):
-    _name = "hvd." + _op.removesuffix("_async")
-    globals()[_op] = _profiled(globals()[_op], _name)
-del _op, _name
+            "alltoall_async", "reducescatter_async", "join", "barrier"):
+    globals()[_op] = _instrumented(globals()[_op],
+                                   _op.removesuffix("_async"))
+synchronize = _instrumented_synchronize(synchronize)
+del _op

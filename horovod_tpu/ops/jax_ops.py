@@ -27,6 +27,7 @@ import numpy as np
 from jax import lax
 from jax.experimental import io_callback
 
+from ..observability import metrics as _obs_metrics
 from . import collective_ops as _core
 from .collective_ops import (  # noqa: F401  (re-exported op constants)
     Adasum,
@@ -195,7 +196,7 @@ def _is_traced(x):
     return isinstance(x, jax.core.Tracer)
 
 
-def _bridge_callback(cb, result_shape, *args):
+def _bridge_callback(cb, result_shape, *args, op="bridge"):
     """``io_callback`` with a trace-time guard for remote-compile relay
     backends. On a relay-attached chip (the ``axon`` PJRT plugin — it
     reports platform "tpu", so ``JAX_PLATFORMS`` is the only signal) a
@@ -208,10 +209,14 @@ def _bridge_callback(cb, result_shape, *args):
     platform."""
     allow = os.environ.get("HVD_INJIT_CALLBACKS")
     # Platform may be selected via env OR jax.config (the config value is
-    # seeded from the env var but also settable directly — e.g. this
-    # repo's own jax.config.update platform selection).
-    platforms = os.environ.get("JAX_PLATFORMS", "") or \
-        str(getattr(jax.config, "jax_platforms", None) or "")
+    # seeded from the env var but also settable directly — e.g. a site
+    # hook pinning the config to "axon,cpu" while the env still says
+    # "cpu", verified live: JAX_PLATFORMS=cpu still initializes the axon
+    # relay). Env-first short-circuiting missed exactly that case, so the
+    # guard inspects the UNION of both signals (ADVICE r5).
+    env_platforms = os.environ.get("JAX_PLATFORMS", "") or ""
+    cfg_platforms = str(getattr(jax.config, "jax_platforms", None) or "")
+    platforms = ",".join(p for p in (env_platforms, cfg_platforms) if p)
     relay = "axon" in platforms
     if allow != "1" and (relay or allow == "0"):
         why = (f"this remote-compile relay backend (platforms="
@@ -226,6 +231,11 @@ def _bridge_callback(cb, result_shape, *args):
             "ops.jax_ops in-mesh ops, e.g. make_train_step), call the "
             "op OUTSIDE jit (eager arrays take the direct core path), "
             "or set HVD_INJIT_CALLBACKS=1 to override.")
+    if _obs_metrics.enabled():
+        # Trace-time count of bridge lowerings (one per compiled program,
+        # not per step); the callback's per-execution bytes/latency are
+        # recorded by the instrumented _core ops it calls into.
+        _obs_metrics.BRIDGE_TRACES.labels(op=op).inc()
     return io_callback(cb, result_shape, *args, ordered=True)
 
 
@@ -248,7 +258,7 @@ def hvd_allreduce(x, op=Average, name=None, process_set=0,
 
     if _is_traced(x):
         return _bridge_callback(cb, jax.ShapeDtypeStruct(x.shape, x.dtype),
-                                x)
+                                x, op="allreduce")
     out = cb(np.asarray(x))
     return jnp.asarray(out)
 
@@ -275,7 +285,8 @@ def hvd_allreduce_pytree(tree, op=Average, name=None, process_set=0,
 
     if any(_is_traced(l) for l in leaves):
         shapes = tuple(jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves)
-        outs = _bridge_callback(cb, shapes, *leaves)
+        outs = _bridge_callback(cb, shapes, *leaves,
+                                op="grouped_allreduce")
     else:
         outs = cb(*leaves)
         outs = tuple(jnp.asarray(o) for o in outs)
@@ -309,7 +320,8 @@ def hvd_allgather(x, name=None, process_set=0):
             return out
 
         return _bridge_callback(cb_checked,
-                                jax.ShapeDtypeStruct(shape, x.dtype), x)
+                                jax.ShapeDtypeStruct(shape, x.dtype), x,
+                                op="allgather")
     return jnp.asarray(_core.allgather(np.asarray(x), name=name,
                                        process_set=process_set))
 
@@ -354,7 +366,7 @@ def hvd_alltoall(x, splits=None, name=None, process_set=0):
             return out
 
         return _bridge_callback(cb, jax.ShapeDtypeStruct(x.shape, x.dtype),
-                                x)
+                                x, op="alltoall")
     out, rs = _core.synchronize(_core.alltoall_async(
         np.asarray(x), splits, name, process_set))
     if splits is None:
@@ -382,7 +394,7 @@ def hvd_reducescatter(x, op=Average, name=None, process_set=0,
         rows = x.shape[0] // n + (1 if r < x.shape[0] % n else 0)
         shape = (rows,) + tuple(x.shape[1:])
         return _bridge_callback(cb, jax.ShapeDtypeStruct(shape, x.dtype),
-                                x)
+                                x, op="reducescatter")
     return jnp.asarray(cb(np.asarray(x)))
 
 
@@ -395,7 +407,7 @@ def hvd_broadcast(x, root_rank=0, name=None, process_set=0):
 
     if _is_traced(x):
         return _bridge_callback(cb, jax.ShapeDtypeStruct(x.shape, x.dtype),
-                                x)
+                                x, op="broadcast")
     return jnp.asarray(cb(np.asarray(x)))
 
 
@@ -418,7 +430,8 @@ def hvd_broadcast_pytree(tree, root_rank=0, name=None, process_set=0):
 
     if any(_is_traced(l) for l in leaves):
         shapes = tuple(jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves)
-        outs = _bridge_callback(cb, shapes, *leaves)
+        outs = _bridge_callback(cb, shapes, *leaves,
+                                op="broadcast_tree")
     else:
         outs = tuple(jnp.asarray(o) for o in cb(*leaves))
     return jax.tree.unflatten(treedef, outs)

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax import lax, shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..observability import metrics as _metrics
+
 
 def pipeline_apply(stage_fn, stage_params, x, mesh, axis="pipe",
                    n_microbatches=None, batch_axis=None):
@@ -68,6 +70,13 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis="pipe",
             raise ValueError(
                 f"stage_params leaf shape {jnp.shape(leaf)} must lead "
                 f"with the pipeline stage count {S} (mesh axis {axis!r})")
+    if _metrics.enabled():
+        # Trace-time schedule metadata (this body runs once per compile,
+        # not per step — per-tick device work is XLA's, visible through
+        # the xplane profiler, not host counters).
+        _metrics.PIPELINE_TRACES.labels(
+            stages=str(S), microbatches=str(M)).inc()
+        _metrics.PIPELINE_BUBBLE.set((S - 1) / (M + S - 1))
     mb = B // M
     xm = x.reshape((M, mb) + x.shape[1:])
 

@@ -20,6 +20,9 @@ YAML schema (any subset):
     autotune:
       enable: true
       log-file: /tmp/autotune.csv
+    metrics:
+      enable: true
+      port: 9090
 """
 
 # arg attribute name → (env var, transform-to-env)
@@ -40,6 +43,11 @@ ARG_TO_ENV = {
     "autotune_log_file": ("HVD_AUTOTUNE_LOG", str),
     "start_timeout": ("HVD_START_TIMEOUT", str),
     "log_level": ("HVD_LOG_LEVEL", str),
+    # Observability (horovod_tpu/observability/): the metrics registry,
+    # span recorder, and Python-side stall inspector all gate on
+    # HVD_METRICS; HVD_METRICS_PORT adds a per-worker /metrics endpoint.
+    "metrics": ("HVD_METRICS", lambda v: "1" if v else "0"),
+    "metrics_port": ("HVD_METRICS_PORT", str),
 }
 
 _FILE_SECTIONS = {
@@ -53,6 +61,7 @@ _FILE_SECTIONS = {
                     "shutdown-time-seconds":
                     "stall_check_shutdown_time_seconds"},
     "autotune": {"enable": "autotune", "log-file": "autotune_log_file"},
+    "metrics": {"enable": "metrics", "port": "metrics_port"},
 }
 
 

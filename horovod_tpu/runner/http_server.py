@@ -8,6 +8,15 @@ requests carry an HMAC signature header when the server was given a key.
 
 GET on a missing key returns 404 and clients poll — that is the rendezvous
 barrier (same semantics the reference's Gloo context relies on).
+
+Observability: both servers here also expose the process's metrics
+registry as Prometheus text at ``GET /metrics`` — the driver's
+RendezvousServer piggybacks it on the KV port, and
+:class:`MetricsServer` is the standalone per-worker endpoint
+(auto-started by ``hvd.init()`` via
+``horovod_tpu.observability.maybe_start_endpoint``). ``/metrics`` is
+read-only health data and scrapers cannot sign requests, so it is served
+without the HMAC check.
 """
 
 import threading
@@ -18,6 +27,20 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from . import util
 
 SIG_HEADER = "X-Hvd-Sig"
+METRICS_PATH = "/metrics"
+_METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _serve_metrics(handler):
+    """Write the registry's Prometheus exposition as the response."""
+    from .. import observability
+
+    body = observability.metrics.render_text().encode()
+    handler.send_response(200)
+    handler.send_header("Content-Type", _METRICS_CONTENT_TYPE)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -42,6 +65,9 @@ class _KVHandler(BaseHTTPRequestHandler):
             key, self.command.encode() + self.path.encode() + payload, sig)
 
     def do_GET(self):
+        if self.path == METRICS_PATH:
+            _serve_metrics(self)
+            return
         if not self._check_sig():
             self.send_error(403)
             return
@@ -125,6 +151,49 @@ class RendezvousServer:
     def delete(self, path):
         with self._httpd.kv_lock:
             self._httpd.kv.pop(path, None)
+
+
+class _MetricsOnlyHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def do_GET(self):
+        if self.path == METRICS_PATH:
+            _serve_metrics(self)
+            return
+        self.send_error(404)
+
+
+class MetricsServer:
+    """Standalone ``/metrics`` endpoint for a worker process (the driver's
+    RendezvousServer already serves it on the KV port). start() returns
+    the bound port; the serving thread is a daemon, so a forgotten stop()
+    never blocks process exit."""
+
+    def __init__(self, addr="0.0.0.0"):
+        self._addr = addr
+        self._httpd = None
+        self._thread = None
+
+    def start(self, port=0):
+        self._httpd = ThreadingHTTPServer((self._addr, port),
+                                          _MetricsOnlyHandler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
 
 
 def _request(method, url, payload=b"", secret_key=None, timeout=10.0):

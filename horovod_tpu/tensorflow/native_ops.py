@@ -37,11 +37,13 @@ def _make_under_lock(target):
     if not os.path.isdir(_CSRC):
         return
     try:
-        import fcntl
         import sys
 
+        from horovod_tpu import _build_lock
+
         with open(os.path.join(_CSRC, ".build.lock"), "w") as lk:
-            fcntl.flock(lk, fcntl.LOCK_EX)
+            if not _build_lock.acquire(lk, _build_lock.timeout_from_env()):
+                return  # stuck holder: skip make, load whatever shipped
             subprocess.run(
                 ["make", "-s", target, f"PYTHON={sys.executable}"],
                 cwd=_CSRC, check=True, stdout=subprocess.DEVNULL,

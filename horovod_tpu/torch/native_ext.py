@@ -46,14 +46,24 @@ def lib():
             and os.path.exists(os.path.join(_LIBDIR, "libhvd_tpu.so"))):
         return None
     try:
-        import fcntl
-
         from torch.utils import cpp_extension
+
+        from horovod_tpu import _build_lock
 
         build_dir = jit_build_dir()
         os.makedirs(build_dir, exist_ok=True)
         with open(os.path.join(_CSRC, ".build.lock"), "w") as lk:
-            fcntl.flock(lk, fcntl.LOCK_EX)
+            if not _build_lock.acquire(lk, _build_lock.timeout_from_env()):
+                # Stuck holder (orphaned build): fall back to the numpy
+                # bridge rather than wedging this import forever.
+                raise RuntimeError("build lock timeout")
+            # Holding the kernel-enforced flock means no live repo process
+            # is inside cpp_extension.load — so a leftover torch file
+            # baton (existence-polled, left by a SIGKILLed builder) is
+            # stale and would make load() wait forever. Clear it.
+            baton = os.path.join(build_dir, "lock")
+            if os.path.exists(baton):
+                os.unlink(baton)
             _mod = cpp_extension.load(
                 name="hvd_torch_ops", sources=[src],
                 build_directory=build_dir,
