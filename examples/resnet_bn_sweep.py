@@ -40,6 +40,10 @@ VARIANTS = [
     {"name": "b256", "env": {"HVD_BENCH_BATCH": "256"}},
     {"name": "b64", "env": {"HVD_BENCH_BATCH": "64"}},
     {"name": "pallas_norm", "env": {"HVD_BENCH_NORM": "pallas"}},
+    # bf16 partial stats accumulation + f32 finalization — the VERDICT
+    # r4 weak #3 / r5 weak #1 lever (halves the bytes the BN stats
+    # reductions re-read).
+    {"name": "bn_bf16_stats", "env": {"HVD_BENCH_NORM": "bf16stats"}},
     {"name": "classic_stem", "env": {"HVD_BENCH_STEM": "classic"}},
     # Bigger scoped VMEM: lets the scheduler keep conv outputs resident
     # for the stats re-read instead of round-tripping HBM.

@@ -140,6 +140,8 @@ start_timeline = _basics.start_timeline
 stop_timeline = _basics.stop_timeline
 cache_stats = _basics.cache_stats
 autotune_state = _basics.autotune_state
+zerocopy_stats = _basics.zerocopy_stats
+zerocopy_state = _basics.zerocopy_state
 peer_tx_bytes = _basics.peer_tx_bytes
 op_backends = _basics.op_backends
 backend_uses = _basics.backend_uses
@@ -163,6 +165,7 @@ def tpu_built():
         return False
 
 
+from .ops import zerocopy as bridge  # noqa: E402  (hvd.bridge.stats / as_buffer)
 from . import elastic  # noqa: F401,E402  (hvd.elastic.run / State / ObjectState)
 from . import profiler  # noqa: F401,E402  (xplane trace windows + op ranges)
 from . import observability  # noqa: F401,E402  (metrics / stall / spans)

@@ -163,6 +163,10 @@ _lib.hvd_backend_uses.restype = c_int64
 _lib.hvd_backend_uses.argtypes = [c_char_p]
 _lib.hvd_autotune_state.restype = c_int
 _lib.hvd_autotune_state.argtypes = [P_int64, ctypes.POINTER(c_double)]
+_lib.hvd_zerocopy_stats.restype = c_int
+_lib.hvd_zerocopy_stats.argtypes = [P_int64, P_int64, P_int64, P_int64]
+_lib.hvd_zerocopy_state.restype = c_int
+_lib.hvd_zerocopy_state.argtypes = [P_int64]
 _lib.hvd_peer_tx_bytes.restype = c_int64
 _lib.hvd_peer_tx_bytes.argtypes = [ctypes.c_int]
 
@@ -281,6 +285,34 @@ class HorovodBasics:
             raise ValueError("horovod_tpu has not been initialized")
         status = {0: "off", 1: "searching", 2: "locked"}[rc]
         return status, fusion.value, cycle.value
+
+    def zerocopy_stats(self):
+        """(zerocopy_ops, zerocopy_bytes, staging_ops, staging_bytes) for
+        the host data plane. zerocopy_* counts fused/unfused allreduces
+        executed by the scatter-gather ring straight over user buffers;
+        staging_* counts ops routed through the fusion-buffer staging path
+        and the bytes actually memcpy'd there."""
+        zc_ops = c_int64(0)
+        zc_bytes = c_int64(0)
+        st_ops = c_int64(0)
+        st_bytes = c_int64(0)
+        rc = _lib.hvd_zerocopy_stats(
+            ctypes.byref(zc_ops), ctypes.byref(zc_bytes),
+            ctypes.byref(st_ops), ctypes.byref(st_bytes))
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        return zc_ops.value, zc_bytes.value, st_ops.value, st_bytes.value
+
+    def zerocopy_state(self):
+        """(enabled, threshold_bytes): whether the scatter-gather zero-copy
+        path is currently live (HVD_ZEROCOPY master switch AND the autotune
+        toggle) and the minimum payload that routes onto it
+        (HVD_ZEROCOPY_THRESHOLD)."""
+        threshold = c_int64(0)
+        rc = _lib.hvd_zerocopy_state(ctypes.byref(threshold))
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        return bool(rc), threshold.value
 
     def mpi_threads_supported(self):
         return bool(_lib.hvd_mpi_threads_supported())

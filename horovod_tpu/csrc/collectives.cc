@@ -1,8 +1,10 @@
 #include "collectives.h"
 
 #include <fcntl.h>
+#include <limits.h>
 #include <poll.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <string.h>
 #include <unistd.h>
 
@@ -42,6 +44,76 @@ void SetNonBlocking(int fd, bool on) {
     fcntl(fd, F_SETFL, flags | O_NONBLOCK);
   else
     fcntl(fd, F_SETFL, flags & ~O_NONBLOCK);
+}
+
+// Bytes remaining in an iovec list from index `i` onward.
+size_t IovBytes(const std::vector<iovec>& v, size_t i) {
+  size_t n = 0;
+  for (; i < v.size(); i++) n += v[i].iov_len;
+  return n;
+}
+
+// Consume k transferred bytes: advance past finished iovecs, bump the
+// partial one, and land *idx on the next non-empty entry.
+void IovAdvance(std::vector<iovec>& v, size_t* idx, size_t k) {
+  while (k > 0) {
+    iovec& io = v[*idx];
+    if (k >= io.iov_len) {
+      k -= io.iov_len;
+      io.iov_len = 0;
+      (*idx)++;
+    } else {
+      io.iov_base = (uint8_t*)io.iov_base + k;
+      io.iov_len -= k;
+      k = 0;
+    }
+  }
+  while (*idx < v.size() && v[*idx].iov_len == 0) (*idx)++;
+}
+
+// Append iovecs covering elements [first, first+count) of the segment list
+// (segments are laid end to end in list order, like the fusion buffer the
+// scatter-gather path replaces).
+void SliceIov(const std::vector<Segment>& segs, int64_t first, int64_t count,
+              size_t esz, std::vector<iovec>* out) {
+  int64_t pos = 0;
+  for (const auto& s : segs) {
+    if (count == 0) break;
+    int64_t seg_end = pos + s.elems;
+    if (seg_end > first) {
+      int64_t lo = std::max(first, pos);
+      int64_t take = std::min(count, seg_end - lo);
+      if (take > 0)
+        out->push_back({s.base + (size_t)(lo - pos) * esz,
+                        (size_t)take * esz});
+      first += take;
+      count -= take;
+    }
+    pos = seg_end;
+  }
+}
+
+// Walk parallel in/out segment lists (identical element layout) over
+// [first, first+count) elements, calling fn(out_ptr, in_ptr, n) for each
+// maximal run inside one segment.
+template <typename F>
+void ForEachSpan(const std::vector<Segment>& in,
+                 const std::vector<Segment>& out, int64_t first,
+                 int64_t count, size_t esz, F fn) {
+  int64_t pos = 0;
+  for (size_t i = 0; i < in.size() && count > 0; i++) {
+    int64_t seg_end = pos + in[i].elems;
+    if (seg_end > first) {
+      int64_t lo = std::max(first, pos);
+      int64_t take = std::min(count, seg_end - lo);
+      if (take > 0)
+        fn(out[i].base + (size_t)(lo - pos) * esz,
+           in[i].base + (size_t)(lo - pos) * esz, take);
+      first += take;
+      count -= take;
+    }
+    pos = seg_end;
+  }
 }
 
 }  // namespace
@@ -108,6 +180,82 @@ void DataPlane::FullDuplex(Socket& to, const void* sbuf, size_t sn,
   if (!same) SetNonBlocking(from.fd(), false);
 }
 
+void DataPlane::FullDuplexV(Socket& to, std::vector<iovec>& sv, Socket& from,
+                            std::vector<iovec>& rv) {
+  size_t si = 0, ri = 0;
+  while (si < sv.size() && sv[si].iov_len == 0) si++;
+  while (ri < rv.size() && rv[ri].iov_len == 0) ri++;
+  size_t sleft = IovBytes(sv, si);
+  size_t rleft = IovBytes(rv, ri);
+  bool same = to.fd() == from.fd();
+  SetNonBlocking(to.fd(), true);
+  if (!same) SetNonBlocking(from.fd(), true);
+  try {
+    while (sleft > 0 || rleft > 0) {
+      pollfd fds[2];
+      int nfds = 0;
+      if (same) {
+        fds[0] = {to.fd(), 0, 0};
+        if (sleft > 0) fds[0].events |= POLLOUT;
+        if (rleft > 0) fds[0].events |= POLLIN;
+        nfds = 1;
+      } else {
+        if (sleft > 0) fds[nfds++] = {to.fd(), POLLOUT, 0};
+        if (rleft > 0) fds[nfds++] = {from.fd(), POLLIN, 0};
+      }
+      int rc = ::poll(fds, nfds, poll_timeout_ms_);
+      if (rc < 0) {
+        if (errno == EINTR) continue;
+        throw std::runtime_error("poll failed");
+      }
+      if (rc == 0)
+        throw std::runtime_error(
+            "data-plane poll timeout (" +
+            std::to_string(poll_timeout_ms_ / 1000) +
+            "s with no bytes moved; HVD_DATA_TIMEOUT_SECONDS to tune)");
+      for (int i = 0; i < nfds; i++) {
+        if ((fds[i].revents & (POLLERR | POLLHUP | POLLNVAL)) &&
+            !(fds[i].revents & (POLLIN | POLLOUT)))
+          throw std::runtime_error("data-plane peer failed");
+        if ((fds[i].revents & POLLOUT) && sleft > 0) {
+          // sendmsg, not writev: MSG_NOSIGNAL keeps a dead peer an error
+          // return instead of a SIGPIPE, matching the byte path.
+          msghdr mh = {};
+          mh.msg_iov = &sv[si];
+          mh.msg_iovlen = std::min(sv.size() - si, (size_t)IOV_MAX);
+          ssize_t k = ::sendmsg(to.fd(), &mh, MSG_NOSIGNAL);
+          if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+              errno != EINTR)
+            throw std::runtime_error("data-plane send failed");
+          if (k > 0) {
+            IovAdvance(sv, &si, (size_t)k);
+            sleft -= (size_t)k;
+            to.note_tx((size_t)k);
+          }
+        }
+        if ((fds[i].revents & POLLIN) && rleft > 0) {
+          ssize_t k = ::readv(from.fd(), &rv[ri],
+                              (int)std::min(rv.size() - ri, (size_t)IOV_MAX));
+          if (k == 0) throw std::runtime_error("data-plane peer closed");
+          if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+              errno != EINTR)
+            throw std::runtime_error("data-plane recv failed");
+          if (k > 0) {
+            IovAdvance(rv, &ri, (size_t)k);
+            rleft -= (size_t)k;
+          }
+        }
+      }
+    }
+  } catch (...) {
+    SetNonBlocking(to.fd(), false);
+    if (!same) SetNonBlocking(from.fd(), false);
+    throw;
+  }
+  SetNonBlocking(to.fd(), false);
+  if (!same) SetNonBlocking(from.fd(), false);
+}
+
 void DataPlane::RingAllreduce(void* buf, int64_t nelem, DataType dtype,
                               ReduceOp op, const std::vector<int32_t>& members) {
   int m = (int)members.size();
@@ -137,6 +285,63 @@ void DataPlane::RingAllreduce(void* buf, int64_t nelem, DataType dtype,
     int rc = ((my - s) % m + m) % m;
     FullDuplex(next, p + off[sc] * esz, (size_t)lens[sc] * esz, prev,
                p + off[rc] * esz, (size_t)lens[rc] * esz);
+  }
+}
+
+void DataPlane::RingAllreduceSG(const std::vector<Segment>& in,
+                                const std::vector<Segment>& out,
+                                int64_t nelem, DataType dtype, ReduceOp op,
+                                const std::vector<int32_t>& members) {
+  int m = (int)members.size();
+  size_t esz = DataTypeSize(dtype);
+  if (nelem == 0) return;
+  if (m <= 1) {
+    // Reduction of a single contribution is the contribution itself.
+    for (size_t i = 0; i < in.size(); i++)
+      if (out[i].base != in[i].base && in[i].elems > 0)
+        memcpy(out[i].base, in[i].base, (size_t)in[i].elems * esz);
+    return;
+  }
+  int my = IndexOf(members, rank_);
+  Socket& next = peer(members[(my + 1) % m]);
+  Socket& prev = peer(members[(my - 1 + m) % m]);
+  auto lens = SplitChunks(nelem, m);
+  auto off = Offsets(lens);
+  int64_t max_len = *std::max_element(lens.begin(), lens.end());
+  std::vector<uint8_t> tmp((size_t)max_len * esz);
+  std::vector<iovec> sv, rv;
+
+  // Phase 1: reduce-scatter. Each chunk is RS-touched exactly once per
+  // rank (rc walks my-1, my-2, ... — never my), so the reduction of the
+  // received scratch with the INPUT chunk lands directly in the OUTPUT
+  // chunk (three-address first touch: no input->output bulk copy). Step 0
+  // therefore sends untouched input; later steps send the partials already
+  // reduced into the output segments.
+  for (int s = 0; s < m - 1; s++) {
+    int sc = ((my - s) % m + m) % m;
+    int rc = ((my - s - 1) % m + m) % m;
+    sv.clear();
+    rv.clear();
+    SliceIov(s == 0 ? in : out, off[sc], lens[sc], esz, &sv);
+    rv.push_back({tmp.data(), (size_t)lens[rc] * esz});
+    FullDuplexV(next, sv, prev, rv);
+    const uint8_t* t = tmp.data();
+    ForEachSpan(in, out, off[rc], lens[rc], esz,
+                [&](uint8_t* o, const uint8_t* a, int64_t n) {
+                  AccumulateTo(o, a, t, n, dtype, op);
+                  t += (size_t)n * esz;
+                });
+  }
+  // Phase 2: allgather of completed chunks, wired directly between output
+  // segments on both sides (readv overwrites the stale RS partials).
+  for (int s = 0; s < m - 1; s++) {
+    int sc = ((my + 1 - s) % m + m) % m;
+    int rc = ((my - s) % m + m) % m;
+    sv.clear();
+    rv.clear();
+    SliceIov(out, off[sc], lens[sc], esz, &sv);
+    SliceIov(out, off[rc], lens[rc], esz, &rv);
+    FullDuplexV(next, sv, prev, rv);
   }
 }
 

@@ -9,12 +9,23 @@
 // through these host buffers.
 #pragma once
 
+#include <sys/uio.h>
+
 #include <vector>
 
 #include "common.h"
 #include "tcp.h"
 
 namespace hvd {
+
+// One tensor's buffer inside a fused response, viewed as a run of elements.
+// The scatter-gather ring operates on lists of these instead of a staged
+// contiguous fusion buffer: the per-tensor user buffers ARE the wire
+// buffers (writev/readv), so the staging memcpys disappear.
+struct Segment {
+  uint8_t* base;   // input views are const in spirit; never written
+  int64_t elems;
+};
 
 // Full-mesh data-plane connections. peer(r) is a connected socket to global
 // rank r (invalid for self). Only the background thread touches these, and
@@ -42,6 +53,20 @@ class DataPlane {
   // buf holds nelem elements of dtype; op applied elementwise.
   void RingAllreduce(void* buf, int64_t nelem, DataType dtype, ReduceOp op,
                      const std::vector<int32_t>& members);
+
+  // Scatter-gather ring allreduce (zero staging copies): the same ring
+  // algorithm as RingAllreduce, but running directly over the per-tensor
+  // segments of a fused response. `in` and `out` must have identical
+  // element counts segment-by-segment (out[i] may alias in[i] for in-place
+  // reduction). Reduce-scatter reads first-touch data from the input
+  // segments and writes partial reductions into the output segments; the
+  // allgather phase sends/recvs output segments directly via writev/readv.
+  // Scratch is one ring chunk (nelem/m elements), not nelem — the only
+  // intermediate buffer on the whole path.
+  void RingAllreduceSG(const std::vector<Segment>& in,
+                       const std::vector<Segment>& out, int64_t nelem,
+                       DataType dtype, ReduceOp op,
+                       const std::vector<int32_t>& members);
 
   // Hierarchical allreduce (reference: NCCLHierarchicalAllreduce in
   // horovod/common/ops/nccl_operations.cc): local reduce-scatter inside each
@@ -86,6 +111,12 @@ class DataPlane {
   // pairwise exchanges.
   void FullDuplex(Socket& to, const void* sbuf, size_t sn, Socket& from,
                   void* rbuf, size_t rn);
+
+  // Vectorized full duplex: gather-send the iovec list `sv` while
+  // scatter-receiving into `rv`, poll-driven like FullDuplex. The lists are
+  // consumed in place (bases/lengths advance as bytes move).
+  void FullDuplexV(Socket& to, std::vector<iovec>& sv, Socket& from,
+                   std::vector<iovec>& rv);
 
  private:
   int rank_ = 0;

@@ -355,6 +355,7 @@ struct ResponseList {
   // 0/1 = every rank flips the feature on this cycle.
   int8_t tuned_cache = -1;
   int8_t tuned_hier = -1;
+  int8_t tuned_zerocopy = -1;  // scatter-gather allreduce toggle
   bool tuned_locked = false;  // coordinator's search finished
 
   void serialize(Writer& w) const {
@@ -368,6 +369,7 @@ struct ResponseList {
     w.f64(tuned_cycle_ms);
     w.u8((uint8_t)(tuned_cache + 1));  // -1..1 -> 0..2
     w.u8((uint8_t)(tuned_hier + 1));
+    w.u8((uint8_t)(tuned_zerocopy + 1));
     w.u8(tuned_locked ? 1 : 0);
   }
   static ResponseList deserialize(Reader& r) {
@@ -384,6 +386,7 @@ struct ResponseList {
     l.tuned_cycle_ms = r.f64();
     l.tuned_cache = (int8_t)r.u8() - 1;
     l.tuned_hier = (int8_t)r.u8() - 1;
+    l.tuned_zerocopy = (int8_t)r.u8() - 1;
     l.tuned_locked = r.u8() != 0;
     return l;
   }

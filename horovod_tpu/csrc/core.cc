@@ -125,6 +125,24 @@ struct Global {
   int64_t fusion_threshold = 64 * 1024 * 1024;
   double cycle_time_ms = 1.0;
 
+  // Zero-copy (scatter-gather) allreduce path: responses at or above
+  // zerocopy_threshold bytes ride writev/readv directly over the
+  // per-tensor user buffers instead of staging through fusion_buf.
+  // zerocopy_on is autotune's toggle arm (rides ResponseList like the
+  // cache/hier toggles); HVD_ZEROCOPY=0 disables the path entirely.
+  int64_t zerocopy_threshold = 4 * 1024 * 1024;
+  bool zerocopy_on = true;
+  bool zerocopy_allowed = true;  // HVD_ZEROCOPY master switch
+  // Counters, readable from user threads via hvd_zerocopy_stats: ops/bytes
+  // that took the scatter-gather path vs ops/bytes memcpy'd through the
+  // staged path (fusion-buffer in+out copies and unfused input->output
+  // copies). The zero-copy acceptance tests assert staging_bytes stays
+  // flat while large allreduces run.
+  std::atomic<int64_t> zerocopy_ops_total{0};
+  std::atomic<int64_t> zerocopy_bytes_total{0};
+  std::atomic<int64_t> staging_ops_total{0};
+  std::atomic<int64_t> staging_bytes_total{0};
+
   std::thread background;
 
   std::mutex handle_mu;
@@ -239,9 +257,21 @@ void RingKernel(void* buf, int64_t n, const Response& resp,
   g->data.RingAllreduce(buf, n, resp.dtype, RingOpOf(resp), members);
 }
 
+// The scatter-gather path only applies to the plain ring (adasum and the
+// hierarchical composition run multi-phase algorithms over a contiguous
+// scratch buffer), needs a real ring (m > 1), untouched inputs (prescale
+// would have to mutate const user memory), and a payload at or above the
+// threshold — small responses lose more to per-chunk iovec setup than the
+// staging memcpy costs.
+bool UseZeroCopy(bool sg_ok, int64_t bytes, const Response& resp, int m) {
+  return sg_ok && g->zerocopy_allowed && g->zerocopy_on && m > 1 &&
+         resp.prescale == 1.0 && bytes >= g->zerocopy_threshold;
+}
+
 void ExecAllreduce(const Response& resp,
                    std::vector<TensorTableEntry>& entries,
-                   const std::vector<int32_t>& members, ReduceKernel kernel) {
+                   const std::vector<int32_t>& members, ReduceKernel kernel,
+                   bool sg_ok) {
   int m = (int)members.size();
   size_t esz = DataTypeSize(resp.dtype);
   double post = EffectivePostscale(resp, m);
@@ -250,7 +280,26 @@ void ExecAllreduce(const Response& resp,
     // Unfused fast path: operate in place on the user's output buffer.
     auto& e = entries[0];
     int64_t n = NumElements(e.req.shape);
-    if (e.output != e.input) memcpy(e.output, e.input, (size_t)n * esz);
+    if (UseZeroCopy(sg_ok, n * (int64_t)esz, resp, m)) {
+      // Scatter-gather: the ring reads the input and writes the output
+      // directly — even the input->output priming copy disappears.
+      std::vector<Segment> in{{(uint8_t*)e.input, n}};
+      std::vector<Segment> out{{(uint8_t*)e.output, n}};
+      int64_t t0 = NowUs();
+      g->data.RingAllreduceSG(in, out, n, resp.dtype, RingOpOf(resp),
+                              members);
+      g->timeline.Record(e.req.name, "TCP_ALLREDUCE_SG", t0, NowUs());
+      if (post != 1.0) ScaleBuffer(e.output, n, resp.dtype, post);
+      g->zerocopy_ops_total++;
+      g->zerocopy_bytes_total += n * (int64_t)esz;
+      CompleteHandle(e.handle, Status::Ok());
+      return;
+    }
+    if (e.output != e.input) {
+      memcpy(e.output, e.input, (size_t)n * esz);
+      g->staging_bytes_total += n * (int64_t)esz;
+    }
+    g->staging_ops_total++;
     if (resp.prescale != 1.0) ScaleBuffer(e.output, n, resp.dtype, resp.prescale);
     int64_t t0 = NowUs();
     kernel(e.output, n, resp, members);
@@ -267,17 +316,53 @@ void ExecAllreduce(const Response& resp,
   for (auto& e : entries) mine[e.req.name] = &e;
   int64_t total = 0;
   for (auto& s : resp.shapes) total += NumElements(s);
+
+  // Fused scatter-gather: every name must be ours (a joined rank's
+  // zero-filled stand-in has no user buffer to wire an iovec to).
+  if (mine.size() == resp.names.size() &&
+      UseZeroCopy(sg_ok, total * (int64_t)esz, resp, m)) {
+    std::vector<Segment> in, out;
+    in.reserve(resp.names.size());
+    out.reserve(resp.names.size());
+    for (size_t i = 0; i < resp.names.size(); i++) {
+      auto& e = *mine.at(resp.names[i]);
+      int64_t n = NumElements(resp.shapes[i]);
+      in.push_back({(uint8_t*)e.input, n});
+      out.push_back({(uint8_t*)e.output, n});
+    }
+    int64_t t0 = NowUs();
+    g->data.RingAllreduceSG(in, out, total, resp.dtype, RingOpOf(resp),
+                            members);
+    int64_t t1 = NowUs();
+    // Counters bump BEFORE any CompleteHandle: the caller may read
+    // zerocopy_stats() the instant its op resolves, and the unfused path
+    // already orders it this way.
+    g->zerocopy_ops_total++;
+    g->zerocopy_bytes_total += total * (int64_t)esz;
+    for (size_t i = 0; i < resp.names.size(); i++) {
+      auto& e = *mine.at(resp.names[i]);
+      if (post != 1.0)
+        ScaleBuffer(e.output, NumElements(resp.shapes[i]), resp.dtype, post);
+      g->timeline.Record(e.req.name, "TCP_ALLREDUCE_SG", t0, t1);
+      CompleteHandle(e.handle, Status::Ok());
+    }
+    return;
+  }
+
   EnsureFusionCapacity(total * (int64_t)esz);
   uint8_t* fb = g->fusion_buf.data();
   int64_t t0 = NowUs();
   int64_t off = 0;
+  int64_t staged = 0;
   for (size_t i = 0; i < resp.names.size(); i++) {
     int64_t n = NumElements(resp.shapes[i]);
     auto it = mine.find(resp.names[i]);
-    if (it != mine.end())
+    if (it != mine.end()) {
       memcpy(fb + off * esz, it->second->input, (size_t)n * esz);
-    else
+      staged += n * (int64_t)esz;
+    } else {
       memset(fb + off * esz, 0, (size_t)n * esz);
+    }
     off += n;
   }
   int64_t t1 = NowUs();
@@ -292,12 +377,21 @@ void ExecAllreduce(const Response& resp,
     if (it != mine.end()) {
       auto& e = *it->second;
       memcpy(e.output, fb + off * esz, (size_t)n * esz);
+      staged += n * (int64_t)esz;
       g->timeline.Record(e.req.name, "MEMCPY_IN_FUSION_BUFFER", t0, t1);
       g->timeline.Record(e.req.name, "TCP_ALLREDUCE", t1, t2);
       g->timeline.Record(e.req.name, "MEMCPY_OUT_FUSION_BUFFER", t2, NowUs());
-      CompleteHandle(e.handle, Status::Ok());
     }
     off += n;
+  }
+  // Same ordering rule as the SG branch: counters before CompleteHandle,
+  // so a caller polling staging counters right after its op resolves
+  // never sees the op uncounted.
+  g->staging_ops_total++;
+  g->staging_bytes_total += staged;
+  for (size_t i = 0; i < resp.names.size(); i++) {
+    auto it = mine.find(resp.names[i]);
+    if (it != mine.end()) CompleteHandle(it->second->handle, Status::Ok());
   }
 }
 
@@ -426,7 +520,7 @@ void RegisterBackends(OperationManager& om) {
       },
       [](const Response& r, std::vector<TensorTableEntry>& e,
          const std::vector<int32_t>& m) {
-        ExecAllreduce(r, e, m, AdasumKernel);
+        ExecAllreduce(r, e, m, AdasumKernel, /*sg_ok=*/false);
       });
   om.Register(
       OpType::kAllreduce, "hierarchical_allreduce",
@@ -435,13 +529,13 @@ void RegisterBackends(OperationManager& om) {
       },
       [](const Response& r, std::vector<TensorTableEntry>& e,
          const std::vector<int32_t>& m) {
-        ExecAllreduce(r, e, m, HierarchicalKernel);
+        ExecAllreduce(r, e, m, HierarchicalKernel, /*sg_ok=*/false);
       });
   om.Register(
       OpType::kAllreduce, "ring_allreduce", nullptr,
       [](const Response& r, std::vector<TensorTableEntry>& e,
          const std::vector<int32_t>& m) {
-        ExecAllreduce(r, e, m, RingKernel);
+        ExecAllreduce(r, e, m, RingKernel, /*sg_ok=*/true);
       });
   om.Register(
       OpType::kAllgather, "ring_allgatherv", nullptr,
@@ -647,13 +741,14 @@ void AutotuneCycle(ResponseList& rl) {
   if (g->autotune.active()) {
     int64_t fusion;
     double cycle_ms;
-    int cache_on, hier_on;
+    int cache_on, hier_on, zerocopy_on;
     if (g->autotune.Record(PayloadBytes(rl), NowUs(), &fusion, &cycle_ms,
-                           &cache_on, &hier_on)) {
+                           &cache_on, &hier_on, &zerocopy_on)) {
       rl.tuned_fusion = fusion;
       rl.tuned_cycle_ms = cycle_ms;
       rl.tuned_cache = (int8_t)cache_on;
       rl.tuned_hier = (int8_t)hier_on;
+      rl.tuned_zerocopy = (int8_t)zerocopy_on;
     }
   }
   rl.tuned_locked = !g->autotune.active();
@@ -668,6 +763,11 @@ void ProcessResponseList(ResponseList& rl) {
   }
   if (rl.tuned_cycle_ms > 0) g->cycle_time_ms = rl.tuned_cycle_ms;
   if (rl.tuned_hier >= 0) g->hierarchical = rl.tuned_hier != 0;
+  // The zero-copy toggle is stateless (no replica/drain concerns like the
+  // cache): adopt up front so this cycle's responses already use it,
+  // identically on every rank.
+  if (rl.tuned_zerocopy >= 0 && g->zerocopy_allowed)
+    g->zerocopy_on = rl.tuned_zerocopy != 0;
   if (rl.tuned_locked && g->autotune.enabled()) g->autotune.SetDone();
   if (CacheOn()) {
     for (uint32_t b : rl.evict_bits) {
@@ -1133,6 +1233,13 @@ int hvd_init() {
     // covers identical suffixes.
     g->cycle_time_ms = EnvDouble("HVD_CYCLE_TIME_MS",
                                  EnvDouble("HOROVOD_CYCLE_TIME", 1.0));
+    // Zero-copy allreduce: HVD_ZEROCOPY=0 kills the path outright;
+    // HVD_ZEROCOPY_THRESHOLD (bytes) sets where scatter-gather takes over
+    // from fusion-buffer staging (0 = every eligible response).
+    g->zerocopy_allowed = EnvInt("HVD_ZEROCOPY", 1) != 0;
+    g->zerocopy_on = g->zerocopy_allowed;
+    g->zerocopy_threshold =
+        EnvInt("HVD_ZEROCOPY_THRESHOLD", 4 * 1024 * 1024);
     g->process_sets.InitGlobal(g->size);
     RegisterBackends(g->ops);
     g->cache.Configure(EnvInt("HVD_CACHE_CAPACITY", 1024));
@@ -1152,9 +1259,10 @@ int hvd_init() {
         g->fusion_threshold, g->cycle_time_ms,
         EnvInt("HVD_AUTOTUNE_CYCLES_PER_SAMPLE", 20),
         EnvInt("HVD_AUTOTUNE_MAX_SAMPLES", 30),
-        g->cache.enabled(), g->hierarchical,
+        g->cache.enabled(), g->hierarchical, g->zerocopy_on,
         /*can_toggle_cache=*/g->cache.enabled(),
-        /*can_toggle_hier=*/g->hier_ok && g->size > 1);
+        /*can_toggle_hier=*/g->hier_ok && g->size > 1,
+        /*can_toggle_zerocopy=*/g->zerocopy_allowed && g->size > 1);
     g->data.set_timeout_ms(
         (int)(EnvDouble("HVD_DATA_TIMEOUT_SECONDS", 300.0) * 1000.0));
     LogF(LogLevel::kInfo,
@@ -1485,6 +1593,29 @@ int64_t hvd_peer_tx_bytes(int rank) {
   if (rank < 0 || rank >= g->size || rank == g->rank) return 0;
   Socket& s = g->data.peer(rank);
   return s.valid() ? (int64_t)s.tx_bytes() : 0;
+}
+
+// Zero-copy data-path observability: ops/bytes that rode the
+// scatter-gather ring vs ops/bytes memcpy'd through the staged path. The
+// acceptance tests assert staging_bytes stays flat while large allreduces
+// run above HVD_ZEROCOPY_THRESHOLD.
+int hvd_zerocopy_stats(int64_t* zc_ops, int64_t* zc_bytes,
+                       int64_t* staged_ops, int64_t* staged_bytes) {
+  if (!g || !g->initialized) return -1;
+  if (zc_ops) *zc_ops = g->zerocopy_ops_total.load();
+  if (zc_bytes) *zc_bytes = g->zerocopy_bytes_total.load();
+  if (staged_ops) *staged_ops = g->staging_ops_total.load();
+  if (staged_bytes) *staged_bytes = g->staging_bytes_total.load();
+  return 0;
+}
+
+// Current zero-copy configuration: returns -1 uninitialized, 0 off
+// (HVD_ZEROCOPY=0 or autotune toggled it off), 1 on; *threshold gets the
+// live byte threshold.
+int hvd_zerocopy_state(int64_t* threshold) {
+  if (!g || !g->initialized) return -1;
+  if (threshold) *threshold = g->zerocopy_threshold;
+  return g->zerocopy_allowed && g->zerocopy_on ? 1 : 0;
 }
 
 int hvd_mpi_threads_supported() { return 0; }

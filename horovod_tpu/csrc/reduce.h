@@ -126,6 +126,85 @@ inline void Accumulate16(uint16_t* dst, const uint16_t* src, int64_t n,
   }
 }
 
+// --- three-address accumulate: dst = a OP b, n elements --------------------
+// The scatter-gather ring's first touch of an output chunk: the reduction of
+// the (const, user-owned) input chunk with the received scratch lands
+// directly in the output segment, so no input->output bulk copy ever runs.
+template <typename T>
+inline void AccumulateToTyped(T* dst, const T* a, const T* b, int64_t n,
+                              ReduceOp op) {
+  switch (op) {
+    case ReduceOp::kSum:
+    case ReduceOp::kAverage:  // averaged via postscale
+    case ReduceOp::kAdasum:   // adasum host math handled separately
+      for (int64_t i = 0; i < n; i++) dst[i] = (T)(a[i] + b[i]);
+      break;
+    case ReduceOp::kMin:
+      for (int64_t i = 0; i < n; i++) dst[i] = b[i] < a[i] ? b[i] : a[i];
+      break;
+    case ReduceOp::kMax:
+      for (int64_t i = 0; i < n; i++) dst[i] = b[i] > a[i] ? b[i] : a[i];
+      break;
+    case ReduceOp::kProduct:
+      for (int64_t i = 0; i < n; i++) dst[i] = (T)(a[i] * b[i]);
+      break;
+  }
+}
+
+template <float (*ToF)(uint16_t), uint16_t (*FromF)(float)>
+inline void AccumulateTo16(uint16_t* dst, const uint16_t* a,
+                           const uint16_t* b, int64_t n, ReduceOp op) {
+  for (int64_t i = 0; i < n; i++) {
+    float x = ToF(a[i]), y = ToF(b[i]), r;
+    switch (op) {
+      case ReduceOp::kMin: r = y < x ? y : x; break;
+      case ReduceOp::kMax: r = y > x ? y : x; break;
+      case ReduceOp::kProduct: r = x * y; break;
+      default: r = x + y; break;
+    }
+    dst[i] = FromF(r);
+  }
+}
+
+// dst = a OP b over raw buffers of `n` elements of `dtype` (dst may alias a).
+inline void AccumulateTo(void* dst, const void* a, const void* b, int64_t n,
+                         DataType dtype, ReduceOp op) {
+  switch (dtype) {
+    case DataType::kUInt8:
+    case DataType::kBool:
+      AccumulateToTyped((uint8_t*)dst, (const uint8_t*)a, (const uint8_t*)b,
+                        n, op);
+      break;
+    case DataType::kInt8:
+      AccumulateToTyped((int8_t*)dst, (const int8_t*)a, (const int8_t*)b, n,
+                        op);
+      break;
+    case DataType::kInt32:
+      AccumulateToTyped((int32_t*)dst, (const int32_t*)a, (const int32_t*)b,
+                        n, op);
+      break;
+    case DataType::kInt64:
+      AccumulateToTyped((int64_t*)dst, (const int64_t*)a, (const int64_t*)b,
+                        n, op);
+      break;
+    case DataType::kFloat32:
+      AccumulateToTyped((float*)dst, (const float*)a, (const float*)b, n, op);
+      break;
+    case DataType::kFloat64:
+      AccumulateToTyped((double*)dst, (const double*)a, (const double*)b, n,
+                        op);
+      break;
+    case DataType::kFloat16:
+      AccumulateTo16<half_to_float, float_to_half>(
+          (uint16_t*)dst, (const uint16_t*)a, (const uint16_t*)b, n, op);
+      break;
+    case DataType::kBFloat16:
+      AccumulateTo16<bf16_to_float, float_to_bf16>(
+          (uint16_t*)dst, (const uint16_t*)a, (const uint16_t*)b, n, op);
+      break;
+  }
+}
+
 // dst = dst OP src over raw buffers of `n` elements of `dtype`.
 inline void Accumulate(void* dst, const void* src, int64_t n, DataType dtype,
                        ReduceOp op) {

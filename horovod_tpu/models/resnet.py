@@ -61,6 +61,61 @@ class PallasBatchNorm(nn.Module):
         return y
 
 
+class Bf16StatsBatchNorm(nn.Module):
+    """BatchNorm whose train-mode batch statistics are ACCUMULATED in
+    bfloat16 and finalized in float32 — the VERDICT r5 weak-#1 lever.
+
+    PERF.md round 4: the BN stats traffic (convert_reduce_fusion,
+    ~9.2 GB/step) dominates the ResNet step, and half of those bytes are
+    the f32 upcast of bf16 activations feeding the reductions. Here the
+    partial sums (mean and raw second moment) accumulate in bf16 — the
+    reduction reads the activations at their native width — and only the
+    finalization (moment combine, momentum update, rsqrt, affine) runs
+    in f32. Running stats and parameters stay f32, so eval-mode behavior
+    and the variable structure match nn.BatchNorm exactly."""
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scale_init: Any = nn.initializers.ones
+
+    @nn.compact
+    def __call__(self, x):
+        C = x.shape[-1]
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros(C, jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones(C, jnp.float32))
+        scale = self.param("scale", self.scale_init, (C,), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, (C,),
+                          self.param_dtype)
+        if self.use_running_average:
+            inv = scale * jax.lax.rsqrt(ra_var.value + self.epsilon)
+            a = inv.astype(self.dtype)
+            b = (bias - ra_mean.value * inv).astype(self.dtype)
+            return x.astype(self.dtype) * a + b
+        xh = x.astype(jnp.bfloat16)
+        axes = tuple(range(x.ndim - 1))
+        # dtype= pins the reduction accumulator to bf16 (XLA would
+        # otherwise upcast — re-materializing exactly the traffic this
+        # variant exists to avoid); finalization is f32 from here on.
+        mean = jnp.mean(xh, axis=axes, dtype=jnp.bfloat16) \
+            .astype(jnp.float32)
+        mean2 = jnp.mean(jax.lax.square(xh), axis=axes,
+                         dtype=jnp.bfloat16).astype(jnp.float32)
+        var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+        if not self.is_initializing():
+            ra_mean.value = (self.momentum * ra_mean.value
+                             + (1 - self.momentum) * mean)
+            ra_var.value = (self.momentum * ra_var.value
+                            + (1 - self.momentum) * var)
+        inv = scale * jax.lax.rsqrt(var + self.epsilon)
+        a = inv.astype(self.dtype)
+        b = (bias - mean * inv).astype(self.dtype)
+        return x.astype(self.dtype) * a + b
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: int
@@ -101,14 +156,17 @@ class ResNet(nn.Module):
     stem: str = "classic"
     # "flax": nn.BatchNorm. "pallas": PallasBatchNorm — train-mode stats
     # reductions as one-pass pallas kernels (the step-time bottleneck, see
-    # PERF.md round 4).
+    # PERF.md round 4). "bf16stats": Bf16StatsBatchNorm — bf16 partial
+    # stats accumulation, f32 finalization (VERDICT r5 weak #1).
     norm: str = "flax"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
                        param_dtype=jnp.float32)
-        norm_cls = PallasBatchNorm if self.norm == "pallas" else nn.BatchNorm
+        norm_cls = {"pallas": PallasBatchNorm,
+                    "bf16stats": Bf16StatsBatchNorm}.get(self.norm,
+                                                         nn.BatchNorm)
         norm = partial(norm_cls, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype,
                        param_dtype=jnp.float32)

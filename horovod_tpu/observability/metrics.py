@@ -345,6 +345,16 @@ BRIDGE_TRACES = counter(
     "(trace-time count; per-step execution is counted by hvd_op_* "
     "when the callback runs)",
     ("op",))
+BRIDGE_BUFFERS = counter(
+    "hvd_bridge_buffers_total",
+    "Eager-bridge tensor adaptations by path ('zerocopy': a dlpack/"
+    "buffer-protocol view handed straight to the core; 'copy': fallback "
+    "staging copy) and fallback reason ('' for zerocopy)",
+    ("path", "reason"))
+BRIDGE_COPY_BYTES = counter(
+    "hvd_bridge_copy_bytes_total",
+    "Bytes actually memcpy'd by eager-bridge fallback copies (zero while "
+    "every input arrives contiguous with a matching dtype)")
 ELASTIC_EVENTS = counter(
     "hvd_elastic_events_total",
     "Elastic lifecycle events (failure / host_update / reset / "

@@ -23,6 +23,7 @@ except ImportError:  # pragma: no cover
 
 from ..basics import _lib, last_error
 from ..exceptions import HorovodInternalError
+from . import zerocopy as _zerocopy
 
 # ReduceOp values (must match csrc/common.h).
 Sum = 0
@@ -171,10 +172,12 @@ def _f32(x):
 
 def allreduce_async(tensor, op=Average, name=None, prescale_factor=1.0,
                     postscale_factor=1.0, process_set=0, _group=(-1, 0)):
-    # np.ascontiguousarray promotes 0-d to 1-d; hand the caller back a 0-d
-    # view of the same buffer so scalar leaves keep their shape.
+    # Scalar leaves stay 0-d for the caller; the core wants ndim >= 1, so
+    # reshape (a view — zero-copy survives) before enqueue.
     orig_shape = np.shape(tensor)
-    arr = np.ascontiguousarray(tensor)
+    arr, _ = _zerocopy.as_buffer(tensor)
+    if arr.ndim == 0:
+        arr = arr.reshape(1)
     out = np.empty_like(arr)
     name = _auto_name("allreduce", name)
     shape, ndim = _shape_arg(arr)
@@ -182,8 +185,11 @@ def allreduce_async(tensor, op=Average, name=None, prescale_factor=1.0,
         name.encode(), _ptr(arr), _ptr(out), shape, ndim, _dtype_code(arr),
         int(op), _f32(prescale_factor), _f32(postscale_factor),
         int(process_set), _group[0], _group[1]))
-    return _register(Handle(h, "allreduce", (arr,), out.reshape(orig_shape),
-                            arr.dtype, name))
+    # Pin BOTH the view and its source: a zero-copy `arr` aliases
+    # `tensor`'s memory, which the background thread reads until the
+    # collective completes.
+    return _register(Handle(h, "allreduce", (tensor, arr),
+                            out.reshape(orig_shape), arr.dtype, name))
 
 
 def allreduce(tensor, op=Average, name=None, prescale_factor=1.0,
@@ -234,7 +240,7 @@ def grouped_allreduce(tensors, op=Average, name=None, process_set=0,
 # Allgather
 
 def allgather_async(tensor, name=None, process_set=0, _group=(-1, 0)):
-    arr = np.ascontiguousarray(tensor)
+    arr, _ = _zerocopy.as_buffer(tensor)
     if arr.ndim == 0:
         arr = arr.reshape(1)
     name = _auto_name("allgather", name)
@@ -242,7 +248,8 @@ def allgather_async(tensor, name=None, process_set=0, _group=(-1, 0)):
     h = _check_handle(_lib.hvd_allgather_async(
         name.encode(), _ptr(arr), shape, ndim, _dtype_code(arr),
         int(process_set), _group[0], _group[1]))
-    return _register(Handle(h, "allgather", (arr,), None, arr.dtype, name))
+    return _register(Handle(h, "allgather", (tensor, arr), None, arr.dtype,
+                            name))
 
 
 def allgather(tensor, name=None, process_set=0):
@@ -268,15 +275,17 @@ def grouped_allgather(tensors, name=None, process_set=0):
 
 def broadcast_async(tensor, root_rank, name=None, process_set=0):
     orig_shape = np.shape(tensor)  # keep 0-d leaves 0-d (see allreduce)
-    arr = np.ascontiguousarray(tensor)
+    arr, _ = _zerocopy.as_buffer(tensor)
+    if arr.ndim == 0:
+        arr = arr.reshape(1)
     out = arr.copy()
     name = _auto_name("broadcast", name)
     shape, ndim = _shape_arg(arr)
     h = _check_handle(_lib.hvd_broadcast_async(
         name.encode(), _ptr(arr), _ptr(out), shape, ndim, _dtype_code(arr),
         int(root_rank), int(process_set)))
-    return _register(Handle(h, "broadcast", (arr,), out.reshape(orig_shape),
-                            arr.dtype, name))
+    return _register(Handle(h, "broadcast", (tensor, arr),
+                            out.reshape(orig_shape), arr.dtype, name))
 
 
 def broadcast(tensor, root_rank, name=None, process_set=0):
@@ -370,7 +379,7 @@ def broadcast_object(obj, root_rank=0, name=None, process_set=0):
 # Alltoall
 
 def alltoall_async(tensor, splits=None, name=None, process_set=0):
-    arr = np.ascontiguousarray(tensor)
+    arr, _ = _zerocopy.as_buffer(tensor)
     if arr.ndim == 0:
         raise ValueError("alltoall requires a tensor with at least 1 dim")
     psize = _lib.hvd_process_set_size(int(process_set))
@@ -388,7 +397,8 @@ def alltoall_async(tensor, splits=None, name=None, process_set=0):
     h = _check_handle(_lib.hvd_alltoall_async(
         name.encode(), _ptr(arr), shape, ndim, _dtype_code(arr), c_splits,
         len(splits_arr), int(process_set)))
-    return _register(Handle(h, "alltoall", (arr,), None, arr.dtype, name))
+    return _register(Handle(h, "alltoall", (tensor, arr), None, arr.dtype,
+                            name))
 
 
 def alltoall(tensor, splits=None, name=None, process_set=0):
@@ -404,7 +414,7 @@ def alltoall(tensor, splits=None, name=None, process_set=0):
 
 def reducescatter_async(tensor, op=Average, name=None, prescale_factor=1.0,
                         postscale_factor=1.0, process_set=0, _group=(-1, 0)):
-    arr = np.ascontiguousarray(tensor)
+    arr, _ = _zerocopy.as_buffer(tensor)
     if arr.ndim == 0:
         raise ValueError("reducescatter requires a tensor with at least 1 dim")
     name = _auto_name("reducescatter", name)
@@ -413,7 +423,8 @@ def reducescatter_async(tensor, op=Average, name=None, prescale_factor=1.0,
         name.encode(), _ptr(arr), shape, ndim, _dtype_code(arr), int(op),
         _f32(prescale_factor), _f32(postscale_factor), int(process_set),
         _group[0], _group[1]))
-    return _register(Handle(h, "reducescatter", (arr,), None, arr.dtype, name))
+    return _register(Handle(h, "reducescatter", (tensor, arr), None,
+                            arr.dtype, name))
 
 
 def reducescatter(tensor, op=Average, name=None, prescale_factor=1.0,

@@ -32,6 +32,8 @@ ARG_TO_ENV = {
                             lambda v: str(int(float(v) * _MB))),
     "cycle_time_ms": ("HVD_CYCLE_TIME_MS", str),
     "cache_capacity": ("HVD_CACHE_CAPACITY", str),
+    "zerocopy_threshold_mb": ("HVD_ZEROCOPY_THRESHOLD",
+                              lambda v: str(int(float(v) * _MB))),
     "timeline_filename": ("HVD_TIMELINE", str),
     "timeline_mark_cycles": ("HVD_TIMELINE_MARK_CYCLES",
                              lambda v: "1" if v else "0"),
@@ -53,7 +55,8 @@ ARG_TO_ENV = {
 _FILE_SECTIONS = {
     "params": {"fusion-threshold-mb": "fusion_threshold_mb",
                "cycle-time-ms": "cycle_time_ms",
-               "cache-capacity": "cache_capacity"},
+               "cache-capacity": "cache_capacity",
+               "zerocopy-threshold-mb": "zerocopy_threshold_mb"},
     "timeline": {"filename": "timeline_filename",
                  "mark-cycles": "timeline_mark_cycles"},
     "stall-check": {"warning-time-seconds":

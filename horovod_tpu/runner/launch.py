@@ -53,6 +53,10 @@ def parse_args(argv=None):
                    default=None)
     p.add_argument("--cache-capacity", dest="cache_capacity", type=int,
                    default=None)
+    p.add_argument("--zerocopy-threshold-mb", dest="zerocopy_threshold_mb",
+                   type=float, default=None,
+                   help="min payload MB routed onto the scatter-gather "
+                        "zero-copy ring (HVD_ZEROCOPY_THRESHOLD)")
     p.add_argument("--timeline-filename", dest="timeline_filename")
     p.add_argument("--timeline-mark-cycles", dest="timeline_mark_cycles",
                    action="store_true", default=None)

@@ -76,14 +76,15 @@ def _tf():
 
 
 def _run_op(np_fn, x, out_dtype=None):
-    """Run a core collective on a tf value: eager → direct numpy path;
-    traced (tf.function) → tf.py_function."""
+    """Run a core collective on a tf value: eager → direct path (the
+    EagerTensor goes straight to the core, which bridges it zero-copy via
+    dlpack / buffer protocol — ops.zerocopy — instead of a .numpy()
+    staging copy); traced (tf.function) → tf.py_function."""
     tf = _tf()
     t = tf.convert_to_tensor(x)
     if tf.executing_eagerly():
-        return tf.convert_to_tensor(np_fn(t.numpy()))
-    return tf.py_function(lambda a: np_fn(a.numpy()), [t],
-                          out_dtype or t.dtype)
+        return tf.convert_to_tensor(np_fn(t))
+    return tf.py_function(np_fn, [t], out_dtype or t.dtype)
 
 
 def _native_for(dtype, with_bool=False):
@@ -113,7 +114,7 @@ def allreduce(tensor, op=Average, name=None, process_set=0,
     def fn(a):
         ctx = None
         if compression is not None:
-            a, ctx = compression.compress(a)
+            a, ctx = compression.compress(np.asarray(a))
         out = _core.allreduce(a, op=op, name=name,
                               prescale_factor=prescale_factor,
                               postscale_factor=postscale_factor,
@@ -149,12 +150,12 @@ def grouped_allreduce(tensors, op=Average, name=None, process_set=0):
     tf = _tf()
     arrs = [tf.convert_to_tensor(t) for t in tensors]
     if tf.executing_eagerly():
-        outs = _core.grouped_allreduce([a.numpy() for a in arrs], op=op,
+        outs = _core.grouped_allreduce(list(arrs), op=op,
                                        name=name, process_set=process_set)
         return [tf.convert_to_tensor(o) for o in outs]
 
     def fn(*as_):
-        return _core.grouped_allreduce([a.numpy() for a in as_], op=op,
+        return _core.grouped_allreduce(list(as_), op=op,
                                        name=name, process_set=process_set)
 
     return tf.py_function(fn, arrs, [a.dtype for a in arrs])
@@ -258,10 +259,9 @@ def alltoall(tensor, splits=None, name=None, process_set=0):
         return out, np.zeros(0, np.int64)
 
     if tf.executing_eagerly():
-        data, rs = np_fn(t.numpy())
+        data, rs = np_fn(t)
     else:
-        data, rs = tf.py_function(lambda a: np_fn(a.numpy()), [t],
-                                  [t.dtype, tf.int64])
+        data, rs = tf.py_function(np_fn, [t], [t.dtype, tf.int64])
     if splits is not None:
         return tf.convert_to_tensor(data), tf.convert_to_tensor(rs)
     return tf.convert_to_tensor(data)

@@ -59,7 +59,15 @@ cross_size = _basics.cross_size
 
 
 def _to_numpy(t):
-    return t.detach().cpu().numpy()
+    """Host view of `t` for the numpy bridge. detach() drops autograd and
+    cpu() is a no-op for CPU tensors, so for the common case the zero-copy
+    bridge (ops.zerocopy: dlpack first, then torch's sharing __array__)
+    hands back a VIEW of the tensor's own storage; non-contiguous or
+    numpy-unrepresentable layouts fall back to a counted copy."""
+    from ..ops import zerocopy as _zerocopy
+
+    arr, _ = _zerocopy.as_buffer(t.detach().cpu())
+    return arr
 
 
 def _from_numpy(a, like):

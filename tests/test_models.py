@@ -188,16 +188,45 @@ def test_pallas_norm_matches_reference():
                                    rtol=2e-3, atol=2e-3, err_msg=f"d{n}")
 
 
-def test_resnet_pallas_norm_trains():
-    """ResNet(norm='pallas') runs a training step end-to-end (interpret
-    mode on CPU) and produces finite loss + updated batch stats."""
+def test_bf16stats_norm_matches_flax_bn():
+    """Bf16StatsBatchNorm (bf16 partial stats accumulation, f32
+    finalization — the VERDICT r5 weak-#1 bench variant): identical
+    variable structure to nn.BatchNorm, train-mode output within bf16
+    rounding of the f32-stats reference, running stats updated."""
+    import flax.linen as nn
+
+    from horovod_tpu.models import resnet
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((4, 8, 8, 16)), jnp.float32)
+    kw = dict(use_running_average=False, momentum=0.9, epsilon=1e-5,
+              dtype=jnp.bfloat16, param_dtype=jnp.float32)
+    ref_m, new_m = nn.BatchNorm(**kw), resnet.Bf16StatsBatchNorm(**kw)
+    ref_v = ref_m.init(jax.random.PRNGKey(0), x)
+    new_v = new_m.init(jax.random.PRNGKey(0), x)
+    assert (jax.tree_util.tree_structure(ref_v)
+            == jax.tree_util.tree_structure(new_v))
+    y_ref, ref_s = ref_m.apply(ref_v, x, mutable=["batch_stats"])
+    y_new, new_s = new_m.apply(new_v, x, mutable=["batch_stats"])
+    # bf16 accumulation over 256 elements: tolerance is the variant's
+    # honest precision cost, not a bug bar.
+    np.testing.assert_allclose(np.asarray(y_new, np.float32),
+                               np.asarray(y_ref, np.float32),
+                               rtol=0.1, atol=0.1)
+    assert not np.allclose(
+        np.asarray(new_s["batch_stats"]["mean"], np.float32), 0.0)
+
+
+def _resnet_norm_trains(norm):
+    """Shared body: ResNet(norm=...) runs a training step end-to-end
+    (interpret mode on CPU) and produces finite loss + updated stats."""
     import optax
 
     from horovod_tpu.models import resnet
 
     model, variables = resnet.create_train_state(
         jax.random.PRNGKey(0), image_size=32, num_classes=10,
-        norm="pallas")
+        norm=norm)
     params, batch_stats = variables["params"], variables["batch_stats"]
     tx = optax.sgd(0.1)
     opt_state = tx.init(params)
@@ -227,3 +256,11 @@ def test_resnet_pallas_norm_trains():
     assert np.isfinite(float(loss)), loss
     after = np.asarray(batch_stats["bn_init"]["mean"], np.float32)
     assert not np.allclose(before, after), "running stats never updated"
+
+
+def test_resnet_pallas_norm_trains():
+    _resnet_norm_trains("pallas")
+
+
+def test_resnet_bf16stats_norm_trains():
+    _resnet_norm_trains("bf16stats")

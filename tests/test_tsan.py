@@ -80,6 +80,21 @@ def test_core_collective_matrix_under_tsan(tmp_path):
         "\n".join(core_reports[:3])
 
 
+def test_zerocopy_sg_ring_under_tsan(tmp_path):
+    """The round-6 scatter-gather data path under the sanitizer: the
+    segmented-iovec ring (RingAllreduceSG) reads user input buffers and
+    writes user output buffers directly from the background thread while
+    frontend threads poll the zerocopy/staging counters — exactly the
+    ordering the counters-before-CompleteHandle contract pins down."""
+    p, core_reports = _run_under_tsan(
+        tmp_path, "zerocopy_worker.py", 2,
+        extra_env={"HVD_ZEROCOPY_THRESHOLD": "16384"})
+    assert p.returncode == 0, p.stderr[-3000:]
+    assert p.stdout.count("PASS") == 2, p.stdout
+    assert not core_reports, "TSAN races in the core:\n" + \
+        "\n".join(core_reports[:3])
+
+
 def test_reinit_and_auth_under_tsan(tmp_path):
     """The round-5 rendezvous additions under the sanitizer: rebind
     backoff + worker re-dial (rapid re-init cycles) and the connect-time

@@ -1,0 +1,117 @@
+"""Zero-copy data path (ISSUE 4): the eager bridge's dlpack/buffer-
+protocol adaptation (ops.zerocopy.as_buffer) and the host plane's
+scatter-gather ring (csrc RingAllreduceSG behind HVD_ZEROCOPY_THRESHOLD).
+"""
+import numpy as np
+import pytest
+
+from .util import run_single, run_worker_job
+
+from horovod_tpu.ops import zerocopy
+
+
+def _delta(before, after):
+    return {k: after[k] - before[k]
+            for k in ("zerocopy_ops", "zerocopy_bytes",
+                      "copy_ops", "copy_bytes")}
+
+
+def test_as_buffer_contiguous_ndarray_passes_through():
+    x = np.arange(16, dtype=np.float32)
+    s0 = zerocopy.stats()
+    arr, zc = zerocopy.as_buffer(x)
+    assert zc and arr is x
+    d = _delta(s0, zerocopy.stats())
+    assert d["zerocopy_ops"] == 1 and d["zerocopy_bytes"] == x.nbytes
+    assert d["copy_ops"] == 0 and d["copy_bytes"] == 0
+
+
+def test_as_buffer_noncontiguous_falls_back_counted():
+    x = np.arange(32, dtype=np.float32)[::2]
+    s0 = zerocopy.stats()
+    arr, zc = zerocopy.as_buffer(x)
+    assert not zc
+    assert arr.flags["C_CONTIGUOUS"] and np.array_equal(arr, x)
+    s1 = zerocopy.stats()
+    d = _delta(s0, s1)
+    assert d["copy_ops"] == 1 and d["copy_bytes"] == arr.nbytes
+    assert (s1["fallback_reasons"]["non-contiguous"]
+            == s0["fallback_reasons"].get("non-contiguous", 0) + 1)
+
+
+def test_as_buffer_dtype_mismatch_falls_back_counted():
+    x = np.arange(8, dtype=np.float64)
+    s0 = zerocopy.stats()
+    arr, zc = zerocopy.as_buffer(x, dtype=np.float32)
+    assert not zc and arr.dtype == np.float32
+    assert np.array_equal(arr, x.astype(np.float32))
+    s1 = zerocopy.stats()
+    assert (s1["fallback_reasons"]["dtype-mismatch"]
+            == s0["fallback_reasons"].get("dtype-mismatch", 0) + 1)
+    # Matching dtype request stays zero-copy.
+    arr2, zc2 = zerocopy.as_buffer(x, dtype=np.float64)
+    assert zc2 and arr2 is x
+
+
+def test_as_buffer_buffer_protocol_view():
+    raw = bytearray(b"\x01\x02\x03\x04")
+    arr, zc = zerocopy.as_buffer(raw)
+    assert zc, "bytearray exports the buffer protocol — must not copy"
+    raw[0] = 9  # writes through to the view => truly aliased
+    assert arr[0] == 9
+
+
+def test_as_buffer_no_protocol_copies_with_reason():
+    s0 = zerocopy.stats()
+    arr, zc = zerocopy.as_buffer([1.0, 2.0, 3.0])
+    assert not zc and np.array_equal(arr, [1.0, 2.0, 3.0])
+    s1 = zerocopy.stats()
+    assert (s1["fallback_reasons"]["no-buffer-protocol"]
+            == s0["fallback_reasons"].get("no-buffer-protocol", 0) + 1)
+
+
+def test_bridge_disable_forces_copies():
+    x = np.arange(16, dtype=np.float32)
+    prev = zerocopy.set_enabled(False)
+    try:
+        s0 = zerocopy.stats()
+        arr, zc = zerocopy.as_buffer(x)
+        assert not zc and arr is not x and np.array_equal(arr, x)
+        s1 = zerocopy.stats()
+        assert (s1["fallback_reasons"]["disabled"]
+                == s0["fallback_reasons"].get("disabled", 0) + 1)
+    finally:
+        zerocopy.set_enabled(prev)
+
+
+def test_zerocopy_sg_allreduce_2rank():
+    """2-rank integration (ISSUE 4 acceptance): above HVD_ZEROCOPY_THRESHOLD
+    the host plane performs ZERO staging memcpys — large unfused, fused
+    group straddling the threshold, Min/Average/f64 accumulate variants,
+    and the below-threshold staged path all asserted via the new
+    hvd.zerocopy_stats() counters, with exact numerics throughout."""
+    run_worker_job(2, "zerocopy_worker.py",
+                   extra_env={"HVD_ZEROCOPY_THRESHOLD": "16384"})
+
+
+def test_zerocopy_sg_allreduce_4rank():
+    run_worker_job(4, "zerocopy_worker.py",
+                   extra_env={"HVD_ZEROCOPY_THRESHOLD": "16384"})
+
+
+def test_zerocopy_disabled_by_env():
+    """HVD_ZEROCOPY=0 pins everything to the staged path: the worker's
+    zero-staging assertions must fail closed — exercised by asserting the
+    state query instead of rerunning the whole worker."""
+    run_single("zerocopy_off_worker.py", extra_env={
+        "HVD_ZEROCOPY": "0",
+        "HVD_ZEROCOPY_THRESHOLD": "4096",
+    })
+
+
+def test_traced_bridge_fails_loudly_on_stale_resize():
+    """VERDICT r5 #8: hvd_allgather/hvd_reducescatter hoist the process-set
+    size at trace time; a (faked) elastic resize must raise the staleness
+    error at the callback, not hand XLA a wrong-sized buffer."""
+    run_single("bridge_stale_worker.py", timeout=180,
+               drop_prefixes=("HVD_",))

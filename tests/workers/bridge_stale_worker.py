@@ -1,0 +1,55 @@
+"""Worker: traced bridge ops must fail LOUDLY when an elastic resize
+invalidates their trace-time size hoists (VERDICT r5 #8).
+
+hvd_allgather / hvd_reducescatter hoist the process-set size (and rank)
+at TRACE time to compute static output shapes. A resize between trace
+and execution makes the compiled program's output buffer silently wrong-
+sized. Single rank: trace both ops under jit, run them once, then fake a
+resize by monkeypatching the live size query and assert the callback
+raises the staleness error instead of returning garbage.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.ops import collective_ops as _core
+from horovod_tpu.ops import jax_ops
+
+hvd.init()
+assert hvd.size() == 1
+
+
+@jax.jit
+def gather(x):
+    return jax_ops.hvd_allgather(x, name="stale.ag")
+
+
+@jax.jit
+def scatter(x):
+    return jax_ops.hvd_reducescatter(x, op=jax_ops.Sum, name="stale.rs")
+
+
+x = jnp.arange(4, dtype=jnp.float32)
+assert np.array_equal(np.asarray(gather(x)), np.arange(4, dtype=np.float32))
+assert np.array_equal(np.asarray(scatter(x)), np.arange(4, dtype=np.float32))
+
+# Fake the resize: the library now reports one more member than the traces
+# hoisted. CDLL instances accept python attribute overrides, so this
+# shadows the ctypes entry point for every caller in this process.
+real_size = _core._lib.hvd_process_set_size
+_core._lib.hvd_process_set_size = lambda ps: int(real_size(int(ps))) + 1
+
+for jitted, tag in ((gather, "allgather"), (scatter, "reducescatter")):
+    try:
+        jitted(x)
+    except Exception as e:  # noqa: BLE001 — jax wraps the callback error
+        msg = f"{e!r}\n{e}"
+        assert "elastic resize" in msg, (tag, msg)
+        print(f"stale {tag}: loud error OK", flush=True)
+    else:
+        raise SystemExit(f"stale traced {tag} did NOT fail loudly")
+
+_core._lib.hvd_process_set_size = real_size
+hvd.shutdown()
+print("bridge-stale PASS", flush=True)

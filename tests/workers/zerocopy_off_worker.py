@@ -1,0 +1,24 @@
+"""Worker: HVD_ZEROCOPY=0 disables the scatter-gather path entirely —
+state reports disabled, large allreduces ride the staging path, and the
+zero-copy counters stay flat (single rank: the m<=1 path would skip SG
+anyway, so the state+counter assertions are the point here)."""
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+
+enabled, threshold = hvd.zerocopy_state()
+assert not enabled, "HVD_ZEROCOPY=0 must report the path disabled"
+assert threshold == 4096, threshold
+
+n = 8192  # 32 KB of f32, far above the 4 KB threshold
+out = hvd.allreduce(np.arange(n, dtype=np.float32), op=hvd.Sum,
+                    name="off.big")
+assert np.array_equal(out, np.arange(n, dtype=np.float32)), out[:4]
+zc_ops, zc_bytes, st_ops, st_bytes = hvd.zerocopy_stats()
+assert zc_ops == 0 and zc_bytes == 0, (zc_ops, zc_bytes)
+assert st_ops >= 1, st_ops
+
+hvd.shutdown()
+print("zerocopy-off PASS", flush=True)
