@@ -664,30 +664,48 @@ def _bench_hostplane():
     relay-immune — the multi-rank bus-bandwidth datum the single-chip ICI
     bench cannot provide (VERDICT r4 weak #4). Loopback TCP shares one
     memory system among all ranks, so this is a scaling *signal*, not an
-    ICI-peak claim."""
+    ICI-peak claim.
+
+    Runs the pod twice — streamed ring reduce-scatter (HVD_RING_PIPELINE
+    auto) vs forced serial (=1) — so the record carries the pipelined-vs-
+    serial A/B (ISSUE 5 acceptance). On a 1-core box the two are expected
+    to tie (the overlap has no second core to hide work on); the headline
+    value stays the pipelined figure."""
     import tempfile
 
     from horovod_tpu.runner.local import run_local
 
     np_ = int(os.environ.get("BENCH_HOSTPLANE_RANKS", "8"))
-    fd, out_path = tempfile.mkstemp(prefix="hvd_bench_hostplane_")
-    os.close(fd)
-    try:
-        env = {"PYTHONPATH": _repo_pythonpath(os.environ.get("PYTHONPATH")),
-               "JAX_PLATFORMS": "cpu",
-               "_BENCH_HOSTPLANE_WORKER": "1",
-               "_BENCH_HOSTPLANE_OUT": out_path}
-        codes = run_local(np_, [sys.executable, os.path.abspath(__file__)],
-                          env=env, timeout=90)
-        if codes != [0] * np_:
-            raise RuntimeError(f"hostplane ranks exited {codes}")
-        with open(out_path) as f:
-            return json.load(f)
-    finally:
+    runs = {}
+    for mode, depth in (("pipelined", "0"), ("serial", "1")):
+        fd, out_path = tempfile.mkstemp(prefix="hvd_bench_hostplane_")
+        os.close(fd)
         try:
-            os.unlink(out_path)
-        except OSError:
-            pass
+            env = {"PYTHONPATH":
+                   _repo_pythonpath(os.environ.get("PYTHONPATH")),
+                   "JAX_PLATFORMS": "cpu",
+                   "HVD_RING_PIPELINE": depth,
+                   "_BENCH_HOSTPLANE_WORKER": "1",
+                   "_BENCH_HOSTPLANE_OUT": out_path}
+            codes = run_local(np_,
+                              [sys.executable, os.path.abspath(__file__)],
+                              env=env, timeout=90)
+            if codes != [0] * np_:
+                raise RuntimeError(f"hostplane ranks exited {codes}")
+            with open(out_path) as f:
+                runs[mode] = json.load(f)
+        finally:
+            try:
+                os.unlink(out_path)
+            except OSError:
+                pass
+    d = runs["pipelined"]
+    serial = runs["serial"]
+    d["serial_gbps"] = serial["value"]
+    d["pipeline_speedup"] = (round(d["value"] / serial["value"], 3)
+                             if serial["value"] > 0 else None)
+    assert serial.get("stream_steps", 0) == 0, serial
+    return d
 
 
 def _hostplane_worker():
@@ -705,10 +723,12 @@ def _hostplane_worker():
         hvd.allreduce(x, op=hvd.Sum, name="hostplane.bw")
     hvd.barrier()
     iters = int(os.environ.get("_BENCH_HOSTPLANE_ITERS", "10"))
+    steps0, _, serial0, us0 = hvd.pipeline_stats()
     t0 = time.perf_counter()
     for _ in range(iters):
         hvd.allreduce(x, op=hvd.Sum, name="hostplane.bw")
     dt = time.perf_counter() - t0
+    steps1, _, serial1, us1 = hvd.pipeline_stats()
     if r == 0:
         alg = x.nbytes * iters / dt / 1e9
         bus = alg * 2.0 * (s - 1) / s
@@ -724,6 +744,9 @@ def _hostplane_worker():
                        "alg_gbps": round(alg, 3), "n_ranks": s,
                        "cpu_cores": len(os.sched_getaffinity(0)),
                        "nbytes": x.nbytes, "iters": iters,
+                       "stream_steps": steps1 - steps0,
+                       "serial_steps": serial1 - serial0,
+                       "overlap_ms": round((us1 - us0) / 1e3, 1),
                        "vs_baseline": 1.0}, f)
     hvd.barrier()
     hvd.shutdown()
@@ -902,6 +925,38 @@ def _bench_moe():
             "vs_baseline": 1.0}
 
 
+def _bench_reduce():
+    """Reduce-kernel microbench (ISSUE 5): GB/s of Accumulate(kSum) per
+    dtype with the vectorized tier forced on vs the pinned scalar
+    baseline (HVD_REDUCE_VECTOR A/B), via hvd.reduce_bench — pure
+    in-process timing of the csrc/reduce.h kernels, no pod and no init,
+    so it's meaningful even on the 1-core box where the ring A/B ties.
+    GB/s is payload (n * dtype size) per Accumulate call."""
+    import horovod_tpu as hvd
+
+    n = 1 << 20
+    iters = int(os.environ.get("BENCH_REDUCE_ITERS", "8"))
+    dtypes = {"f32": (5, 4), "f64": (6, 8), "i32": (2, 4), "i64": (3, 8),
+              "f16": (4, 2), "bf16": (8, 2), "u8": (0, 1)}
+    per = {}
+    for name, (dt, esz) in dtypes.items():
+        scal = hvd.reduce_bench(dt, n, iters=iters, vector=False)
+        vec = hvd.reduce_bench(dt, n, iters=iters, vector=True)
+        gb = n * esz / 1e9
+        per[name] = {
+            "scalar_gbps": round(gb / scal, 3) if scal > 0 else None,
+            "vector_gbps": round(gb / vec, 3) if vec > 0 else None,
+            "speedup": (round(scal / vec, 2)
+                        if vec > 0 and scal > 0 else None),
+        }
+    return {"metric": "reduce_kernel_vector_bandwidth",
+            "value": per["f32"]["vector_gbps"],
+            "unit": "GB/s (payload, Accumulate kSum, 1M f32)",
+            "n_elems": n, "iters": iters, "dtypes": per,
+            "cpu_cores": len(os.sched_getaffinity(0)),
+            "vs_baseline": 1.0}
+
+
 def _bench_elastic():
     """Measured elastic recovery — the BASELINE.md graded config "elastic
     resize: recovers without restart" (reference:
@@ -1027,6 +1082,7 @@ _CONFIG_FNS = {
     "longctx": _bench_longctx,
     "hostplane": _bench_hostplane,
     "bridge": _bench_bridge,
+    "reduce": _bench_reduce,
     "moe": _bench_moe,
     "elastic": _bench_elastic,
 }
@@ -1038,6 +1094,7 @@ _METRIC_NAMES = {
     "longctx": ("longctx_flash_train_throughput", "tokens/sec/chip"),
     "hostplane": ("allreduce_hostplane_bus_bandwidth", "GB/s"),
     "bridge": ("bridge_eager_allreduce_16MB", "ms/op"),
+    "reduce": ("reduce_kernel_vector_bandwidth", "GB/s"),
     "moe": ("moe_dispatch_throughput", "tokens/sec"),
     "elastic": ("elastic_recovery_seconds", "s"),
 }
@@ -1047,17 +1104,20 @@ _METRIC_NAMES = {
 # probe (75) + caps sum to 1125 <= the default BENCH_DEADLINE=1200, so
 # even an every-config-hangs run emits all lines inside the budget.
 _CONFIG_CAPS = {
-    "resnet50": 225,
+    "resnet50": 195,
     "transformer": 165,
     # Streaming sweep (4 variants, shared compile cache) + resident
     # widening both live inside this cap.
     "allreduce": 165,
     "longctx": 135,
-    "hostplane": 75,
+    # Two pods now (pipelined-vs-serial A/B), each well under 45 s.
+    "hostplane": 90,
     "bridge": 60,
+    # In-process ctypes microbench; seconds on a healthy box.
+    "reduce": 30,
     # Two remote compiles (dense + ragged in-jit loops) measured 135 s
     # alone on the relay; the cap must hold both plus the timed reps.
-    "moe": 210,
+    "moe": 195,
     "elastic": 90,
 }
 
@@ -1289,7 +1349,7 @@ def main():
 
     results = {}
     order = ["resnet50", "transformer", "allreduce", "longctx", "hostplane",
-             "bridge", "moe", "elastic"]
+             "bridge", "reduce", "moe", "elastic"]
     for name in order:
         cap = _cap(name)
         left = remaining() - 15  # reserve for final assembly

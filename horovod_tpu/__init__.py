@@ -142,6 +142,10 @@ cache_stats = _basics.cache_stats
 autotune_state = _basics.autotune_state
 zerocopy_stats = _basics.zerocopy_stats
 zerocopy_state = _basics.zerocopy_state
+reduce_stats = _basics.reduce_stats
+reduce_bench = _basics.reduce_bench
+pipeline_stats = _basics.pipeline_stats
+pipeline_state = _basics.pipeline_state
 peer_tx_bytes = _basics.peer_tx_bytes
 op_backends = _basics.op_backends
 backend_uses = _basics.backend_uses

@@ -169,6 +169,14 @@ _lib.hvd_zerocopy_state.restype = c_int
 _lib.hvd_zerocopy_state.argtypes = [P_int64]
 _lib.hvd_peer_tx_bytes.restype = c_int64
 _lib.hvd_peer_tx_bytes.argtypes = [ctypes.c_int]
+_lib.hvd_reduce_stats.restype = c_int
+_lib.hvd_reduce_stats.argtypes = [P_int64, P_int64, P_int64, P_int64]
+_lib.hvd_pipeline_stats.restype = c_int
+_lib.hvd_pipeline_stats.argtypes = [P_int64, P_int64, P_int64, P_int64]
+_lib.hvd_pipeline_state.restype = c_int
+_lib.hvd_pipeline_state.argtypes = [P_int64]
+_lib.hvd_reduce_bench.restype = c_double
+_lib.hvd_reduce_bench.argtypes = [c_int, c_int64, c_int, c_int]
 
 
 def last_error():
@@ -313,6 +321,57 @@ class HorovodBasics:
         if rc < 0:
             raise ValueError("horovod_tpu has not been initialized")
         return bool(rc), threshold.value
+
+    def reduce_stats(self):
+        """(fast_ops, fast_elems, scalar_ops, scalar_elems): how many
+        Accumulate dispatches (and elements) took the vectorized reduce
+        kernels vs the pinned scalar baseline (HVD_REDUCE_VECTOR=0). Works
+        without init — the counters are process-global."""
+        fo = c_int64(0)
+        fe = c_int64(0)
+        so = c_int64(0)
+        se = c_int64(0)
+        _lib.hvd_reduce_stats(ctypes.byref(fo), ctypes.byref(fe),
+                              ctypes.byref(so), ctypes.byref(se))
+        return fo.value, fe.value, so.value, se.value
+
+    def pipeline_stats(self):
+        """(stream_steps, stream_blocks, serial_steps, overlap_us) for the
+        streamed ring reduce-scatter: ring steps that delivered sub-blocks
+        into Accumulate while the socket drained (stream_*), steps that fell
+        back to the serial recv-then-reduce path, and microseconds of reduce
+        work overlapped with the wire."""
+        steps = c_int64(0)
+        blocks = c_int64(0)
+        serial = c_int64(0)
+        us = c_int64(0)
+        rc = _lib.hvd_pipeline_stats(
+            ctypes.byref(steps), ctypes.byref(blocks),
+            ctypes.byref(serial), ctypes.byref(us))
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        return steps.value, blocks.value, serial.value, us.value
+
+    def pipeline_state(self):
+        """(enabled, depth): whether ring-step streaming is live and the
+        configured sub-chunk depth (0 = auto-size per chunk, 1 = serial,
+        N>1 = split each ring chunk into N sub-blocks). HVD_RING_PIPELINE
+        sets the initial depth; autotune may toggle it."""
+        depth = c_int64(0)
+        rc = _lib.hvd_pipeline_state(ctypes.byref(depth))
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        return bool(rc), depth.value
+
+    def reduce_bench(self, dtype, n, iters=5, vector=True):
+        """Seconds per Accumulate(kSum) call over `n` elements of DataType
+        index `dtype`, with the vectorized tier forced on/off. Pure in-process
+        microbench (no init needed); used by bench.py's `reduce` config."""
+        v = _lib.hvd_reduce_bench(int(dtype), int(n), int(iters),
+                                  1 if vector else 0)
+        if v < 0:
+            raise ValueError(f"reduce_bench: bad dtype/size ({dtype}, {n})")
+        return v
 
     def mpi_threads_supported(self):
         return bool(_lib.hvd_mpi_threads_supported())

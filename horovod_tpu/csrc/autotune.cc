@@ -37,8 +37,10 @@ void ParameterManager::Configure(bool enabled, const std::string& log_path,
                                  int64_t cycles_per_sample,
                                  int64_t max_samples, bool init_cache,
                                  bool init_hier, bool init_zerocopy,
-                                 bool can_toggle_cache, bool can_toggle_hier,
-                                 bool can_toggle_zerocopy) {
+                                 bool init_pipeline, bool can_toggle_cache,
+                                 bool can_toggle_hier,
+                                 bool can_toggle_zerocopy,
+                                 bool can_toggle_pipeline) {
   enabled_ = enabled;
   if (!enabled_) return;
   cycles_per_sample_ = cycles_per_sample;
@@ -48,21 +50,27 @@ void ParameterManager::Configure(bool enabled, const std::string& log_path,
   // Arm order: the job's initial configuration first (the baseline every
   // later score competes against), then the other combinations — but only
   // over dims that can actually take effect (a capacity-0 cache, a
-  // non-uniform topology, or HVD_ZEROCOPY=0 makes that toggle a no-op;
-  // sweeping it would burn windows measuring a config that never engaged).
+  // non-uniform topology, HVD_ZEROCOPY=0, or a single-member ring makes
+  // that toggle a no-op; sweeping it would burn windows measuring a config
+  // that never engaged).
   int n = 0;
   for (int c = 0; c < (can_toggle_cache ? 2 : 1); c++) {
     for (int h = 0; h < (can_toggle_hier ? 2 : 1); h++) {
       for (int z = 0; z < (can_toggle_zerocopy ? 2 : 1); z++) {
-        arm_cache_[n] = can_toggle_cache
-                            ? (c == 0 ? init_cache : !init_cache)
-                            : init_cache;
-        arm_hier_[n] = can_toggle_hier ? (h == 0 ? init_hier : !init_hier)
-                                       : init_hier;
-        arm_zerocopy_[n] = can_toggle_zerocopy
-                               ? (z == 0 ? init_zerocopy : !init_zerocopy)
-                               : init_zerocopy;
-        n++;
+        for (int pl = 0; pl < (can_toggle_pipeline ? 2 : 1); pl++) {
+          arm_cache_[n] = can_toggle_cache
+                              ? (c == 0 ? init_cache : !init_cache)
+                              : init_cache;
+          arm_hier_[n] = can_toggle_hier ? (h == 0 ? init_hier : !init_hier)
+                                         : init_hier;
+          arm_zerocopy_[n] = can_toggle_zerocopy
+                                 ? (z == 0 ? init_zerocopy : !init_zerocopy)
+                                 : init_zerocopy;
+          arm_pipeline_[n] = can_toggle_pipeline
+                                 ? (pl == 0 ? init_pipeline : !init_pipeline)
+                                 : init_pipeline;
+          n++;
+        }
       }
     }
   }
@@ -70,14 +78,17 @@ void ParameterManager::Configure(bool enabled, const std::string& log_path,
   cur_cache_ = init_cache;
   cur_hier_ = init_hier;
   cur_zerocopy_ = init_zerocopy;
+  cur_pipeline_ = init_pipeline;
   // With fewer than arms+warmup samples budgeted (or nothing to sweep),
   // skip the arm phase and tune numerics only under the initial config.
   if (arm_count_ < 2 || max_samples_ < arm_count_ + 3) arm_idx_ = arm_count_;
   if (!log_path.empty()) {
     log_ = fopen(log_path.c_str(), "w");
     if (log_)
-      fprintf(log_,
-              "sample,fusion_kb,cycle_ms,cache,hier,zerocopy,score_mbps\n");
+      fprintf(
+          log_,
+          "sample,fusion_kb,cycle_ms,cache,hier,zerocopy,pipeline,"
+          "score_mbps\n");
   }
   // First sample point = warmup[0]; adopted on the first Record proposal.
   memcpy(cur_x_, kWarmup[0], sizeof(cur_x_));
@@ -184,7 +195,7 @@ void ParameterManager::Propose(double out[2]) {
 
 bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
                               double* cycle_ms, int* cache_on, int* hier_on,
-                              int* zerocopy_on) {
+                              int* zerocopy_on, int* pipeline_on) {
   if (!active()) return false;
   if (bytes <= 0 && acc_cycles_ == 0) {
     // Idle before the window opens: keep re-stamping the start so a pause
@@ -201,6 +212,7 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
     *cache_on = cur_cache_ ? 1 : 0;
     *hier_on = cur_hier_ ? 1 : 0;
     *zerocopy_on = cur_zerocopy_ ? 1 : 0;
+    *pipeline_on = cur_pipeline_ ? 1 : 0;
     warmup_idx_ = 1;
     return true;
   }
@@ -219,9 +231,9 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
     int64_t f;
     double c;
     ToParams(cur_x_, &f, &c);
-    fprintf(log_, "%lld,%.1f,%.3f,%d,%d,%d,%.3f\n", (long long)n_samples_,
+    fprintf(log_, "%lld,%.1f,%.3f,%d,%d,%d,%d,%.3f\n", (long long)n_samples_,
             f / 1024.0, c, cur_cache_ ? 1 : 0, cur_hier_ ? 1 : 0,
-            cur_zerocopy_ ? 1 : 0, score / 1e6);
+            cur_zerocopy_ ? 1 : 0, cur_pipeline_ ? 1 : 0, score / 1e6);
     fflush(log_);
   }
   if (score > best_score_) {
@@ -243,6 +255,7 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
       cur_cache_ = arm_cache_[arm_idx_];
       cur_hier_ = arm_hier_[arm_idx_];
       cur_zerocopy_ = arm_zerocopy_[arm_idx_];
+      cur_pipeline_ = arm_pipeline_[arm_idx_];
     } else {
       best_arm_ = 0;
       for (int i = 1; i < arm_count_; i++)
@@ -250,6 +263,7 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
       cur_cache_ = arm_cache_[best_arm_];
       cur_hier_ = arm_hier_[best_arm_];
       cur_zerocopy_ = arm_zerocopy_[best_arm_];
+      cur_pipeline_ = arm_pipeline_[best_arm_];
       // Seed the GP with the winning arm's observation at warmup[0]: the
       // numeric phase continues from warmup[1] under the locked arm.
       xs_.push_back({cur_x_[0], cur_x_[1]});
@@ -260,6 +274,7 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
     *cache_on = cur_cache_ ? 1 : 0;
     *hier_on = cur_hier_ ? 1 : 0;
     *zerocopy_on = cur_zerocopy_ ? 1 : 0;
+    *pipeline_on = cur_pipeline_ ? 1 : 0;
     return true;
   }
 
@@ -274,10 +289,12 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
     *cache_on = cur_cache_ ? 1 : 0;
     *hier_on = cur_hier_ ? 1 : 0;
     *zerocopy_on = cur_zerocopy_ ? 1 : 0;
+    *pipeline_on = cur_pipeline_ ? 1 : 0;
     if (log_) {
-      fprintf(log_, "# final,%.1f,%.3f,%d,%d,%d,%.3f\n",
+      fprintf(log_, "# final,%.1f,%.3f,%d,%d,%d,%d,%.3f\n",
               best_fusion_ / 1024.0, best_cycle_ms_, cur_cache_ ? 1 : 0,
-              cur_hier_ ? 1 : 0, cur_zerocopy_ ? 1 : 0, best_score_ / 1e6);
+              cur_hier_ ? 1 : 0, cur_zerocopy_ ? 1 : 0, cur_pipeline_ ? 1 : 0,
+              best_score_ / 1e6);
       fflush(log_);
     }
     return true;
@@ -287,6 +304,7 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
   *cache_on = cur_cache_ ? 1 : 0;
   *hier_on = cur_hier_ ? 1 : 0;
   *zerocopy_on = cur_zerocopy_ ? 1 : 0;
+  *pipeline_on = cur_pipeline_ ? 1 : 0;
   return true;
 }
 

@@ -9,6 +9,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <chrono>
 #include <cstdlib>
 #include <stdexcept>
 
@@ -38,12 +39,10 @@ std::vector<int64_t> Offsets(const std::vector<int64_t>& lens) {
   return off;
 }
 
-void SetNonBlocking(int fd, bool on) {
-  int flags = fcntl(fd, F_GETFL, 0);
-  if (on)
-    fcntl(fd, F_SETFL, flags | O_NONBLOCK);
-  else
-    fcntl(fd, F_SETFL, flags & ~O_NONBLOCK);
+int64_t MonoUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
 }
 
 // Bytes remaining in an iovec list from index `i` onward.
@@ -124,8 +123,8 @@ void DataPlane::FullDuplex(Socket& to, const void* sbuf, size_t sn,
   uint8_t* rp = (uint8_t*)rbuf;
   size_t sent = 0, recvd = 0;
   bool same = to.fd() == from.fd();
-  SetNonBlocking(to.fd(), true);
-  if (!same) SetNonBlocking(from.fd(), true);
+  to.SetNonBlocking(true);
+  if (!same) from.SetNonBlocking(true);
   try {
     while (sent < sn || recvd < rn) {
       pollfd fds[2];
@@ -172,12 +171,12 @@ void DataPlane::FullDuplex(Socket& to, const void* sbuf, size_t sn,
       }
     }
   } catch (...) {
-    SetNonBlocking(to.fd(), false);
-    if (!same) SetNonBlocking(from.fd(), false);
+    to.SetNonBlocking(false);
+    if (!same) from.SetNonBlocking(false);
     throw;
   }
-  SetNonBlocking(to.fd(), false);
-  if (!same) SetNonBlocking(from.fd(), false);
+  to.SetNonBlocking(false);
+  if (!same) from.SetNonBlocking(false);
 }
 
 void DataPlane::FullDuplexV(Socket& to, std::vector<iovec>& sv, Socket& from,
@@ -188,8 +187,8 @@ void DataPlane::FullDuplexV(Socket& to, std::vector<iovec>& sv, Socket& from,
   size_t sleft = IovBytes(sv, si);
   size_t rleft = IovBytes(rv, ri);
   bool same = to.fd() == from.fd();
-  SetNonBlocking(to.fd(), true);
-  if (!same) SetNonBlocking(from.fd(), true);
+  to.SetNonBlocking(true);
+  if (!same) from.SetNonBlocking(true);
   try {
     while (sleft > 0 || rleft > 0) {
       pollfd fds[2];
@@ -248,12 +247,181 @@ void DataPlane::FullDuplexV(Socket& to, std::vector<iovec>& sv, Socket& from,
       }
     }
   } catch (...) {
-    SetNonBlocking(to.fd(), false);
-    if (!same) SetNonBlocking(from.fd(), false);
+    to.SetNonBlocking(false);
+    if (!same) from.SetNonBlocking(false);
     throw;
   }
-  SetNonBlocking(to.fd(), false);
-  if (!same) SetNonBlocking(from.fd(), false);
+  to.SetNonBlocking(false);
+  if (!same) from.SetNonBlocking(false);
+}
+
+// Sub-block size for streaming a chunk_bytes receive. Auto depth (pipeline_
+// == 0) targets ~256 KiB sub-blocks, capped at 32 per chunk — deep enough
+// to overlap most of the reduce on MB-scale chunks, shallow enough that the
+// per-block dispatch overhead stays noise. A 4 KiB floor keeps tiny chunks
+// from degenerating into per-packet callbacks.
+size_t DataPlane::StreamBlockBytes(size_t chunk_bytes, size_t esz) const {
+  size_t depth = (size_t)pipeline_;
+  if (depth == 0)
+    depth = std::min<size_t>(32, std::max<size_t>(1, chunk_bytes >> 18));
+  if (depth <= 1 || chunk_bytes < 2 * esz) return 0;
+  size_t block = chunk_bytes / depth;
+  if (block < 4096) block = 4096;
+  block = block / esz * esz;
+  if (block == 0) block = esz;
+  if (block >= chunk_bytes) return 0;
+  return block;
+}
+
+void DataPlane::FullDuplexStream(
+    Socket& to, const void* sbuf, size_t sn, Socket& from, void* rbuf,
+    size_t rn, size_t rblock,
+    const std::function<void(size_t, size_t)>& on_block) {
+  const uint8_t* sp = (const uint8_t*)sbuf;
+  uint8_t* rp = (uint8_t*)rbuf;
+  size_t sent = 0, recvd = 0, delivered = 0;
+  bool same = to.fd() == from.fd();
+  to.SetNonBlocking(true);
+  if (!same) from.SetNonBlocking(true);
+  try {
+    while (sent < sn || recvd < rn) {
+      pollfd fds[2];
+      int nfds = 0;
+      if (same) {
+        fds[0] = {to.fd(), 0, 0};
+        if (sent < sn) fds[0].events |= POLLOUT;
+        if (recvd < rn) fds[0].events |= POLLIN;
+        nfds = 1;
+      } else {
+        if (sent < sn) fds[nfds++] = {to.fd(), POLLOUT, 0};
+        if (recvd < rn) fds[nfds++] = {from.fd(), POLLIN, 0};
+      }
+      int rc = ::poll(fds, nfds, poll_timeout_ms_);
+      if (rc < 0) {
+        if (errno == EINTR) continue;
+        throw std::runtime_error("poll failed");
+      }
+      if (rc == 0)
+        throw std::runtime_error(
+            "data-plane poll timeout (" +
+            std::to_string(poll_timeout_ms_ / 1000) +
+            "s with no bytes moved; HVD_DATA_TIMEOUT_SECONDS to tune)");
+      for (int i = 0; i < nfds; i++) {
+        if ((fds[i].revents & (POLLERR | POLLHUP | POLLNVAL)) &&
+            !(fds[i].revents & (POLLIN | POLLOUT)))
+          throw std::runtime_error("data-plane peer failed");
+        if ((fds[i].revents & POLLOUT) && sent < sn) {
+          ssize_t k = ::send(to.fd(), sp + sent, sn - sent, MSG_NOSIGNAL);
+          if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+            throw std::runtime_error("data-plane send failed");
+          if (k > 0) {
+            sent += (size_t)k;
+            to.note_tx((size_t)k);
+          }
+        }
+        if ((fds[i].revents & POLLIN) && recvd < rn) {
+          ssize_t k = ::recv(from.fd(), rp + recvd, rn - recvd, 0);
+          if (k == 0) throw std::runtime_error("data-plane peer closed");
+          if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+            throw std::runtime_error("data-plane recv failed");
+          if (k > 0) recvd += (size_t)k;
+          // Reduce every completed rblock-aligned run now, while the socket
+          // buffers keep filling/draining underneath us. The final partial
+          // block rides along as soon as the last byte lands.
+          size_t bound = recvd == rn
+                             ? rn
+                             : delivered + (recvd - delivered) / rblock * rblock;
+          if (bound > delivered) {
+            on_block(delivered, bound - delivered);
+            delivered = bound;
+          }
+        }
+      }
+    }
+  } catch (...) {
+    to.SetNonBlocking(false);
+    if (!same) from.SetNonBlocking(false);
+    throw;
+  }
+  to.SetNonBlocking(false);
+  if (!same) from.SetNonBlocking(false);
+}
+
+void DataPlane::FullDuplexVStream(
+    Socket& to, std::vector<iovec>& sv, Socket& from, void* rbuf, size_t rn,
+    size_t rblock, const std::function<void(size_t, size_t)>& on_block) {
+  size_t si = 0;
+  while (si < sv.size() && sv[si].iov_len == 0) si++;
+  size_t sleft = IovBytes(sv, si);
+  uint8_t* rp = (uint8_t*)rbuf;
+  size_t recvd = 0, delivered = 0;
+  bool same = to.fd() == from.fd();
+  to.SetNonBlocking(true);
+  if (!same) from.SetNonBlocking(true);
+  try {
+    while (sleft > 0 || recvd < rn) {
+      pollfd fds[2];
+      int nfds = 0;
+      if (same) {
+        fds[0] = {to.fd(), 0, 0};
+        if (sleft > 0) fds[0].events |= POLLOUT;
+        if (recvd < rn) fds[0].events |= POLLIN;
+        nfds = 1;
+      } else {
+        if (sleft > 0) fds[nfds++] = {to.fd(), POLLOUT, 0};
+        if (recvd < rn) fds[nfds++] = {from.fd(), POLLIN, 0};
+      }
+      int rc = ::poll(fds, nfds, poll_timeout_ms_);
+      if (rc < 0) {
+        if (errno == EINTR) continue;
+        throw std::runtime_error("poll failed");
+      }
+      if (rc == 0)
+        throw std::runtime_error(
+            "data-plane poll timeout (" +
+            std::to_string(poll_timeout_ms_ / 1000) +
+            "s with no bytes moved; HVD_DATA_TIMEOUT_SECONDS to tune)");
+      for (int i = 0; i < nfds; i++) {
+        if ((fds[i].revents & (POLLERR | POLLHUP | POLLNVAL)) &&
+            !(fds[i].revents & (POLLIN | POLLOUT)))
+          throw std::runtime_error("data-plane peer failed");
+        if ((fds[i].revents & POLLOUT) && sleft > 0) {
+          msghdr mh = {};
+          mh.msg_iov = &sv[si];
+          mh.msg_iovlen = std::min(sv.size() - si, (size_t)IOV_MAX);
+          ssize_t k = ::sendmsg(to.fd(), &mh, MSG_NOSIGNAL);
+          if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+              errno != EINTR)
+            throw std::runtime_error("data-plane send failed");
+          if (k > 0) {
+            IovAdvance(sv, &si, (size_t)k);
+            sleft -= (size_t)k;
+            to.note_tx((size_t)k);
+          }
+        }
+        if ((fds[i].revents & POLLIN) && recvd < rn) {
+          ssize_t k = ::recv(from.fd(), rp + recvd, rn - recvd, 0);
+          if (k == 0) throw std::runtime_error("data-plane peer closed");
+          if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+            throw std::runtime_error("data-plane recv failed");
+          if (k > 0) recvd += (size_t)k;
+          size_t bound = recvd == rn
+                             ? rn
+                             : delivered + (recvd - delivered) / rblock * rblock;
+          if (bound > delivered) {
+            on_block(delivered, bound - delivered);
+            delivered = bound;
+          }
+        }
+      }
+    }
+  } catch (...) {
+    to.SetNonBlocking(false);
+    if (!same) from.SetNonBlocking(false);
+    throw;
+  }
+  to.SetNonBlocking(false);
+  if (!same) from.SetNonBlocking(false);
 }
 
 void DataPlane::RingAllreduce(void* buf, int64_t nelem, DataType dtype,
@@ -271,13 +439,32 @@ void DataPlane::RingAllreduce(void* buf, int64_t nelem, DataType dtype,
   uint8_t* p = (uint8_t*)buf;
 
   // Phase 1: reduce-scatter. After m-1 steps, member i owns the complete
-  // reduction of chunk (i+1) mod m.
+  // reduction of chunk (i+1) mod m. When the pipeline is on, each received
+  // chunk streams through Accumulate sub-block by sub-block from inside the
+  // poll loop, overlapping reduction of block k with the transfer of k+1.
   for (int s = 0; s < m - 1; s++) {
     int sc = ((my - s) % m + m) % m;
     int rc = ((my - s - 1) % m + m) % m;
-    FullDuplex(next, p + off[sc] * esz, (size_t)lens[sc] * esz, prev, tmp.data(),
-               (size_t)lens[rc] * esz);
-    Accumulate(p + off[rc] * esz, tmp.data(), lens[rc], dtype, op);
+    size_t rbytes = (size_t)lens[rc] * esz;
+    size_t block = StreamBlockBytes(rbytes, esz);
+    if (block == 0) {
+      FullDuplex(next, p + off[sc] * esz, (size_t)lens[sc] * esz, prev,
+                 tmp.data(), rbytes);
+      Accumulate(p + off[rc] * esz, tmp.data(), lens[rc], dtype, op);
+      stat_serial_steps++;
+    } else {
+      uint8_t* dst = p + off[rc] * esz;
+      FullDuplexStream(next, p + off[sc] * esz, (size_t)lens[sc] * esz, prev,
+                       tmp.data(), rbytes, block,
+                       [&](size_t boff, size_t blen) {
+                         int64_t t0 = MonoUs();
+                         Accumulate(dst + boff, tmp.data() + boff,
+                                    (int64_t)(blen / esz), dtype, op);
+                         stat_overlap_us += MonoUs() - t0;
+                         stat_stream_blocks++;
+                       });
+      stat_stream_steps++;
+    }
   }
   // Phase 2: allgather of completed chunks.
   for (int s = 0; s < m - 1; s++) {
@@ -323,14 +510,38 @@ void DataPlane::RingAllreduceSG(const std::vector<Segment>& in,
     sv.clear();
     rv.clear();
     SliceIov(s == 0 ? in : out, off[sc], lens[sc], esz, &sv);
-    rv.push_back({tmp.data(), (size_t)lens[rc] * esz});
-    FullDuplexV(next, sv, prev, rv);
-    const uint8_t* t = tmp.data();
-    ForEachSpan(in, out, off[rc], lens[rc], esz,
-                [&](uint8_t* o, const uint8_t* a, int64_t n) {
-                  AccumulateTo(o, a, t, n, dtype, op);
-                  t += (size_t)n * esz;
-                });
+    size_t rbytes = (size_t)lens[rc] * esz;
+    size_t block = StreamBlockBytes(rbytes, esz);
+    if (block == 0) {
+      rv.push_back({tmp.data(), rbytes});
+      FullDuplexV(next, sv, prev, rv);
+      const uint8_t* t = tmp.data();
+      ForEachSpan(in, out, off[rc], lens[rc], esz,
+                  [&](uint8_t* o, const uint8_t* a, int64_t n) {
+                    AccumulateTo(o, a, t, n, dtype, op);
+                    t += (size_t)n * esz;
+                  });
+      stat_serial_steps++;
+    } else {
+      // The SG receive side is already one contiguous chunk of scratch, so
+      // the streamed variant reduces each completed sub-block through the
+      // same three-address first-touch spans, shifted by the block offset.
+      FullDuplexVStream(
+          next, sv, prev, tmp.data(), rbytes, block,
+          [&](size_t boff, size_t blen) {
+            int64_t t0 = MonoUs();
+            const uint8_t* t = tmp.data() + boff;
+            ForEachSpan(in, out, off[rc] + (int64_t)(boff / esz),
+                        (int64_t)(blen / esz), esz,
+                        [&](uint8_t* o, const uint8_t* a, int64_t n) {
+                          AccumulateTo(o, a, t, n, dtype, op);
+                          t += (size_t)n * esz;
+                        });
+            stat_overlap_us += MonoUs() - t0;
+            stat_stream_blocks++;
+          });
+      stat_stream_steps++;
+    }
   }
   // Phase 2: allgather of completed chunks, wired directly between output
   // segments on both sides (readv overwrites the stale RS partials).

@@ -11,6 +11,8 @@
 
 #include <sys/uio.h>
 
+#include <cstddef>
+#include <functional>
 #include <vector>
 
 #include "common.h"
@@ -48,6 +50,24 @@ class DataPlane {
   // move within each window; only a fully quiet window trips the timeout.
   void set_timeout_ms(int ms) { poll_timeout_ms_ = ms; }
   int timeout_ms() const { return poll_timeout_ms_; }
+
+  // Ring-pipeline depth (HVD_RING_PIPELINE / --ring-pipeline / autotune arm):
+  // each reduce-scatter step's receive chunk is split into `depth` sub-blocks
+  // and every completed sub-block is reduced inside the poll loop while the
+  // socket keeps draining the next one. 0 = auto (scale depth with chunk
+  // size), 1 = serial (the pre-pipeline recv-all-then-reduce behavior),
+  // N > 1 = fixed depth.
+  void set_pipeline(int depth) { pipeline_ = depth < 0 ? 0 : depth; }
+  int pipeline() const { return pipeline_; }
+
+  // Pipeline proof counters. Background-thread-only writes (plain int64s,
+  // not atomics); core.cc snapshots deltas into Global's atomic counters
+  // BEFORE completing handles, per the established counter/completion
+  // ordering contract.
+  int64_t stat_stream_steps = 0;   // RS steps that ran the streamed path
+  int64_t stat_stream_blocks = 0;  // sub-block reductions fired in-loop
+  int64_t stat_serial_steps = 0;   // RS steps that ran the serial path
+  int64_t stat_overlap_us = 0;     // µs spent reducing inside the poll loop
 
   // In-place ring allreduce over `members` (sorted global ranks incl. self).
   // buf holds nelem elements of dtype; op applied elementwise.
@@ -118,10 +138,33 @@ class DataPlane {
   void FullDuplexV(Socket& to, std::vector<iovec>& sv, Socket& from,
                    std::vector<iovec>& rv);
 
+  // Streaming full duplex: like FullDuplex, but every time an
+  // `rblock`-byte-aligned run of the receive buffer completes, on_block(off,
+  // len) fires from inside the poll loop — the kernel keeps draining the
+  // next sub-block (and flushing pending sends) while the callback reduces
+  // this one. Callbacks are delivered in offset order and cover rbuf
+  // exactly once; same thread as the caller, so no new synchronization.
+  void FullDuplexStream(Socket& to, const void* sbuf, size_t sn, Socket& from,
+                        void* rbuf, size_t rn, size_t rblock,
+                        const std::function<void(size_t, size_t)>& on_block);
+
+  // Streaming variant of FullDuplexV for the scatter-gather ring's
+  // reduce-scatter phase: gather-send `sv`, but receive into one contiguous
+  // scratch buffer (the SG RS receive side is already a single chunk-sized
+  // iovec) with the same sub-block delivery contract as FullDuplexStream.
+  void FullDuplexVStream(Socket& to, std::vector<iovec>& sv, Socket& from,
+                         void* rbuf, size_t rn, size_t rblock,
+                         const std::function<void(size_t, size_t)>& on_block);
+
  private:
+  // Sub-block size in bytes for streaming a `chunk_bytes` receive, honoring
+  // pipeline_; 0 means run the serial path (depth 1 or chunk too small).
+  size_t StreamBlockBytes(size_t chunk_bytes, size_t esz) const;
+
   int rank_ = 0;
   int size_ = 1;
   int poll_timeout_ms_ = 300000;
+  int pipeline_ = 0;
   std::vector<Socket> peers_;
 };
 

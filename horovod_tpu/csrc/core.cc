@@ -143,6 +143,19 @@ struct Global {
   std::atomic<int64_t> staging_ops_total{0};
   std::atomic<int64_t> staging_bytes_total{0};
 
+  // Ring pipeline (streamed sub-chunk reduction inside the poll loop).
+  // ring_pipeline_cfg remembers the user-configured depth
+  // (HVD_RING_PIPELINE; 0 = auto) so autotune's on/off arm can restore it:
+  // arm off -> data.set_pipeline(1) (serial), arm on -> the configured
+  // depth (or auto if the user configured serial). Counters snapshot
+  // DataPlane's background-thread-only stat members; readable from user
+  // threads via hvd_pipeline_stats.
+  int ring_pipeline_cfg = 0;
+  std::atomic<int64_t> pipeline_stream_steps{0};
+  std::atomic<int64_t> pipeline_stream_blocks{0};
+  std::atomic<int64_t> pipeline_serial_steps{0};
+  std::atomic<int64_t> pipeline_overlap_us{0};
+
   std::thread background;
 
   std::mutex handle_mu;
@@ -268,6 +281,28 @@ bool UseZeroCopy(bool sg_ok, int64_t bytes, const Response& resp, int m) {
          resp.prescale == 1.0 && bytes >= g->zerocopy_threshold;
 }
 
+// Snapshot of DataPlane's (background-thread-only) ring-pipeline counters
+// around one ring execution: Publish() folds the deltas into Global's
+// atomics — BEFORE any CompleteHandle, same ordering rule as the zerocopy
+// counters — and overlap_us() sizes the TCP_REDUCE_OVERLAP timeline
+// sub-span (the slice of the ring span spent reducing inside the poll
+// loop).
+struct PipelineScope {
+  int64_t steps0, blocks0, serial0, us0;
+  PipelineScope()
+      : steps0(g->data.stat_stream_steps),
+        blocks0(g->data.stat_stream_blocks),
+        serial0(g->data.stat_serial_steps),
+        us0(g->data.stat_overlap_us) {}
+  int64_t overlap_us() const { return g->data.stat_overlap_us - us0; }
+  void Publish() const {
+    g->pipeline_stream_steps += g->data.stat_stream_steps - steps0;
+    g->pipeline_stream_blocks += g->data.stat_stream_blocks - blocks0;
+    g->pipeline_serial_steps += g->data.stat_serial_steps - serial0;
+    g->pipeline_overlap_us += overlap_us();
+  }
+};
+
 void ExecAllreduce(const Response& resp,
                    std::vector<TensorTableEntry>& entries,
                    const std::vector<int32_t>& members, ReduceKernel kernel,
@@ -285,11 +320,16 @@ void ExecAllreduce(const Response& resp,
       // directly — even the input->output priming copy disappears.
       std::vector<Segment> in{{(uint8_t*)e.input, n}};
       std::vector<Segment> out{{(uint8_t*)e.output, n}};
+      PipelineScope ps;
       int64_t t0 = NowUs();
       g->data.RingAllreduceSG(in, out, n, resp.dtype, RingOpOf(resp),
                               members);
       g->timeline.Record(e.req.name, "TCP_ALLREDUCE_SG", t0, NowUs());
+      if (ps.overlap_us() > 0)
+        g->timeline.Record(e.req.name, "TCP_REDUCE_OVERLAP", t0,
+                           t0 + ps.overlap_us());
       if (post != 1.0) ScaleBuffer(e.output, n, resp.dtype, post);
+      ps.Publish();
       g->zerocopy_ops_total++;
       g->zerocopy_bytes_total += n * (int64_t)esz;
       CompleteHandle(e.handle, Status::Ok());
@@ -301,10 +341,15 @@ void ExecAllreduce(const Response& resp,
     }
     g->staging_ops_total++;
     if (resp.prescale != 1.0) ScaleBuffer(e.output, n, resp.dtype, resp.prescale);
+    PipelineScope ps;
     int64_t t0 = NowUs();
     kernel(e.output, n, resp, members);
     g->timeline.Record(e.req.name, "TCP_ALLREDUCE", t0, NowUs());
+    if (ps.overlap_us() > 0)
+      g->timeline.Record(e.req.name, "TCP_REDUCE_OVERLAP", t0,
+                         t0 + ps.overlap_us());
     if (post != 1.0) ScaleBuffer(e.output, n, resp.dtype, post);
+    ps.Publish();
     CompleteHandle(e.handle, Status::Ok());
     return;
   }
@@ -330,6 +375,7 @@ void ExecAllreduce(const Response& resp,
       in.push_back({(uint8_t*)e.input, n});
       out.push_back({(uint8_t*)e.output, n});
     }
+    PipelineScope ps;
     int64_t t0 = NowUs();
     g->data.RingAllreduceSG(in, out, total, resp.dtype, RingOpOf(resp),
                             members);
@@ -337,6 +383,7 @@ void ExecAllreduce(const Response& resp,
     // Counters bump BEFORE any CompleteHandle: the caller may read
     // zerocopy_stats() the instant its op resolves, and the unfused path
     // already orders it this way.
+    ps.Publish();
     g->zerocopy_ops_total++;
     g->zerocopy_bytes_total += total * (int64_t)esz;
     for (size_t i = 0; i < resp.names.size(); i++) {
@@ -344,6 +391,9 @@ void ExecAllreduce(const Response& resp,
       if (post != 1.0)
         ScaleBuffer(e.output, NumElements(resp.shapes[i]), resp.dtype, post);
       g->timeline.Record(e.req.name, "TCP_ALLREDUCE_SG", t0, t1);
+      if (ps.overlap_us() > 0)
+        g->timeline.Record(e.req.name, "TCP_REDUCE_OVERLAP", t0,
+                           t0 + ps.overlap_us());
       CompleteHandle(e.handle, Status::Ok());
     }
     return;
@@ -367,6 +417,7 @@ void ExecAllreduce(const Response& resp,
   }
   int64_t t1 = NowUs();
   if (resp.prescale != 1.0) ScaleBuffer(fb, total, resp.dtype, resp.prescale);
+  PipelineScope ps;
   kernel(fb, total, resp, members);
   int64_t t2 = NowUs();
   if (post != 1.0) ScaleBuffer(fb, total, resp.dtype, post);
@@ -380,6 +431,9 @@ void ExecAllreduce(const Response& resp,
       staged += n * (int64_t)esz;
       g->timeline.Record(e.req.name, "MEMCPY_IN_FUSION_BUFFER", t0, t1);
       g->timeline.Record(e.req.name, "TCP_ALLREDUCE", t1, t2);
+      if (ps.overlap_us() > 0)
+        g->timeline.Record(e.req.name, "TCP_REDUCE_OVERLAP", t1,
+                           t1 + ps.overlap_us());
       g->timeline.Record(e.req.name, "MEMCPY_OUT_FUSION_BUFFER", t2, NowUs());
     }
     off += n;
@@ -387,6 +441,7 @@ void ExecAllreduce(const Response& resp,
   // Same ordering rule as the SG branch: counters before CompleteHandle,
   // so a caller polling staging counters right after its op resolves
   // never sees the op uncounted.
+  ps.Publish();
   g->staging_ops_total++;
   g->staging_bytes_total += staged;
   for (size_t i = 0; i < resp.names.size(); i++) {
@@ -741,14 +796,15 @@ void AutotuneCycle(ResponseList& rl) {
   if (g->autotune.active()) {
     int64_t fusion;
     double cycle_ms;
-    int cache_on, hier_on, zerocopy_on;
+    int cache_on, hier_on, zerocopy_on, pipeline_on;
     if (g->autotune.Record(PayloadBytes(rl), NowUs(), &fusion, &cycle_ms,
-                           &cache_on, &hier_on, &zerocopy_on)) {
+                           &cache_on, &hier_on, &zerocopy_on, &pipeline_on)) {
       rl.tuned_fusion = fusion;
       rl.tuned_cycle_ms = cycle_ms;
       rl.tuned_cache = (int8_t)cache_on;
       rl.tuned_hier = (int8_t)hier_on;
       rl.tuned_zerocopy = (int8_t)zerocopy_on;
+      rl.tuned_pipeline = (int8_t)pipeline_on;
     }
   }
   rl.tuned_locked = !g->autotune.active();
@@ -768,6 +824,16 @@ void ProcessResponseList(ResponseList& rl) {
   // identically on every rank.
   if (rl.tuned_zerocopy >= 0 && g->zerocopy_allowed)
     g->zerocopy_on = rl.tuned_zerocopy != 0;
+  // The ring-pipeline toggle is stateless too (only the background thread
+  // reads the depth, per-collective): arm on restores the user-configured
+  // depth (auto unless they pinned one; a user-configured serial depth of
+  // 1 maps to auto so the arm actually engages), arm off forces serial.
+  if (rl.tuned_pipeline >= 0)
+    g->data.set_pipeline(rl.tuned_pipeline != 0
+                             ? (g->ring_pipeline_cfg == 1
+                                    ? 0
+                                    : g->ring_pipeline_cfg)
+                             : 1);
   if (rl.tuned_locked && g->autotune.enabled()) g->autotune.SetDone();
   if (CacheOn()) {
     for (uint32_t b : rl.evict_bits) {
@@ -1240,6 +1306,15 @@ int hvd_init() {
     g->zerocopy_on = g->zerocopy_allowed;
     g->zerocopy_threshold =
         EnvInt("HVD_ZEROCOPY_THRESHOLD", 4 * 1024 * 1024);
+    // Ring pipeline: 0 = auto depth (default), 1 = serial (the
+    // pre-pipeline recv-all-then-reduce behavior), N > 1 = fixed sub-block
+    // count per reduce-scatter chunk.
+    g->ring_pipeline_cfg = (int)EnvInt("HVD_RING_PIPELINE", 0);
+    g->data.set_pipeline(g->ring_pipeline_cfg);
+    // Reduce-kernel tier: HVD_REDUCE_VECTOR=0 pins the scalar baseline
+    // (the bench's A/B switch); default is the vectorized tier.
+    ReduceVectorFlag().store(EnvInt("HVD_REDUCE_VECTOR", 1) != 0,
+                             std::memory_order_relaxed);
     g->process_sets.InitGlobal(g->size);
     RegisterBackends(g->ops);
     g->cache.Configure(EnvInt("HVD_CACHE_CAPACITY", 1024));
@@ -1260,9 +1335,13 @@ int hvd_init() {
         EnvInt("HVD_AUTOTUNE_CYCLES_PER_SAMPLE", 20),
         EnvInt("HVD_AUTOTUNE_MAX_SAMPLES", 30),
         g->cache.enabled(), g->hierarchical, g->zerocopy_on,
+        /*init_pipeline=*/g->ring_pipeline_cfg != 1,
         /*can_toggle_cache=*/g->cache.enabled(),
         /*can_toggle_hier=*/g->hier_ok && g->size > 1,
-        /*can_toggle_zerocopy=*/g->zerocopy_allowed && g->size > 1);
+        /*can_toggle_zerocopy=*/g->zerocopy_allowed && g->size > 1,
+        // HVD_RING_PIPELINE=1 is the operator pinning serial: drop the
+        // arm dimension instead of sweeping a config they opted out of.
+        /*can_toggle_pipeline=*/g->size > 1 && g->ring_pipeline_cfg != 1);
     g->data.set_timeout_ms(
         (int)(EnvDouble("HVD_DATA_TIMEOUT_SECONDS", 300.0) * 1000.0));
     LogF(LogLevel::kInfo,
@@ -1616,6 +1695,117 @@ int hvd_zerocopy_state(int64_t* threshold) {
   if (!g || !g->initialized) return -1;
   if (threshold) *threshold = g->zerocopy_threshold;
   return g->zerocopy_allowed && g->zerocopy_on ? 1 : 0;
+}
+
+// Reduce-kernel tier observability: ops/elements dispatched through the
+// vectorized tier vs the scalar baseline since process start. Returns the
+// live tier (0 scalar, 1 vectorized) — usable WITHOUT init (the counters
+// are process-global), so the microbench can read it standalone.
+int hvd_reduce_stats(int64_t* fast_ops, int64_t* fast_elems,
+                     int64_t* scalar_ops, int64_t* scalar_elems) {
+  ReduceStats& st = GlobalReduceStats();
+  if (fast_ops) *fast_ops = st.fast_ops.load(std::memory_order_relaxed);
+  if (fast_elems) *fast_elems = st.fast_elems.load(std::memory_order_relaxed);
+  if (scalar_ops)
+    *scalar_ops = st.scalar_ops.load(std::memory_order_relaxed);
+  if (scalar_elems)
+    *scalar_elems = st.scalar_elems.load(std::memory_order_relaxed);
+  return ReduceVectorFlag().load(std::memory_order_relaxed) ? 1 : 0;
+}
+
+// Ring-pipeline observability: reduce-scatter steps that streamed
+// sub-blocks through the poll loop vs ran serial, sub-block reductions
+// fired in-loop, and µs spent reducing inside the poll loop (the overlap
+// the TCP_REDUCE_OVERLAP timeline spans visualize).
+int hvd_pipeline_stats(int64_t* stream_steps, int64_t* stream_blocks,
+                       int64_t* serial_steps, int64_t* overlap_us) {
+  if (!g || !g->initialized) return -1;
+  if (stream_steps) *stream_steps = g->pipeline_stream_steps.load();
+  if (stream_blocks) *stream_blocks = g->pipeline_stream_blocks.load();
+  if (serial_steps) *serial_steps = g->pipeline_serial_steps.load();
+  if (overlap_us) *overlap_us = g->pipeline_overlap_us.load();
+  return 0;
+}
+
+// Current ring-pipeline depth: returns -1 uninitialized, else the live
+// depth (0 auto, 1 serial, N fixed) — reflects autotune arm flips.
+int hvd_pipeline_state(int64_t* depth) {
+  if (!g || !g->initialized) return -1;
+  if (depth) *depth = g->data.pipeline();
+  return g->data.pipeline() != 1 ? 1 : 0;
+}
+
+// Standalone reduce-kernel microbench: time `iters` in-place Accumulate
+// sum calls over `n` elements of `dtype`, under the requested tier
+// (vector_on 0/1; the live tier is restored afterwards). Returns seconds
+// per iteration, or -1 on bad dtype. Does NOT require init — bench.py
+// uses it to measure scalar vs vectorized GB/s on a box with no job up.
+double hvd_reduce_bench(int dtype, int64_t n, int iters, int vector_on) {
+  if (n <= 0 || iters <= 0) return -1.0;
+  DataType dt = (DataType)dtype;
+  size_t esz;
+  switch (dt) {
+    case DataType::kUInt8:
+    case DataType::kBool:
+    case DataType::kInt8:
+    case DataType::kInt32:
+    case DataType::kInt64:
+    case DataType::kFloat32:
+    case DataType::kFloat64:
+    case DataType::kFloat16:
+    case DataType::kBFloat16:
+      esz = DataTypeSize(dt);
+      break;
+    default:
+      return -1.0;
+  }
+  std::vector<uint8_t> dst((size_t)n * esz), src((size_t)n * esz);
+  // Fill with small NORMAL values in the target dtype: raw byte noise
+  // decodes to denormals/NaN for the float types, and denormal arithmetic
+  // is microcoded ~100x slower — it would swamp the scalar/vector delta
+  // being measured.
+  switch (dt) {
+    case DataType::kFloat32:
+      for (int64_t i = 0; i < n; i++) {
+        ((float*)src.data())[i] = 1.0f + (float)(i & 7) * 0.25f;
+        ((float*)dst.data())[i] = 0.5f + (float)(i & 3) * 0.125f;
+      }
+      break;
+    case DataType::kFloat64:
+      for (int64_t i = 0; i < n; i++) {
+        ((double*)src.data())[i] = 1.0 + (double)(i & 7) * 0.25;
+        ((double*)dst.data())[i] = 0.5 + (double)(i & 3) * 0.125;
+      }
+      break;
+    case DataType::kFloat16:
+      for (int64_t i = 0; i < n; i++) {
+        ((uint16_t*)src.data())[i] = float_to_half(1.0f + (float)(i & 7) * 0.25f);
+        ((uint16_t*)dst.data())[i] = float_to_half(0.5f + (float)(i & 3) * 0.125f);
+      }
+      break;
+    case DataType::kBFloat16:
+      for (int64_t i = 0; i < n; i++) {
+        ((uint16_t*)src.data())[i] = float_to_bf16(1.0f + (float)(i & 7) * 0.25f);
+        ((uint16_t*)dst.data())[i] = float_to_bf16(0.5f + (float)(i & 3) * 0.125f);
+      }
+      break;
+    default:
+      for (size_t i = 0; i < src.size(); i++) {
+        src[i] = (uint8_t)(i * 31 + 7);
+        dst[i] = (uint8_t)(i * 17 + 3);
+      }
+      break;
+  }
+  bool prev = ReduceVectorFlag().load(std::memory_order_relaxed);
+  ReduceVectorFlag().store(vector_on != 0, std::memory_order_relaxed);
+  // Warmup, then timed loop.
+  Accumulate(dst.data(), src.data(), n, dt, ReduceOp::kSum);
+  int64_t t0 = NowUs();
+  for (int i = 0; i < iters; i++)
+    Accumulate(dst.data(), src.data(), n, dt, ReduceOp::kSum);
+  int64_t t1 = NowUs();
+  ReduceVectorFlag().store(prev, std::memory_order_relaxed);
+  return (double)(t1 - t0) / 1e6 / (double)iters;
 }
 
 int hvd_mpi_threads_supported() { return 0; }

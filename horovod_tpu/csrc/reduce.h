@@ -4,16 +4,61 @@
 // for fp16 in horovod/common/half.h plus NCCL's built-in reductions). On TPU
 // the fused data plane is XLA; these kernels back the host/TCP reference
 // backend and Adasum's host-side math.
+//
+// Two tiers per dtype:
+//   * vectorized (default): restrict-qualified flat loops the compiler
+//     auto-vectorizes (the Makefile supplies -O3/-ftree-vectorize), with
+//     fp16/bf16 handled a block at a time — convert a block to f32 with
+//     branchless converters, reduce in f32, convert back — instead of the
+//     per-element branchy round-trip.
+//   * scalar (HVD_REDUCE_VECTOR=0): the original element-at-a-time kernels,
+//     pinned non-vectorized so they stay an honest A/B baseline even at -O3.
+// Every dispatch bumps process-global counters (hvd_reduce_stats) so tests
+// and the bench can prove which tier actually ran.
 #pragma once
 
+#include <atomic>
 #include <cmath>
 #include <cstdint>
+#include <cstring>
 
 #include "common.h"
 
 namespace hvd {
 
-// --- fp16 / bf16 <-> float conversion -------------------------------------
+// Pins a function to the non-vectorized baseline so the scalar tier stays
+// scalar under the vectorizing flag set (GCC honors per-function optimize
+// attributes; other compilers just get identical code in both tiers).
+#if defined(__GNUC__) && !defined(__clang__)
+#define HVD_NO_VECTORIZE \
+  __attribute__((optimize("no-tree-vectorize", "no-unroll-loops")))
+#else
+#define HVD_NO_VECTORIZE
+#endif
+
+// --- runtime tier selection + proof counters -------------------------------
+// Written by the background thread on every kernel dispatch, read by user
+// threads through hvd_reduce_stats — plain counts, so relaxed atomics.
+struct ReduceStats {
+  std::atomic<int64_t> fast_ops{0};
+  std::atomic<int64_t> fast_elems{0};
+  std::atomic<int64_t> scalar_ops{0};
+  std::atomic<int64_t> scalar_elems{0};
+};
+
+inline ReduceStats& GlobalReduceStats() {
+  static ReduceStats s;
+  return s;
+}
+
+// Vectorized tier on by default; HVD_REDUCE_VECTOR=0 (parsed in core.cc) or
+// hvd_reduce_bench flip it at runtime.
+inline std::atomic<bool>& ReduceVectorFlag() {
+  static std::atomic<bool> on{true};
+  return on;
+}
+
+// --- fp16 / bf16 <-> float conversion (scalar reference) -------------------
 inline float half_to_float(uint16_t h) {
   uint32_t sign = (uint32_t)(h >> 15) << 31;
   uint32_t exp = (h >> 10) & 0x1f;
@@ -58,9 +103,12 @@ inline uint16_t float_to_half(float v) {
       uint32_t rounded = (mant + (1u << (shift - 1))) >> shift;
       h = (uint16_t)((sign << 15) | rounded);
     }
-  } else if (exp >= 0x1f) {
-    // inf/nan
+  } else if (((f >> 23) & 0xff) == 0xff) {
+    // f32 inf/nan: keep nan-ness (quietened payload)
     h = (uint16_t)((sign << 15) | 0x7c00 | (mant ? 0x200 : 0));
+  } else if (exp >= 0x1f) {
+    // finite overflow past the fp16 range: saturate to inf, not nan
+    h = (uint16_t)((sign << 15) | 0x7c00);
   } else {
     // round to nearest even
     uint32_t rounded = mant + 0xfff + ((mant >> 13) & 1);
@@ -90,9 +138,191 @@ inline uint16_t float_to_bf16(float v) {
   return (uint16_t)(f >> 16);
 }
 
-// --- accumulate: dst = dst OP src, n elements ------------------------------
+// --- branchless block converters (vectorized tier) -------------------------
+// Scratch block size: 512 f32 = 2 KiB per buffer on the background thread's
+// stack, big enough to amortize loop overhead, small enough to stay in L1.
+constexpr int64_t kCvtBlock = 512;
+
+// fp16 -> f32, select-mask form: all three classes (normal, inf/nan,
+// subnormal) are computed unconditionally and blended with all-ones/all-
+// zeros masks — ternaries defeat GCC's if-conversion here ("control flow
+// in loop"), arithmetic masks keep the body straight-line so it
+// auto-vectorizes.
+inline void HalfToFloatBlock(const uint16_t* __restrict__ src,
+                             float* __restrict__ dst, int64_t n) {
+  for (int64_t i = 0; i < n; i++) {
+    uint32_t h = src[i];
+    uint32_t sign = (h & 0x8000u) << 16;
+    uint32_t em = h & 0x7fffu;
+    uint32_t is_ext = (uint32_t) - (int32_t)(em >= 0x7c00u);  // inf/nan
+    uint32_t is_sub = (uint32_t) - (int32_t)(em < 0x0400u);
+    // normal: rebias exponent by (127-15); inf/nan: add the same again so
+    // the f32 exponent saturates at 0xff with the mantissa carried through.
+    uint32_t o = (em << 13) + ((uint32_t)(127 - 15) << 23);
+    o += ((uint32_t)(127 - 15) << 23) & is_ext;
+    // subnormal (em < 0x400): value is exactly em * 2^-24.
+    float sub = (float)(int32_t)em * 5.9604644775390625e-08f;
+    uint32_t subbits;
+    memcpy(&subbits, &sub, 4);
+    o = (o & ~is_sub) | (subbits & is_sub);
+    o |= sign;
+    memcpy(&dst[i], &o, 4);
+  }
+}
+
+// f32 -> fp16 with round-to-nearest-even everywhere: normal rounding via
+// the +0xfff+lsb carry trick, subnormals via the denorm-magic float add
+// (adding 0.5f aligns the mantissa LSB to the fp16 subnormal ulp 2^-24 and
+// lets the FPU do the RTNE), inf/nan/overflow blended in with arithmetic
+// masks (same straight-line-body requirement as above).
+inline void FloatToHalfBlock(const float* __restrict__ src,
+                             uint16_t* __restrict__ dst, int64_t n) {
+  // Two passes over a stack scratch: the vectorizer refuses a loop mixing a
+  // float op with a 32->16 narrowing store ("unsupported data-type float"),
+  // so pass 1 stays uniformly 32-bit wide (int + float lanes, vectorizes)
+  // and pass 2 is a pure u32->u16 pack.
+  uint32_t hw[kCvtBlock];
+  for (int64_t base = 0; base < n; base += kCvtBlock) {
+    int64_t m = n - base < kCvtBlock ? n - base : kCvtBlock;
+    const float* __restrict__ s = src + base;
+    for (int64_t i = 0; i < m; i++) {
+      uint32_t u;
+      memcpy(&u, &s[i], 4);
+      uint32_t sign = (u >> 16) & 0x8000u;
+      uint32_t au = u & 0x7fffffffu;
+      // normal (rounds into inf naturally on overflow past 0x7bff)
+      uint32_t nu =
+          au + ((uint32_t)(15 - 127) << 23) + 0xfffu + ((au >> 13) & 1u);
+      uint32_t hnorm = (nu >> 13) & 0x7fffu;
+      // subnormal/zero: |x| < 2^-14 so x + 0.5f keeps exponent -1 and its
+      // mantissa LSB is exactly 2^-24 = one fp16 subnormal ulp.
+      float fa;
+      memcpy(&fa, &au, 4);
+      float fm = fa + 0.5f;
+      uint32_t um;
+      memcpy(&um, &fm, 4);
+      uint32_t hsub = (um - 0x3f000000u) & 0xffffu;
+      uint32_t is_nan = (uint32_t) - (int32_t)(au > 0x7f800000u);
+      uint32_t is_naninf = (uint32_t) - (int32_t)(au >= 0x7f800000u);
+      uint32_t is_big = (uint32_t) - (int32_t)(au >= 0x47800000u);
+      uint32_t is_sub = (uint32_t) - (int32_t)(au < 0x38800000u);
+      uint32_t hh = hnorm;
+      hh = (hh & ~is_big) | (0x7c00u & is_big);
+      hh = (hh & ~is_naninf) | ((0x7c00u | (0x200u & is_nan)) & is_naninf);
+      hh = (hh & ~is_sub) | (hsub & is_sub);
+      hw[i] = hh | sign;
+    }
+    uint16_t* __restrict__ d = dst + base;
+    for (int64_t i = 0; i < m; i++) d[i] = (uint16_t)hw[i];
+  }
+}
+
+// bf16 <-> f32 is a 16-bit shift (plus RTNE on the way down).
+inline void Bf16ToFloatBlock(const uint16_t* __restrict__ src,
+                             float* __restrict__ dst, int64_t n) {
+  for (int64_t i = 0; i < n; i++) {
+    uint32_t f = (uint32_t)src[i] << 16;
+    memcpy(&dst[i], &f, 4);
+  }
+}
+
+inline void FloatToBf16Block(const float* __restrict__ src,
+                             uint16_t* __restrict__ dst, int64_t n) {
+  for (int64_t i = 0; i < n; i++) {
+    uint32_t f;
+    memcpy(&f, &src[i], 4);
+    f += 0x7fffu + ((f >> 16) & 1u);
+    dst[i] = (uint16_t)(f >> 16);
+  }
+}
+
+// --- vectorized tier: restrict-qualified flat loops ------------------------
+// The ring never overlaps dst/src (src is receive scratch), so restrict is
+// sound here; the dispatchers route the documented dst==a alias case of
+// AccumulateTo through the two-address form instead.
 template <typename T>
-inline void AccumulateTyped(T* dst, const T* src, int64_t n, ReduceOp op) {
+inline void VecAccumulateTyped(T* __restrict__ dst, const T* __restrict__ src,
+                               int64_t n, ReduceOp op) {
+  switch (op) {
+    case ReduceOp::kSum:
+    case ReduceOp::kAverage:  // averaged via postscale
+    case ReduceOp::kAdasum:   // adasum host math handled separately
+      for (int64_t i = 0; i < n; i++) dst[i] = (T)(dst[i] + src[i]);
+      break;
+    case ReduceOp::kMin:
+      for (int64_t i = 0; i < n; i++) dst[i] = src[i] < dst[i] ? src[i] : dst[i];
+      break;
+    case ReduceOp::kMax:
+      for (int64_t i = 0; i < n; i++) dst[i] = src[i] > dst[i] ? src[i] : dst[i];
+      break;
+    case ReduceOp::kProduct:
+      for (int64_t i = 0; i < n; i++) dst[i] = (T)(dst[i] * src[i]);
+      break;
+  }
+}
+
+template <typename T>
+inline void VecAccumulateToTyped(T* __restrict__ dst, const T* __restrict__ a,
+                                 const T* __restrict__ b, int64_t n,
+                                 ReduceOp op) {
+  switch (op) {
+    case ReduceOp::kSum:
+    case ReduceOp::kAverage:
+    case ReduceOp::kAdasum:
+      for (int64_t i = 0; i < n; i++) dst[i] = (T)(a[i] + b[i]);
+      break;
+    case ReduceOp::kMin:
+      for (int64_t i = 0; i < n; i++) dst[i] = b[i] < a[i] ? b[i] : a[i];
+      break;
+    case ReduceOp::kMax:
+      for (int64_t i = 0; i < n; i++) dst[i] = b[i] > a[i] ? b[i] : a[i];
+      break;
+    case ReduceOp::kProduct:
+      for (int64_t i = 0; i < n; i++) dst[i] = (T)(a[i] * b[i]);
+      break;
+  }
+}
+
+// 16-bit vectorized tier: bulk-convert a block to f32, reduce in f32 with
+// the restrict kernel, convert back. Same per-element math as the scalar
+// tier (each element is converted, reduced, converted back once), just in
+// vectorizable strips.
+template <void (*ToF)(const uint16_t* __restrict__, float* __restrict__,
+                      int64_t),
+          void (*FromF)(const float* __restrict__, uint16_t* __restrict__,
+                        int64_t)>
+inline void VecAccumulate16(uint16_t* dst, const uint16_t* src, int64_t n,
+                            ReduceOp op) {
+  float fa[kCvtBlock], fb[kCvtBlock];
+  for (int64_t i = 0; i < n; i += kCvtBlock) {
+    int64_t c = n - i < kCvtBlock ? n - i : kCvtBlock;
+    ToF(dst + i, fa, c);
+    ToF(src + i, fb, c);
+    VecAccumulateTyped(fa, fb, c, op);
+    FromF(fa, dst + i, c);
+  }
+}
+
+template <void (*ToF)(const uint16_t* __restrict__, float* __restrict__,
+                      int64_t),
+          void (*FromF)(const float* __restrict__, uint16_t* __restrict__,
+                        int64_t)>
+inline void VecAccumulateTo16(uint16_t* dst, const uint16_t* a,
+                              const uint16_t* b, int64_t n, ReduceOp op) {
+  float fa[kCvtBlock], fb[kCvtBlock];
+  for (int64_t i = 0; i < n; i += kCvtBlock) {
+    int64_t c = n - i < kCvtBlock ? n - i : kCvtBlock;
+    ToF(a + i, fa, c);
+    ToF(b + i, fb, c);
+    VecAccumulateTyped(fa, fb, c, op);
+    FromF(fa, dst + i, c);
+  }
+}
+
+// --- scalar tier (A/B baseline, pinned non-vectorized) ---------------------
+template <typename T>
+HVD_NO_VECTORIZE inline void AccumulateTyped(T* dst, const T* src, int64_t n,
+                                             ReduceOp op) {
   switch (op) {
     case ReduceOp::kSum:
     case ReduceOp::kAverage:  // averaged via postscale
@@ -112,8 +342,8 @@ inline void AccumulateTyped(T* dst, const T* src, int64_t n, ReduceOp op) {
 }
 
 template <float (*ToF)(uint16_t), uint16_t (*FromF)(float)>
-inline void Accumulate16(uint16_t* dst, const uint16_t* src, int64_t n,
-                         ReduceOp op) {
+HVD_NO_VECTORIZE inline void Accumulate16(uint16_t* dst, const uint16_t* src,
+                                          int64_t n, ReduceOp op) {
   for (int64_t i = 0; i < n; i++) {
     float a = ToF(dst[i]), b = ToF(src[i]), r;
     switch (op) {
@@ -131,8 +361,8 @@ inline void Accumulate16(uint16_t* dst, const uint16_t* src, int64_t n,
 // the (const, user-owned) input chunk with the received scratch lands
 // directly in the output segment, so no input->output bulk copy ever runs.
 template <typename T>
-inline void AccumulateToTyped(T* dst, const T* a, const T* b, int64_t n,
-                              ReduceOp op) {
+HVD_NO_VECTORIZE inline void AccumulateToTyped(T* dst, const T* a, const T* b,
+                                               int64_t n, ReduceOp op) {
   switch (op) {
     case ReduceOp::kSum:
     case ReduceOp::kAverage:  // averaged via postscale
@@ -152,8 +382,9 @@ inline void AccumulateToTyped(T* dst, const T* a, const T* b, int64_t n,
 }
 
 template <float (*ToF)(uint16_t), uint16_t (*FromF)(float)>
-inline void AccumulateTo16(uint16_t* dst, const uint16_t* a,
-                           const uint16_t* b, int64_t n, ReduceOp op) {
+HVD_NO_VECTORIZE inline void AccumulateTo16(uint16_t* dst, const uint16_t* a,
+                                            const uint16_t* b, int64_t n,
+                                            ReduceOp op) {
   for (int64_t i = 0; i < n; i++) {
     float x = ToF(a[i]), y = ToF(b[i]), r;
     switch (op) {
@@ -166,75 +397,158 @@ inline void AccumulateTo16(uint16_t* dst, const uint16_t* a,
   }
 }
 
-// dst = a OP b over raw buffers of `n` elements of `dtype` (dst may alias a).
-inline void AccumulateTo(void* dst, const void* a, const void* b, int64_t n,
-                         DataType dtype, ReduceOp op) {
-  switch (dtype) {
-    case DataType::kUInt8:
-    case DataType::kBool:
-      AccumulateToTyped((uint8_t*)dst, (const uint8_t*)a, (const uint8_t*)b,
-                        n, op);
-      break;
-    case DataType::kInt8:
-      AccumulateToTyped((int8_t*)dst, (const int8_t*)a, (const int8_t*)b, n,
-                        op);
-      break;
-    case DataType::kInt32:
-      AccumulateToTyped((int32_t*)dst, (const int32_t*)a, (const int32_t*)b,
-                        n, op);
-      break;
-    case DataType::kInt64:
-      AccumulateToTyped((int64_t*)dst, (const int64_t*)a, (const int64_t*)b,
-                        n, op);
-      break;
-    case DataType::kFloat32:
-      AccumulateToTyped((float*)dst, (const float*)a, (const float*)b, n, op);
-      break;
-    case DataType::kFloat64:
-      AccumulateToTyped((double*)dst, (const double*)a, (const double*)b, n,
-                        op);
-      break;
-    case DataType::kFloat16:
-      AccumulateTo16<half_to_float, float_to_half>(
-          (uint16_t*)dst, (const uint16_t*)a, (const uint16_t*)b, n, op);
-      break;
-    case DataType::kBFloat16:
-      AccumulateTo16<bf16_to_float, float_to_bf16>(
-          (uint16_t*)dst, (const uint16_t*)a, (const uint16_t*)b, n, op);
-      break;
+// --- dispatchers -----------------------------------------------------------
+namespace detail {
+inline bool NoteReduceDispatch(int64_t n) {
+  const bool fast = ReduceVectorFlag().load(std::memory_order_relaxed);
+  ReduceStats& st = GlobalReduceStats();
+  if (fast) {
+    st.fast_ops.fetch_add(1, std::memory_order_relaxed);
+    st.fast_elems.fetch_add(n, std::memory_order_relaxed);
+  } else {
+    st.scalar_ops.fetch_add(1, std::memory_order_relaxed);
+    st.scalar_elems.fetch_add(n, std::memory_order_relaxed);
   }
+  return fast;
 }
+}  // namespace detail
 
 // dst = dst OP src over raw buffers of `n` elements of `dtype`.
 inline void Accumulate(void* dst, const void* src, int64_t n, DataType dtype,
                        ReduceOp op) {
+  const bool fast = detail::NoteReduceDispatch(n);
   switch (dtype) {
     case DataType::kUInt8:
     case DataType::kBool:
-      AccumulateTyped((uint8_t*)dst, (const uint8_t*)src, n, op);
+      if (fast)
+        VecAccumulateTyped((uint8_t*)dst, (const uint8_t*)src, n, op);
+      else
+        AccumulateTyped((uint8_t*)dst, (const uint8_t*)src, n, op);
       break;
     case DataType::kInt8:
-      AccumulateTyped((int8_t*)dst, (const int8_t*)src, n, op);
+      if (fast)
+        VecAccumulateTyped((int8_t*)dst, (const int8_t*)src, n, op);
+      else
+        AccumulateTyped((int8_t*)dst, (const int8_t*)src, n, op);
       break;
     case DataType::kInt32:
-      AccumulateTyped((int32_t*)dst, (const int32_t*)src, n, op);
+      if (fast)
+        VecAccumulateTyped((int32_t*)dst, (const int32_t*)src, n, op);
+      else
+        AccumulateTyped((int32_t*)dst, (const int32_t*)src, n, op);
       break;
     case DataType::kInt64:
-      AccumulateTyped((int64_t*)dst, (const int64_t*)src, n, op);
+      if (fast)
+        VecAccumulateTyped((int64_t*)dst, (const int64_t*)src, n, op);
+      else
+        AccumulateTyped((int64_t*)dst, (const int64_t*)src, n, op);
       break;
     case DataType::kFloat32:
-      AccumulateTyped((float*)dst, (const float*)src, n, op);
+      if (fast)
+        VecAccumulateTyped((float*)dst, (const float*)src, n, op);
+      else
+        AccumulateTyped((float*)dst, (const float*)src, n, op);
       break;
     case DataType::kFloat64:
-      AccumulateTyped((double*)dst, (const double*)src, n, op);
+      if (fast)
+        VecAccumulateTyped((double*)dst, (const double*)src, n, op);
+      else
+        AccumulateTyped((double*)dst, (const double*)src, n, op);
       break;
     case DataType::kFloat16:
-      Accumulate16<half_to_float, float_to_half>((uint16_t*)dst,
-                                                 (const uint16_t*)src, n, op);
+      if (fast)
+        VecAccumulate16<HalfToFloatBlock, FloatToHalfBlock>(
+            (uint16_t*)dst, (const uint16_t*)src, n, op);
+      else
+        Accumulate16<half_to_float, float_to_half>(
+            (uint16_t*)dst, (const uint16_t*)src, n, op);
       break;
     case DataType::kBFloat16:
-      Accumulate16<bf16_to_float, float_to_bf16>((uint16_t*)dst,
-                                                 (const uint16_t*)src, n, op);
+      if (fast)
+        VecAccumulate16<Bf16ToFloatBlock, FloatToBf16Block>(
+            (uint16_t*)dst, (const uint16_t*)src, n, op);
+      else
+        Accumulate16<bf16_to_float, float_to_bf16>(
+            (uint16_t*)dst, (const uint16_t*)src, n, op);
+      break;
+  }
+}
+
+// dst = a OP b over raw buffers of `n` elements of `dtype` (dst may alias a).
+inline void AccumulateTo(void* dst, const void* a, const void* b, int64_t n,
+                         DataType dtype, ReduceOp op) {
+  if (dst == a) {
+    // Exact-alias case: fold into the two-address kernel so the restrict
+    // qualifiers in the vectorized tier stay truthful.
+    Accumulate(dst, b, n, dtype, op);
+    return;
+  }
+  const bool fast = detail::NoteReduceDispatch(n);
+  switch (dtype) {
+    case DataType::kUInt8:
+    case DataType::kBool:
+      if (fast)
+        VecAccumulateToTyped((uint8_t*)dst, (const uint8_t*)a,
+                             (const uint8_t*)b, n, op);
+      else
+        AccumulateToTyped((uint8_t*)dst, (const uint8_t*)a, (const uint8_t*)b,
+                          n, op);
+      break;
+    case DataType::kInt8:
+      if (fast)
+        VecAccumulateToTyped((int8_t*)dst, (const int8_t*)a, (const int8_t*)b,
+                             n, op);
+      else
+        AccumulateToTyped((int8_t*)dst, (const int8_t*)a, (const int8_t*)b, n,
+                          op);
+      break;
+    case DataType::kInt32:
+      if (fast)
+        VecAccumulateToTyped((int32_t*)dst, (const int32_t*)a,
+                             (const int32_t*)b, n, op);
+      else
+        AccumulateToTyped((int32_t*)dst, (const int32_t*)a, (const int32_t*)b,
+                          n, op);
+      break;
+    case DataType::kInt64:
+      if (fast)
+        VecAccumulateToTyped((int64_t*)dst, (const int64_t*)a,
+                             (const int64_t*)b, n, op);
+      else
+        AccumulateToTyped((int64_t*)dst, (const int64_t*)a, (const int64_t*)b,
+                          n, op);
+      break;
+    case DataType::kFloat32:
+      if (fast)
+        VecAccumulateToTyped((float*)dst, (const float*)a, (const float*)b, n,
+                             op);
+      else
+        AccumulateToTyped((float*)dst, (const float*)a, (const float*)b, n,
+                          op);
+      break;
+    case DataType::kFloat64:
+      if (fast)
+        VecAccumulateToTyped((double*)dst, (const double*)a, (const double*)b,
+                             n, op);
+      else
+        AccumulateToTyped((double*)dst, (const double*)a, (const double*)b, n,
+                          op);
+      break;
+    case DataType::kFloat16:
+      if (fast)
+        VecAccumulateTo16<HalfToFloatBlock, FloatToHalfBlock>(
+            (uint16_t*)dst, (const uint16_t*)a, (const uint16_t*)b, n, op);
+      else
+        AccumulateTo16<half_to_float, float_to_half>(
+            (uint16_t*)dst, (const uint16_t*)a, (const uint16_t*)b, n, op);
+      break;
+    case DataType::kBFloat16:
+      if (fast)
+        VecAccumulateTo16<Bf16ToFloatBlock, FloatToBf16Block>(
+            (uint16_t*)dst, (const uint16_t*)a, (const uint16_t*)b, n, op);
+      else
+        AccumulateTo16<bf16_to_float, float_to_bf16>(
+            (uint16_t*)dst, (const uint16_t*)a, (const uint16_t*)b, n, op);
       break;
   }
 }
@@ -277,14 +591,36 @@ inline void ScaleBuffer(void* buf, int64_t n, DataType dtype, double factor) {
     }
     case DataType::kFloat16: {
       auto* p = (uint16_t*)buf;
-      for (int64_t i = 0; i < n; i++)
-        p[i] = float_to_half(half_to_float(p[i]) * (float)factor);
+      if (ReduceVectorFlag().load(std::memory_order_relaxed)) {
+        float fa[kCvtBlock];
+        float f = (float)factor;
+        for (int64_t i = 0; i < n; i += kCvtBlock) {
+          int64_t c = n - i < kCvtBlock ? n - i : kCvtBlock;
+          HalfToFloatBlock(p + i, fa, c);
+          for (int64_t j = 0; j < c; j++) fa[j] *= f;
+          FloatToHalfBlock(fa, p + i, c);
+        }
+      } else {
+        for (int64_t i = 0; i < n; i++)
+          p[i] = float_to_half(half_to_float(p[i]) * (float)factor);
+      }
       break;
     }
     case DataType::kBFloat16: {
       auto* p = (uint16_t*)buf;
-      for (int64_t i = 0; i < n; i++)
-        p[i] = float_to_bf16(bf16_to_float(p[i]) * (float)factor);
+      if (ReduceVectorFlag().load(std::memory_order_relaxed)) {
+        float fa[kCvtBlock];
+        float f = (float)factor;
+        for (int64_t i = 0; i < n; i += kCvtBlock) {
+          int64_t c = n - i < kCvtBlock ? n - i : kCvtBlock;
+          Bf16ToFloatBlock(p + i, fa, c);
+          for (int64_t j = 0; j < c; j++) fa[j] *= f;
+          FloatToBf16Block(fa, p + i, c);
+        }
+      } else {
+        for (int64_t i = 0; i < n; i++)
+          p[i] = float_to_bf16(bf16_to_float(p[i]) * (float)factor);
+      }
       break;
     }
   }

@@ -2,6 +2,7 @@
 
 #include <arpa/inet.h>
 #include <errno.h>
+#include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <poll.h>
@@ -42,6 +43,15 @@ void Socket::Close() {
 void Socket::SetNoDelay() {
   int one = 1;
   setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+void Socket::SetNonBlocking(bool on) {
+  int flags = fcntl(fd_, F_GETFL, 0);
+  if (flags < 0) return;
+  if (on)
+    fcntl(fd_, F_SETFL, flags | O_NONBLOCK);
+  else
+    fcntl(fd_, F_SETFL, flags & ~O_NONBLOCK);
 }
 
 void Socket::SendAll(const void* buf, size_t n) {

@@ -57,6 +57,11 @@ class Socket {
 
   void SetNoDelay();
 
+  // Toggle O_NONBLOCK. The data plane's poll-driven full-duplex loops flip
+  // their sockets non-blocking for the duration of a collective and restore
+  // blocking mode on the way out.
+  void SetNonBlocking(bool on);
+
   // Wire-byte accounting (payload sent on this socket). Written by the
   // background IO thread, read by user threads (hvd_peer_tx_bytes) — so
   // atomic, relaxed: a count, not a synchronization point. Lets tests and

@@ -375,6 +375,44 @@ PIPELINE_BUBBLE = gauge(
 STALL_WARNINGS = counter(
     "hvd_stall_warnings_total",
     "Python-side stall inspector warnings", ("op",))
+RING_STREAM_STEPS = gauge(
+    "hvd_ring_stream_steps",
+    "Ring reduce-scatter steps that streamed sub-chunk reduction while "
+    "the socket drained (core counter snapshot; see sample_core_stats)")
+RING_STREAM_BLOCKS = gauge(
+    "hvd_ring_stream_blocks",
+    "Sub-blocks delivered into Accumulate by streamed ring steps")
+RING_SERIAL_STEPS = gauge(
+    "hvd_ring_serial_steps",
+    "Ring reduce-scatter steps that took the serial recv-then-reduce path "
+    "(pipeline off, or chunk below the streaming floor)")
+RING_OVERLAP_SECONDS = gauge(
+    "hvd_ring_overlap_seconds",
+    "Cumulative reduce time overlapped with the wire by ring streaming")
+REDUCE_FAST_OPS = gauge(
+    "hvd_reduce_fast_ops",
+    "Accumulate dispatches taken by the vectorized reduce kernels")
+REDUCE_SCALAR_OPS = gauge(
+    "hvd_reduce_scalar_ops",
+    "Accumulate dispatches taken by the pinned scalar baseline "
+    "(HVD_REDUCE_VECTOR=0)")
+
+
+def sample_core_stats(hvd=None):
+    """Snapshot the core's ring-pipeline and reduce-kernel counters into
+    the gauge families above. Call after synchronize() (or any quiesce
+    point); cheap, so callers may sample per step. `hvd` defaults to the
+    horovod_tpu package (parameter for tests)."""
+    if hvd is None:
+        import horovod_tpu as hvd
+    steps, blocks, serial, us = hvd.pipeline_stats()
+    RING_STREAM_STEPS.set(steps)
+    RING_STREAM_BLOCKS.set(blocks)
+    RING_SERIAL_STEPS.set(serial)
+    RING_OVERLAP_SECONDS.set(us / 1e6)
+    fast_ops, _, scalar_ops, _ = hvd.reduce_stats()
+    REDUCE_FAST_OPS.set(fast_ops)
+    REDUCE_SCALAR_OPS.set(scalar_ops)
 
 
 def record_call(op, seconds, nbytes, process_set=0):

@@ -34,6 +34,7 @@ ARG_TO_ENV = {
     "cache_capacity": ("HVD_CACHE_CAPACITY", str),
     "zerocopy_threshold_mb": ("HVD_ZEROCOPY_THRESHOLD",
                               lambda v: str(int(float(v) * _MB))),
+    "ring_pipeline": ("HVD_RING_PIPELINE", lambda v: str(int(v))),
     "timeline_filename": ("HVD_TIMELINE", str),
     "timeline_mark_cycles": ("HVD_TIMELINE_MARK_CYCLES",
                              lambda v: "1" if v else "0"),
@@ -56,7 +57,8 @@ _FILE_SECTIONS = {
     "params": {"fusion-threshold-mb": "fusion_threshold_mb",
                "cycle-time-ms": "cycle_time_ms",
                "cache-capacity": "cache_capacity",
-               "zerocopy-threshold-mb": "zerocopy_threshold_mb"},
+               "zerocopy-threshold-mb": "zerocopy_threshold_mb",
+               "ring-pipeline": "ring_pipeline"},
     "timeline": {"filename": "timeline_filename",
                  "mark-cycles": "timeline_mark_cycles"},
     "stall-check": {"warning-time-seconds":

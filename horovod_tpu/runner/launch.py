@@ -57,6 +57,12 @@ def parse_args(argv=None):
                    type=float, default=None,
                    help="min payload MB routed onto the scatter-gather "
                         "zero-copy ring (HVD_ZEROCOPY_THRESHOLD)")
+    p.add_argument("--ring-pipeline", dest="ring_pipeline", type=int,
+                   default=None,
+                   help="ring reduce-scatter streaming depth "
+                        "(HVD_RING_PIPELINE): 0 auto-sizes sub-chunks per "
+                        "ring step, 1 forces the serial recv-then-reduce "
+                        "path, N>1 splits each chunk into N sub-blocks")
     p.add_argument("--timeline-filename", dest="timeline_filename")
     p.add_argument("--timeline-mark-cycles", dest="timeline_mark_cycles",
                    action="store_true", default=None)

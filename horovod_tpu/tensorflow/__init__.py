@@ -338,7 +338,18 @@ def DistributedGradientTape(tape, op=Average, compression=None,
             for i in idx:
                 g = flat[i]
                 if isinstance(g, tf.IndexedSlices):
-                    g = tf.convert_to_tensor(g)  # sparse_as_dense default
+                    # Reference semantics (horovod/torch sparse_as_dense):
+                    # densify before the dense allreduce, or fail loudly —
+                    # a sparse layout silently fed to the dense plane would
+                    # be garbage. Mirrors the torch binding's error.
+                    if not sparse_as_dense:
+                        raise ValueError(
+                            f"gradient {i} produced a sparse gradient "
+                            f"(tf.IndexedSlices, e.g. from tf.gather); "
+                            f"pass sparse_as_dense=True to "
+                            f"DistributedGradientTape to densify it "
+                            f"before allreduce")
+                    g = tf.convert_to_tensor(g)
                 dense.append(g)
             outs = _grouped_np(
                 dense, op=op, name="tape.grads", process_set=process_set,

@@ -80,14 +80,14 @@ def test_autotune(tmp_path):
         "HVD_AUTOTUNE": "1",
         "HVD_AUTOTUNE_LOG": str(log),
         "HVD_AUTOTUNE_CYCLES_PER_SAMPLE": "4",
-        # 8 arms need >= arm_count + 3 samples or the categorical sweep
+        # 16 arms need >= arm_count + 3 samples or the categorical sweep
         # is skipped (parameter_manager arm guard).
-        "HVD_AUTOTUNE_MAX_SAMPLES": "16",
+        "HVD_AUTOTUNE_MAX_SAMPLES": "20",
         # 2 fake hosts x 2 locals: the hierarchical arm is toggleable, so
-        # the categorical sweep covers all 8 (cache, hier, zerocopy)
-        # combinations.
+        # the categorical sweep covers all 16 (cache, hier, zerocopy,
+        # pipeline) combinations.
         "AT_LOCAL_SIZE": "2",
-        "EXPECT_ARMS": "8",
+        "EXPECT_ARMS": "16",
     }, timeout=240)
 
 
@@ -105,10 +105,12 @@ def test_autotune_beats_defaults_32rank(tmp_path):
         "HVD_AUTOTUNE_MAX_SAMPLES": "8",
         "HVD_CYCLE_TIME_MS": "25",
         "AT_LOCAL_SIZE": "8",  # 4 fake hosts x 8: all 4 arms toggleable
-        # Pin the zero-copy arm off: keeps the 4-arm sweep inside the
-        # tight 8-sample budget (8 arms would need >= 11 samples). The
-        # zerocopy arm itself is covered by test_autotune above.
+        # Pin the zero-copy and ring-pipeline arms off: keeps the 4-arm
+        # (cache x hier) sweep inside the tight 8-sample budget (8 arms
+        # would need >= 11 samples, 16 would need 19). Those arms are
+        # covered by test_autotune above.
         "HVD_ZEROCOPY": "0",
+        "HVD_RING_PIPELINE": "1",
     }, timeout=600)
     text = log.read_text()
     assert text.startswith("sample,fusion_kb,cycle_ms,cache,hier,"), text

@@ -111,3 +111,21 @@ def test_reinit_and_auth_under_tsan(tmp_path):
     assert p.stdout.count("PASS") == 4, p.stdout
     assert not core_reports, "TSAN races in the core:\n" + \
         "\n".join(core_reports[:3])
+
+
+def test_streamed_ring_reduce_under_tsan(tmp_path):
+    """The streamed ring reduce-scatter (HVD_RING_PIPELINE) under the
+    sanitizer: sub-blocks of the receive scratch are handed to Accumulate
+    from inside the poll loop while the socket keeps draining the same
+    buffer's tail — the delivery bound (only bytes the kernel already
+    copied out are reduced) is exactly what TSAN would catch if wrong.
+    Covers both the staged and scatter-gather rings plus the vectorized
+    reduce kernels and their relaxed dispatch counters."""
+    p, core_reports = _run_under_tsan(
+        tmp_path, "ring_pipeline_worker.py", 2,
+        extra_env={"HVD_RING_PIPELINE": "4",
+                   "HVD_ZEROCOPY_THRESHOLD": "16384"})
+    assert p.returncode == 0, p.stderr[-3000:]
+    assert p.stdout.count("PASS") == 2, p.stdout
+    assert not core_reports, "TSAN races in the core:\n" + \
+        "\n".join(core_reports[:3])

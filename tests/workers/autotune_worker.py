@@ -10,8 +10,8 @@ import os
 
 # Optional fake multi-host topology (hier_worker.py convention): makes the
 # hierarchical-allreduce arm toggleable, so the categorical sweep covers
-# all 8 (cache, hier, zerocopy) combinations. Without it cross_size == 1
-# and the manager correctly skips the no-op hier arm.
+# all 16 (cache, hier, zerocopy, pipeline) combinations. Without it
+# cross_size == 1 and the manager correctly skips the no-op hier arm.
 _L = os.environ.get("AT_LOCAL_SIZE")
 if _L:
     _r = int(os.environ["HVD_RANK"])
@@ -54,7 +54,8 @@ if r == 0 and log_path:
     with open(log_path) as f:
         lines = [l for l in f.read().splitlines() if l]
     assert lines[0] == \
-        "sample,fusion_kb,cycle_ms,cache,hier,zerocopy,score_mbps", \
+        "sample,fusion_kb,cycle_ms,cache,hier,zerocopy,pipeline," \
+        "score_mbps", \
         lines[:1]
     rows = [l for l in lines[1:] if not l.startswith("#")]
     assert len(rows) == max_samples, (len(rows), max_samples)
@@ -63,18 +64,19 @@ if r == 0 and log_path:
     points = {tuple(l.split(",")[1:3]) for l in rows}
     assert len(points) >= 3, points
     # The categorical sweep ran: the first rows walk every TOGGLEABLE
-    # (cache, hier, zerocopy) arm at a pinned numeric point (reference:
-    # parameter_manager.cc categorical layers before numeric tuning).
-    # 8 arms on a fake multi-host pod (AT_LOCAL_SIZE), 4 when only cache
-    # and zerocopy toggle (cross_size == 1 makes hier a no-op), fewer
-    # still under HVD_ZEROCOPY=0 or single-rank.
-    n_arms = int(os.environ.get("EXPECT_ARMS", "4"))
-    arms = [tuple(l.split(",")[3:6]) for l in rows[:n_arms]]
+    # (cache, hier, zerocopy, pipeline) arm at a pinned numeric point
+    # (reference: parameter_manager.cc categorical layers before numeric
+    # tuning). 16 arms on a fake multi-host pod (AT_LOCAL_SIZE), 8 when
+    # only cache/zerocopy/pipeline toggle (cross_size == 1 makes hier a
+    # no-op), fewer still under HVD_ZEROCOPY=0, HVD_RING_PIPELINE=1, or
+    # single-rank.
+    n_arms = int(os.environ.get("EXPECT_ARMS", "8"))
+    arms = [tuple(l.split(",")[3:7]) for l in rows[:n_arms]]
     assert len(set(arms)) == n_arms, arms
     numeric_pts = {tuple(l.split(",")[1:3]) for l in rows[:n_arms]}
     assert len(numeric_pts) == 1, numeric_pts
     # ...and the numeric phase runs under ONE locked arm.
-    tail_arms = {tuple(l.split(",")[3:6]) for l in rows[n_arms:]}
+    tail_arms = {tuple(l.split(",")[3:7]) for l in rows[n_arms:]}
     assert len(tail_arms) == 1, tail_arms
 
 hvd.shutdown()

@@ -204,6 +204,28 @@ def pd_graph_step():
 g_pg = pd_graph_step()
 assert np.allclose(g_pg.numpy(), expect_pd, atol=1e-5), g_pg.numpy()
 
+# sparse gradients (tf.IndexedSlices from tf.gather): the default
+# sparse_as_dense=False must fail loudly — mirroring the torch binding —
+# never silently densify; sparse_as_dense=True densifies and allreduces.
+emb = tf.Variable(tf.ones((4, 3)) * (r + 1.0))
+with tf.GradientTape() as t_sp:
+    loss_sp = tf.reduce_sum(tf.gather(emb, [0, 2]))
+tape_sp = hvd.DistributedGradientTape(t_sp)
+try:
+    tape_sp.gradient(loss_sp, [emb])
+    raise SystemExit("expected ValueError (sparse_as_dense=False)")
+except ValueError as e:
+    assert "sparse_as_dense=True" in str(e), e
+with tf.GradientTape() as t_sd:
+    loss_sd = tf.reduce_sum(tf.gather(emb, [0, 2]) * (r + 1.0))
+tape_sd = hvd.DistributedGradientTape(t_sd, sparse_as_dense=True)
+g_sd = tape_sd.gradient(loss_sd, [emb])[0]
+expect_rows = np.mean([i + 1.0 for i in range(s)])
+g_sd_np = g_sd.numpy() if not isinstance(g_sd, tf.IndexedSlices) \
+    else tf.convert_to_tensor(g_sd).numpy()
+assert np.allclose(g_sd_np[0], expect_rows, atol=1e-5), g_sd_np
+assert np.allclose(g_sd_np[1], 0.0), g_sd_np
+
 # invalid factors fail at construction, not mid-backward
 try:
     hvd.DistributedGradientTape(tf.GradientTape(), op=hvd.Sum,
