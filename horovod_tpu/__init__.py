@@ -146,6 +146,10 @@ reduce_stats = _basics.reduce_stats
 reduce_bench = _basics.reduce_bench
 pipeline_stats = _basics.pipeline_stats
 pipeline_state = _basics.pipeline_state
+hier_stats = _basics.hier_stats
+lockdep_stats = _basics.lockdep_stats
+lockdep_report = _basics.lockdep_report
+lockdep_selftest = _basics.lockdep_selftest
 peer_tx_bytes = _basics.peer_tx_bytes
 op_backends = _basics.op_backends
 backend_uses = _basics.backend_uses

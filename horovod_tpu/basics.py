@@ -177,6 +177,12 @@ _lib.hvd_pipeline_state.restype = c_int
 _lib.hvd_pipeline_state.argtypes = [P_int64]
 _lib.hvd_reduce_bench.restype = c_double
 _lib.hvd_reduce_bench.argtypes = [c_int, c_int64, c_int, c_int]
+_lib.hvd_lockdep_stats.restype = c_int
+_lib.hvd_lockdep_stats.argtypes = [P_int64, P_int64, P_int64, P_int64]
+_lib.hvd_lockdep_report.restype = c_int
+_lib.hvd_lockdep_report.argtypes = [ctypes.c_char_p, c_int]
+_lib.hvd_lockdep_selftest.restype = c_int64
+_lib.hvd_lockdep_selftest.argtypes = []
 
 
 def last_error():
@@ -372,6 +378,49 @@ class HorovodBasics:
         if v < 0:
             raise ValueError(f"reduce_bench: bad dtype/size ({dtype}, {n})")
         return v
+
+    def hier_stats(self):
+        """(hierarchical_ops, ring_ops): allreduce responses executed by the
+        hierarchical backend (HVD_HIERARCHICAL_ALLREDUCE / the autotune
+        `hier` arm) vs the flat ring since init — the introspection pair for
+        the hierarchical autotune arm, mirroring zerocopy_stats /
+        pipeline_stats for theirs."""
+        return (self.backend_uses("hierarchical_allreduce"),
+                self.backend_uses("ring_allreduce"))
+
+    def lockdep_stats(self):
+        """(enabled, cycles, blocking, edges, acquisitions) from the in-core
+        lockdep checker (csrc/debug_lock.h): whether it is on (HVD_LOCKDEP=1
+        or a `make debug` core), lock-order inversions found, locks held
+        across blocking TCP syscalls, distinct acquisition-order edges, and
+        total instrumented acquisitions. Works without init — the checker is
+        process-global. See docs/static_analysis.md."""
+        cycles = c_int64(0)
+        blocking = c_int64(0)
+        edges = c_int64(0)
+        acq = c_int64(0)
+        rc = _lib.hvd_lockdep_stats(
+            ctypes.byref(cycles), ctypes.byref(blocking),
+            ctypes.byref(edges), ctypes.byref(acq))
+        return bool(rc), cycles.value, blocking.value, edges.value, acq.value
+
+    def lockdep_report(self):
+        """The deduped human-readable lockdep violation reports, one per
+        line (empty string when the graph is clean or lockdep is off)."""
+        size = 4096
+        while True:
+            buf = ctypes.create_string_buffer(size)
+            _lib.hvd_lockdep_report(buf, len(buf))
+            if len(buf.value) < size - 1:  # not truncated at cap
+                return buf.value.decode(errors="replace")
+            size *= 2
+
+    def lockdep_selftest(self):
+        """Seed a deterministic lock-order inversion (A->B then B->A on two
+        private lock classes) and return the cycle count afterwards — the
+        negative test that detection actually works. No deadlock risk: the
+        pairs are taken sequentially on the calling thread."""
+        return _lib.hvd_lockdep_selftest()
 
     def mpi_threads_supported(self):
         return bool(_lib.hvd_mpi_threads_supported())

@@ -3,6 +3,8 @@
 // link dependency for two handshake frames per connection.
 #include "auth.h"
 
+#include "logging.h"
+
 #include <cstdlib>
 #include <cstring>
 #include <random>
@@ -102,7 +104,7 @@ std::vector<uint8_t> HmacSha256(const std::vector<uint8_t>& key,
 }
 
 std::vector<uint8_t> JobSecret() {
-  const char* hex = getenv("HVD_RENDEZVOUS_SECRET");
+  const char* hex = EnvRaw("HVD_RENDEZVOUS_SECRET");
   if (hex == nullptr || hex[0] == '\0') return {};
   size_t n = strlen(hex);
   auto nib = [](char c) -> int {

@@ -21,6 +21,7 @@
 #include <vector>
 
 #include "common.h"
+#include "debug_lock.h"
 #include "response_cache.h"
 
 namespace hvd {
@@ -38,42 +39,42 @@ void FuseResponses(std::vector<Response>& ready, int64_t threshold,
 class ProcessSetTable {
  public:
   void InitGlobal(int size) {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     std::vector<int32_t> all(size);
     for (int i = 0; i < size; i++) all[i] = i;
     sets_[0] = all;
     next_id_ = 1;
   }
   int Add(const std::vector<int32_t>& ranks) {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     int id = next_id_++;
     sets_[id] = ranks;
     return id;
   }
   void AddWithId(int id, const std::vector<int32_t>& ranks) {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     sets_[id] = ranks;
     if (id >= next_id_) next_id_ = id + 1;
   }
   bool Remove(int id) {
     if (id == 0) return false;
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     return sets_.erase(id) > 0;
   }
   bool Contains(int id) const {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     return sets_.count(id) > 0;
   }
   std::vector<int32_t> Members(int id) const {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     return sets_.at(id);
   }
   int Size(int id) const {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     return (int)sets_.at(id).size();
   }
   int RankIn(int id, int global_rank) const {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     auto& m = sets_.at(id);
     for (size_t i = 0; i < m.size(); i++)
       if (m[i] == global_rank) return (int)i;
@@ -81,7 +82,7 @@ class ProcessSetTable {
   }
 
  private:
-  mutable std::mutex mu_;
+  mutable DebugMutex mu_{"process_sets"};
   std::map<int32_t, std::vector<int32_t>> sets_;
   int next_id_ = 1;
 };

@@ -29,6 +29,7 @@
 #include "collectives.h"
 #include "common.h"
 #include "controller.h"
+#include "debug_lock.h"
 #include "logging.h"
 #include "operation_manager.h"
 #include "response_cache.h"
@@ -158,19 +159,20 @@ struct Global {
 
   std::thread background;
 
-  std::mutex handle_mu;
-  std::condition_variable handle_cv;
+  DebugMutex handle_mu{"handle_table"};
+  // condition_variable_any: waits on DebugMutex (lockdep, debug_lock.h).
+  std::condition_variable_any handle_cv;
   std::unordered_map<int, std::shared_ptr<HandleState>> handles;
   int next_handle = 1;
   std::atomic<int> joined_count{0};
 
-  std::mutex error_mu;
+  DebugMutex error_mu{"error_state"};
   std::string last_error;
 
   // Process sets this rank has joined (join() called, not yet released):
   // the background thread participates in allreduces for them with
   // zero-filled stand-ins (reference: HorovodJoinOp).
-  std::mutex join_mu;
+  DebugMutex join_mu{"join_state"};
   std::set<int32_t> joined_sets;
 };
 
@@ -184,20 +186,20 @@ void SetError(const std::string& e) { tl_error = e; }
 // Handle helpers
 
 int NewHandle() {
-  std::lock_guard<std::mutex> l(g->handle_mu);
+  std::lock_guard<DebugMutex> l(g->handle_mu);
   int h = g->next_handle++;
   g->handles[h] = std::make_shared<HandleState>();
   return h;
 }
 
 std::shared_ptr<HandleState> GetHandle(int h) {
-  std::lock_guard<std::mutex> l(g->handle_mu);
+  std::lock_guard<DebugMutex> l(g->handle_mu);
   auto it = g->handles.find(h);
   return it == g->handles.end() ? nullptr : it->second;
 }
 
 void CompleteHandle(int h, Status s) {
-  std::lock_guard<std::mutex> l(g->handle_mu);
+  std::lock_guard<DebugMutex> l(g->handle_mu);
   auto it = g->handles.find(h);
   if (it != g->handles.end()) {
     it->second->status = std::move(s);
@@ -207,7 +209,7 @@ void CompleteHandle(int h, Status s) {
 }
 
 void hvd_release_internal(int h) {
-  std::lock_guard<std::mutex> l(g->handle_mu);
+  std::lock_guard<DebugMutex> l(g->handle_mu);
   g->handles.erase(h);
 }
 
@@ -636,7 +638,7 @@ void PerformOperation(const Response& resp) {
     // run allreduces for its process set with zero-filled stand-ins.
     bool joined_fill = false;
     if (resp.op_type == OpType::kAllreduce && resp.error.empty()) {
-      std::lock_guard<std::mutex> l(g->join_mu);
+      std::lock_guard<DebugMutex> l(g->join_mu);
       joined_fill = g->joined_sets.count(resp.process_set) > 0;
     }
     if (!joined_fill) return;
@@ -679,7 +681,7 @@ void PerformOperation(const Response& resp) {
         break;
       case OpType::kJoin: {
         {
-          std::lock_guard<std::mutex> l(g->join_mu);
+          std::lock_guard<DebugMutex> l(g->join_mu);
           g->joined_sets.erase(resp.process_set);
         }
         for (auto& e : entries) {
@@ -959,7 +961,7 @@ void BackgroundLoop() {
     // future operation fails with HorovodInternalError in Python.
     LogF(LogLevel::kError, "background loop failed: %s", ex.what());
     {
-      std::lock_guard<std::mutex> l(g->error_mu);
+      std::lock_guard<DebugMutex> l(g->error_mu);
       g->last_error = ex.what();
     }
     FailAllPending(std::string("HorovodInternalError: ") + ex.what());
@@ -1227,7 +1229,7 @@ int Enqueue(OpType type, const char* name, const void* input, void* output,
     return -1;
   }
   if (g->dead) {
-    std::lock_guard<std::mutex> l(g->error_mu);
+    std::lock_guard<DebugMutex> l(g->error_mu);
     SetError("HorovodInternalError: background thread dead: " + g->last_error);
     return -1;
   }
@@ -1259,7 +1261,7 @@ int Enqueue(OpType type, const char* name, const void* input, void* output,
   }
   if (type == OpType::kJoin) {
     // Zero-fill participation starts locally as soon as join is enqueued.
-    std::lock_guard<std::mutex> l(g->join_mu);
+    std::lock_guard<DebugMutex> l(g->join_mu);
     g->joined_sets.insert(process_set);
   }
   return handle;
@@ -1466,7 +1468,7 @@ int hvd_reducescatter_async(const char* name, const void* input,
 // Serializes start/stop against each other: without it two concurrent
 // starts both pass the enabled() check and Timeline::Init move-assigns
 // writer_ over a joinable thread — std::terminate.
-static std::mutex timeline_ctl_mu;
+static DebugMutex timeline_ctl_mu{"timeline_ctl"};
 
 int hvd_start_timeline(const char* path, int mark_cycles) {
   // Reference parity: horovod_start_timeline — begin tracing at runtime
@@ -1476,7 +1478,7 @@ int hvd_start_timeline(const char* path, int mark_cycles) {
     tl_error = "horovod_tpu not initialized";
     return -1;
   }
-  std::lock_guard<std::mutex> ctl(timeline_ctl_mu);
+  std::lock_guard<DebugMutex> ctl(timeline_ctl_mu);
   if (g->timeline.enabled()) {
     tl_error = "timeline already running; call hvd_stop_timeline first";
     return -1;
@@ -1501,7 +1503,7 @@ int hvd_stop_timeline() {
     tl_error = "horovod_tpu not initialized";
     return -1;
   }
-  std::lock_guard<std::mutex> ctl(timeline_ctl_mu);
+  std::lock_guard<DebugMutex> ctl(timeline_ctl_mu);
   if (!g->timeline.enabled()) {
     tl_error = "timeline is not running";
     return -1;
@@ -1539,7 +1541,7 @@ int hvd_poll(int handle) {
     SetError("unknown handle");
     return -2;
   }
-  std::lock_guard<std::mutex> l(g->handle_mu);
+  std::lock_guard<DebugMutex> l(g->handle_mu);
   if (!hs->done) return 0;
   if (!hs->status.ok()) {
     SetError(hs->status.reason);
@@ -1555,10 +1557,10 @@ int hvd_wait(int handle) {
     SetError("unknown handle");
     return -1;
   }
-  std::unique_lock<std::mutex> l(g->handle_mu);
+  std::unique_lock<DebugMutex> l(g->handle_mu);
   g->handle_cv.wait(l, [&] { return hs->done || g->dead.load(); });
   if (!hs->done) {
-    std::lock_guard<std::mutex> el(g->error_mu);
+    std::lock_guard<DebugMutex> el(g->error_mu);
     SetError("HorovodInternalError: " + g->last_error);
     return -1;
   }
@@ -1605,7 +1607,7 @@ int hvd_handle_extra(int handle) {
 
 void hvd_release(int handle) {
   if (!g) return;
-  std::lock_guard<std::mutex> l(g->handle_mu);
+  std::lock_guard<DebugMutex> l(g->handle_mu);
   g->handles.erase(handle);
 }
 
@@ -1806,6 +1808,65 @@ double hvd_reduce_bench(int dtype, int64_t n, int iters, int vector_on) {
   int64_t t1 = NowUs();
   ReduceVectorFlag().store(prev, std::memory_order_relaxed);
   return (double)(t1 - t0) / 1e6 / (double)iters;
+}
+
+// Lockdep observability (debug_lock.h): counts of lock-order inversions,
+// locks held across blocking TCP syscalls, distinct order edges, and total
+// instrumented acquisitions. Returns 1 when lockdep is enabled
+// (HVD_LOCKDEP=1 or a `make debug` build), 0 when off — usable WITHOUT
+// init, the checker is process-global.
+int hvd_lockdep_stats(int64_t* cycles, int64_t* blocking, int64_t* edges,
+                      int64_t* acquisitions) {
+  lockdep::State& s = lockdep::State::Get();
+  if (cycles) *cycles = s.cycles.load(std::memory_order_relaxed);
+  if (blocking) *blocking = s.blocking.load(std::memory_order_relaxed);
+  if (edges) *edges = s.edge_count.load(std::memory_order_relaxed);
+  if (acquisitions)
+    *acquisitions = s.acquisitions.load(std::memory_order_relaxed);
+  return lockdep::Enabled() ? 1 : 0;
+}
+
+// Copy the deduped human-readable violation reports (one per line) into
+// `out`; returns the number of violations recorded (which may exceed what
+// fit in `cap`).
+int hvd_lockdep_report(char* out, int cap) {
+  lockdep::State& s = lockdep::State::Get();
+  std::string joined;
+  int n;
+  {
+    std::lock_guard<std::mutex> l(s.mu);
+    n = (int)s.violations.size();
+    for (const auto& v : s.violations) {
+      joined += v;
+      joined += '\n';
+    }
+  }
+  if (out && cap > 0) {
+    int len = (int)joined.size();
+    if (len >= cap) len = cap - 1;
+    memcpy(out, joined.data(), len);
+    out[len] = '\0';
+  }
+  return n;
+}
+
+// Deterministic negative test: acquire two private lock classes as A->B
+// then B->A from this thread. The second ordering closes a cycle in the
+// order graph, which lockdep must report — without any real deadlock risk,
+// since the pairs are taken sequentially. Returns the cycle count after
+// seeding (>=1 iff detection works and lockdep is enabled).
+int64_t hvd_lockdep_selftest() {
+  static DebugMutex a{"selftest_a"};
+  static DebugMutex b{"selftest_b"};
+  {
+    std::lock_guard<DebugMutex> la(a);
+    std::lock_guard<DebugMutex> lb(b);
+  }
+  {
+    std::lock_guard<DebugMutex> lb(b);
+    std::lock_guard<DebugMutex> la(a);
+  }
+  return lockdep::State::Get().cycles.load(std::memory_order_relaxed);
 }
 
 int hvd_mpi_threads_supported() { return 0; }

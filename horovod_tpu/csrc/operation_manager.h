@@ -24,6 +24,7 @@
 
 #include "common.h"
 #include "tensor_queue.h"
+#include "debug_lock.h"
 
 namespace hvd {
 
@@ -55,7 +56,7 @@ class OperationManager {
           // Count BEFORE running: run() completes user handles internally,
           // so a frontend thread woken by its handle must already see the
           // selection reflected in Uses().
-          std::lock_guard<std::mutex> l(mu_);
+          std::lock_guard<DebugMutex> l(mu_);
           uses_[b.name]++;
         }
         b.run(resp, entries, members);
@@ -79,7 +80,7 @@ class OperationManager {
   }
 
   int64_t Uses(const std::string& name) const {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     auto it = uses_.find(name);
     return it == uses_.end() ? 0 : it->second;
   }
@@ -91,7 +92,7 @@ class OperationManager {
     Exec run;
   };
   std::map<int, std::vector<Backend>> ops_;
-  mutable std::mutex mu_;  // uses_ is read from API threads mid-training
+  mutable DebugMutex mu_{"op_uses"};  // uses_ is read from API threads mid-training
   std::map<std::string, int64_t> uses_;
 };
 

@@ -15,6 +15,8 @@
 #include <stdexcept>
 #include <thread>
 
+#include "debug_lock.h"
+
 namespace hvd {
 
 static void throw_errno(const std::string& what) {
@@ -57,6 +59,7 @@ void Socket::SetNonBlocking(bool on) {
 void Socket::SendAll(const void* buf, size_t n) {
   const uint8_t* p = (const uint8_t*)buf;
   while (n > 0) {
+    lockdep::OnBlockingSyscall("send");
     ssize_t k = ::send(fd_, p, n, MSG_NOSIGNAL);
     if (k < 0) {
       if (errno == EINTR) continue;
@@ -72,6 +75,7 @@ void Socket::SendAll(const void* buf, size_t n) {
 void Socket::RecvAll(void* buf, size_t n) {
   uint8_t* p = (uint8_t*)buf;
   while (n > 0) {
+    lockdep::OnBlockingSyscall("recv");
     ssize_t k = ::recv(fd_, p, n, 0);
     if (k < 0) {
       if (errno == EINTR) continue;
@@ -139,6 +143,7 @@ std::vector<std::vector<uint8_t>> RecvFrameEach(
       idx[nf] = i;
       nf++;
     }
+    lockdep::OnBlockingSyscall("poll");
     int rc = ::poll(fds.data(), (nfds_t)nf, -1);
     if (rc < 0) {
       if (errno == EINTR) continue;
@@ -219,6 +224,7 @@ bool Listener::AcceptTimeout(double sec, Socket* out) {
   pollfd p{};
   p.fd = fd_;
   p.events = POLLIN;
+  lockdep::OnBlockingSyscall("poll");
   int rc = ::poll(&p, 1, (int)(sec * 1000));
   if (rc == 0) return false;
   if (rc < 0) {
@@ -231,6 +237,7 @@ bool Listener::AcceptTimeout(double sec, Socket* out) {
 
 Socket Listener::Accept() {
   while (true) {
+    lockdep::OnBlockingSyscall("accept");
     int fd = ::accept(fd_, nullptr, nullptr);
     if (fd < 0) {
       if (errno == EINTR) continue;
@@ -266,6 +273,7 @@ Socket ConnectRetry(const std::string& host, int port, double timeout_sec) {
     int rc = getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res);
     if (rc == 0) {
       int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      lockdep::OnBlockingSyscall("connect");
       if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
         freeaddrinfo(res);
         Socket s(fd);

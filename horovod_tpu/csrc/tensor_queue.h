@@ -12,6 +12,7 @@
 #include <vector>
 
 #include "common.h"
+#include "debug_lock.h"
 
 namespace hvd {
 
@@ -37,7 +38,7 @@ class TensorQueue {
   // pending (the reference treats duplicate in-flight names as a fatal
   // usage error).
   bool Add(TensorTableEntry entry) {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     std::string key = Key(entry.req.process_set, entry.req.name);
     if (table_.count(key)) return false;
     pending_.push_back(entry.req);
@@ -49,7 +50,7 @@ class TensorQueue {
   // stamps each drained entry's announce time for the timeline's
   // QUEUE -> NEGOTIATE_* phase boundary.
   std::vector<Request> PopRequests(int64_t now_us = 0) {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     std::vector<Request> out;
     out.swap(pending_);
     for (auto& q : out) {
@@ -63,7 +64,7 @@ class TensorQueue {
   // this rank is not a participant of the response's process set).
   bool Take(const std::string& name, int32_t process_set,
             TensorTableEntry* out) {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     auto it = table_.find(Key(process_set, name));
     if (it == table_.end()) return false;
     *out = std::move(it->second);
@@ -74,7 +75,7 @@ class TensorQueue {
   // Copy a pending entry's request without claiming it (the response cache
   // records this rank's signature when a new response is inserted).
   bool Peek(const std::string& name, int32_t process_set, Request* out) {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     auto it = table_.find(Key(process_set, name));
     if (it == table_.end()) return false;
     *out = it->second.req;
@@ -85,7 +86,7 @@ class TensorQueue {
   // response-cache entry is evicted mid-negotiation: the tensor falls back
   // to the full metadata path next cycle).
   bool Repost(const std::string& name, int32_t process_set) {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     auto it = table_.find(Key(process_set, name));
     if (it == table_.end()) return false;
     pending_.push_back(it->second.req);
@@ -94,7 +95,7 @@ class TensorQueue {
 
   // Fail everything still pending (shutdown / internal error path).
   std::vector<TensorTableEntry> DrainAll() {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     std::vector<TensorTableEntry> out;
     out.reserve(table_.size());
     for (auto& kv : table_) out.push_back(std::move(kv.second));
@@ -104,12 +105,12 @@ class TensorQueue {
   }
 
   size_t size() {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     return table_.size();
   }
 
  private:
-  std::mutex mu_;
+  DebugMutex mu_{"tensor_queue"};
   std::unordered_map<std::string, TensorTableEntry> table_;
   std::vector<Request> pending_;
 };

@@ -63,6 +63,7 @@ const XLA_FFI_Api* GetXlaFfiApi();
 }  // namespace xla
 
 #include "common.h"
+#include "logging.h"
 #include "tf_dtype.h"
 
 // C API of libhvd_tpu.so (signatures mirror horovod_tpu/basics.py).
@@ -348,7 +349,7 @@ xla::XlaOp EmitCollective(XlaOpKernelContext* ctx, const Meta& m,
   // has_side_effect: a collective must not be CSE'd or dead-code-eliminated
   // — every rank's program must enqueue it exactly once.
   static const bool legacy = [] {
-    const char* v = getenv("HVD_XLA_LEGACY_CUSTOM_CALL");
+    const char* v = hvd::EnvRaw("HVD_XLA_LEGACY_CUSTOM_CALL");
     return v && v[0] == '1';
   }();
   return xla::CustomCall(

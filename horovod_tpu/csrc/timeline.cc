@@ -34,7 +34,7 @@ void Timeline::Init(const std::string& path, int rank) {
     // previous Shutdown — they belong to the old session's file. The
     // session counter catches the racer that is still between its
     // enabled_ check and the lock.
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     queue_.clear();
     session_++;
     stop_ = false;
@@ -46,7 +46,7 @@ void Timeline::Init(const std::string& path, int rank) {
 void Timeline::Shutdown() {
   if (!enabled_) return;
   {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     stop_ = true;
   }
   cv_.notify_all();
@@ -70,7 +70,7 @@ void Timeline::Record(const std::string& tensor, const std::string& phase,
            JsonEscape(phase).c_str(), (long long)start_us,
            (long long)(end_us - start_us), rank_, JsonEscape(tensor).c_str());
   {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     if (session_.load() != sess) return;  // raced a restart: old session
     queue_.emplace_back(buf);
   }
@@ -86,7 +86,7 @@ void Timeline::Mark(const std::string& label) {
            "\"s\": \"p\"}",
            JsonEscape(label).c_str(), (long long)NowUs(), rank_);
   {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<DebugMutex> l(mu_);
     if (session_.load() != sess) return;  // raced a restart: old session
     queue_.emplace_back(buf);
   }
@@ -97,7 +97,7 @@ void Timeline::WriterLoop() {
   std::vector<std::string> batch;
   while (true) {
     {
-      std::unique_lock<std::mutex> l(mu_);
+      std::unique_lock<DebugMutex> l(mu_);
       cv_.wait_for(l, std::chrono::milliseconds(100),
                    [this] { return stop_ || !queue_.empty(); });
       batch.swap(queue_);

@@ -16,6 +16,7 @@
 #include <string>
 #include <thread>
 #include <vector>
+#include "debug_lock.h"
 
 namespace hvd {
 
@@ -51,8 +52,9 @@ class Timeline {
   int rank_ = 0;
   FILE* file_ = nullptr;
   bool first_event_ = true;
-  std::mutex mu_;
-  std::condition_variable cv_;
+  DebugMutex mu_{"timeline"};
+  // condition_variable_any: waits on DebugMutex (lockdep, debug_lock.h).
+  std::condition_variable_any cv_;
   std::vector<std::string> queue_;
   std::thread writer_;
 };

@@ -10,6 +10,8 @@ YAML schema (any subset):
       fusion-threshold-mb: 64
       cycle-time-ms: 1.0
       cache-capacity: 1024
+      start-timeout: 120
+      log-level: info
     timeline:
       filename: /tmp/tl.json
       mark-cycles: true
@@ -58,7 +60,9 @@ _FILE_SECTIONS = {
                "cycle-time-ms": "cycle_time_ms",
                "cache-capacity": "cache_capacity",
                "zerocopy-threshold-mb": "zerocopy_threshold_mb",
-               "ring-pipeline": "ring_pipeline"},
+               "ring-pipeline": "ring_pipeline",
+               "start-timeout": "start_timeout",
+               "log-level": "log_level"},
     "timeline": {"filename": "timeline_filename",
                  "mark-cycles": "timeline_mark_cycles"},
     "stall-check": {"warning-time-seconds":

@@ -78,6 +78,14 @@ def parse_args(argv=None):
     p.add_argument("--autotune-log-file", dest="autotune_log_file")
     p.add_argument("--log-level", dest="log_level",
                    choices=["trace", "debug", "info", "warn", "error"])
+    p.add_argument("--metrics", dest="metrics", action="store_true",
+                   default=None,
+                   help="enable the observability metrics registry "
+                        "(HVD_METRICS; docs/observability.md)")
+    p.add_argument("--metrics-port", dest="metrics_port", type=int,
+                   default=None,
+                   help="serve per-worker Prometheus /metrics on this port "
+                        "(HVD_METRICS_PORT; rank-offset per local rank)")
     # elastic
     p.add_argument("--min-np", dest="min_np", type=int, default=None)
     p.add_argument("--max-np", dest="max_np", type=int, default=None)

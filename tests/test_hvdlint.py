@@ -1,0 +1,248 @@
+"""hvdlint (tools/hvdlint.py) — the repo-clean gate plus fixture-tree
+tests proving each rule actually fires (ISSUE 6 satellite: the linter
+itself is tested, not just trusted).
+
+The fixture tests build a minimal repo skeleton in tmp_path with ONE
+seeded violation each and assert the violation is reported with the
+right rule, file, and symbol — so a refactor that silently defangs a
+check fails here, not in review.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import hvdlint  # noqa: E402
+
+
+# --- the tier-1 gate: the real repo is clean, zero suppressions ------------
+
+def test_repo_is_clean():
+    violations = hvdlint.run(_REPO)
+    assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def test_cli_exit_codes(tmp_path):
+    # Clean repo -> 0 and "clean" on stdout; the CLI is what `make check`
+    # and CI call, so its contract is part of the tool.
+    p = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "hvdlint.py"),
+         "--repo", _REPO],
+        capture_output=True, text=True)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "clean" in p.stdout
+    # --list-knobs inventories every read site; spot-check a C++-read and
+    # a Python-read knob so both collectors are exercised end to end.
+    p = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "hvdlint.py"),
+         "--repo", _REPO, "--list-knobs"],
+        capture_output=True, text=True)
+    assert p.returncode == 0
+    assert "HVD_FUSION_THRESHOLD" in p.stdout
+    assert "HVD_METRICS" in p.stdout
+
+
+# --- fixture tree ----------------------------------------------------------
+
+def _seed_repo(tmp_path):
+    """Minimal clean skeleton the rules run against; tests then break it."""
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "running.md").write_text(
+        "# Running\n`HVD_DOCUMENTED` is a documented knob.\n")
+    (tmp_path / "docs" / "perf_tuning.md").write_text("# Perf\n")
+    csrc = tmp_path / "horovod_tpu" / "csrc"
+    csrc.mkdir(parents=True)
+    (csrc / "logging.h").write_text(
+        '// EnvRaw owns getenv\nstatic const char* EnvRaw(const char* n) '
+        '{ return getenv(n); }\n')
+    (csrc / "core.cc").write_text(textwrap.dedent("""\
+        #include "logging.h"
+        void ExecAllreduce() {
+          g->zerocopy_total++;
+          CompleteHandle(h);
+          return;
+        }
+        void Init() { EnvRaw("HVD_DOCUMENTED"); }
+        """))
+    (csrc / "common.h").write_text("struct Tuned { int8_t tuned_cache; };\n")
+    (tmp_path / "horovod_tpu" / "basics.py").write_text(
+        "def cache_stats(self):\n    return ()\n")
+    runner = tmp_path / "horovod_tpu" / "runner"
+    runner.mkdir()
+    (runner / "config_parser.py").write_text(textwrap.dedent("""\
+        ARG_TO_ENV = {
+            "cycle_time_ms": ("HVD_CYCLE_TIME_MS", str),
+        }
+        _FILE_SECTIONS = {
+            "params": {"cycle-time-ms": "cycle_time_ms"},
+        }
+        """))
+    (runner / "launch.py").write_text(textwrap.dedent("""\
+        import argparse
+        def parse_args():
+            ap = argparse.ArgumentParser()
+            ap.add_argument("--cycle-time-ms", type=float, default=None)
+            return ap.parse_args()
+        """))
+    return tmp_path
+
+
+def _by_rule(violations, rule):
+    return [v for v in violations if v.rule == rule]
+
+
+def test_fixture_tree_is_clean(tmp_path):
+    # The skeleton itself must be green or every seeded-violation assert
+    # below would be ambiguous.
+    root = str(_seed_repo(tmp_path))
+    assert hvdlint.run(root) == [], \
+        "\n".join(str(v) for v in hvdlint.run(root))
+
+
+def test_undocumented_knob_is_reported(tmp_path):
+    root = _seed_repo(tmp_path)
+    py = root / "horovod_tpu" / "knobby.py"
+    py.write_text('import os\nTHRESH = os.environ.get("HVD_SEEDED_KNOB")\n')
+    vs = _by_rule(hvdlint.run(str(root)), "knob-docs")
+    assert len(vs) == 1, [str(v) for v in vs]
+    v = vs[0]
+    assert v.symbol == "HVD_SEEDED_KNOB"
+    assert v.path == os.path.join("horovod_tpu", "knobby.py")
+    assert v.line == 2
+    # Documenting it in either doc clears the violation.
+    (root / "docs" / "perf_tuning.md").write_text("`HVD_SEEDED_KNOB`\n")
+    assert _by_rule(hvdlint.run(str(root)), "knob-docs") == []
+
+
+def test_environ_write_is_not_a_read(tmp_path):
+    root = _seed_repo(tmp_path)
+    (root / "horovod_tpu" / "writer.py").write_text(
+        'import os\nos.environ["HVD_WRITTEN"] = "1"\n'
+        'del os.environ["HVD_WRITTEN"]\n')
+    assert _by_rule(hvdlint.run(str(root)), "knob-docs") == []
+
+
+def test_yaml_cli_mismatch_is_reported(tmp_path):
+    root = _seed_repo(tmp_path)
+    # Seed an env mapping whose dest exists in neither the CLI nor YAML.
+    (root / "horovod_tpu" / "runner" / "config_parser.py").write_text(
+        textwrap.dedent("""\
+            ARG_TO_ENV = {
+                "cycle_time_ms": ("HVD_CYCLE_TIME_MS", str),
+                "orphan_knob": ("HVD_ORPHAN", str),
+            }
+            _FILE_SECTIONS = {
+                "params": {"cycle-time-ms": "cycle_time_ms"},
+            }
+            """))
+    vs = _by_rule(hvdlint.run(str(root)), "config-parity")
+    assert {v.symbol for v in vs} == {"orphan_knob"}
+    msgs = " | ".join(v.message for v in vs)
+    assert "no CLI flag" in msgs and "no YAML key" in msgs
+    assert all(v.path == os.path.join(
+        "horovod_tpu", "runner", "config_parser.py") for v in vs)
+
+
+def test_yaml_key_without_env_mapping_is_reported(tmp_path):
+    root = _seed_repo(tmp_path)
+    (root / "horovod_tpu" / "runner" / "config_parser.py").write_text(
+        textwrap.dedent("""\
+            ARG_TO_ENV = {
+                "cycle_time_ms": ("HVD_CYCLE_TIME_MS", str),
+            }
+            _FILE_SECTIONS = {
+                "params": {"cycle-time-ms": "cycle_time_ms",
+                           "ghost-key": "ghost_attr"},
+            }
+            """))
+    vs = _by_rule(hvdlint.run(str(root)), "config-parity")
+    assert [v.symbol for v in vs] == ["ghost_attr"]
+    assert "missing from ARG_TO_ENV" in vs[0].message
+
+
+def test_stray_getenv_is_reported(tmp_path):
+    root = _seed_repo(tmp_path)
+    tcp = root / "horovod_tpu" / "csrc" / "tcp.cc"
+    tcp.write_text('const char* s = std::getenv("PATH");\n')
+    vs = _by_rule(hvdlint.run(str(root)), "raw-getenv")
+    assert len(vs) == 1
+    assert vs[0].path == os.path.join("horovod_tpu", "csrc", "tcp.cc")
+    assert vs[0].line == 1
+    assert "EnvRaw" in vs[0].message
+    # logging.h itself stays exempt (EnvRaw's implementation site).
+    assert not any(v.path.endswith("logging.h") for v in vs)
+
+
+def test_missing_arm_stats_is_reported(tmp_path):
+    root = _seed_repo(tmp_path)
+    (root / "horovod_tpu" / "csrc" / "common.h").write_text(
+        "struct Tuned { int8_t tuned_cache; int8_t tuned_newarm; };\n")
+    vs = _by_rule(hvdlint.run(str(root)), "arm-stats")
+    assert [v.symbol for v in vs] == ["tuned_newarm"]
+    assert "newarm_stats()" in vs[0].message
+
+
+def test_counter_after_complete_is_reported(tmp_path):
+    root = _seed_repo(tmp_path)
+    (root / "horovod_tpu" / "csrc" / "core.cc").write_text(
+        textwrap.dedent("""\
+            #include "logging.h"
+            void ExecAllreduce() {
+              CompleteHandle(h);
+              g->zerocopy_total++;
+              return;
+            }
+            """))
+    vs = _by_rule(hvdlint.run(str(root)), "counter-order")
+    assert len(vs) == 1
+    assert "AFTER CompleteHandle" in vs[0].message
+    assert vs[0].path == os.path.join("horovod_tpu", "csrc", "core.cc")
+
+
+def test_counter_order_segments_reset_at_return(tmp_path):
+    # A counter on a LATER return-delimited path must not be graded
+    # against an earlier path's CompleteHandle.
+    root = _seed_repo(tmp_path)
+    (root / "horovod_tpu" / "csrc" / "core.cc").write_text(
+        textwrap.dedent("""\
+            #include "logging.h"
+            void ExecAllreduce() {
+              if (fast) {
+                CompleteHandle(h);
+                return;
+              }
+              g->staged_total++;
+              CompleteHandle(h);
+              return;
+            }
+            """))
+    assert _by_rule(hvdlint.run(str(root)), "counter-order") == []
+
+
+def test_renamed_exec_allreduce_fails_loud(tmp_path):
+    # If the anchor function disappears the check must FAIL, not silently
+    # grade nothing.
+    root = _seed_repo(tmp_path)
+    (root / "horovod_tpu" / "csrc" / "core.cc").write_text(
+        "void ExecReduceV2() {}\n")
+    vs = _by_rule(hvdlint.run(str(root)), "counter-order")
+    assert len(vs) == 1
+    assert "not found" in vs[0].message
+
+
+@pytest.mark.parametrize("snippet,knob", [
+    ('import os\nv = os.getenv("HVD_GETENV_FORM")\n', "HVD_GETENV_FORM"),
+    ('import os as _os\nv = _os.environ.get("HVD_ALIASED")\n',
+     "HVD_ALIASED"),
+    ('import os\nv = os.environ["HVD_SUBSCRIPT"]\n', "HVD_SUBSCRIPT"),
+])
+def test_python_read_forms_are_collected(tmp_path, snippet, knob):
+    root = _seed_repo(tmp_path)
+    (root / "horovod_tpu" / "forms.py").write_text(snippet)
+    reads = {k for k, _, _ in hvdlint.collect_knob_reads(str(root))}
+    assert knob in reads

@@ -1,0 +1,83 @@
+"""ASAN and UBSAN tiers of the core sanitizer matrix (ISSUE 6 /
+docs/static_analysis.md). Same worker matrix as test_tsan.py, same shared
+harness (tests/util.py run_under_sanitizer), different instrumentation:
+
+- ASAN (+LeakSanitizer): heap misuse and leaks — the handle table's
+  core-owned output buffers (NewHandle/CompleteHandle/hvd_release) and the
+  scatter-gather iovec path, which sends/recvs straight over user buffers,
+  are the paths where a lifetime bug would hide.
+- UBSAN: shift/overflow/alignment UB — the fp16/bf16 bit-twiddling block
+  converters in reduce.h (mask-blend subnormal handling, unsigned-wrap
+  exponent rebias) are the prime candidates; ring_pipeline_worker drives
+  them across every dtype.
+
+The collective-matrix tests run in tier-1; the deeper per-path runs are
+`slow` (each is a full instrumented rebuild + multi-rank job). `make
+check` (csrc/Makefile) builds every tier outside pytest.
+"""
+import pytest
+
+from .util import assert_sanitizer_clean, run_under_sanitizer
+
+pytestmark = pytest.mark.sanitizer
+
+
+# --- ASAN ------------------------------------------------------------------
+
+def test_core_collective_matrix_under_asan(tmp_path):
+    p, core_reports = run_under_sanitizer(
+        tmp_path, "collective_worker.py", 2, tier="asan")
+    assert_sanitizer_clean(p, 2, core_reports, tier="asan")
+
+
+@pytest.mark.slow
+def test_zerocopy_sg_ring_under_asan(tmp_path):
+    """The scatter-gather ring under ASAN: segmented iovecs over user
+    buffers; an off-by-one in segment math is a heap-buffer-overflow here."""
+    p, core_reports = run_under_sanitizer(
+        tmp_path, "zerocopy_worker.py", 2, tier="asan",
+        extra_env={"HVD_ZEROCOPY_THRESHOLD": "16384"})
+    assert_sanitizer_clean(p, 2, core_reports, tier="asan")
+
+
+@pytest.mark.slow
+def test_reinit_under_asan(tmp_path):
+    """Rapid init/shutdown cycles under LeakSanitizer: every cycle tears
+    down sockets, the handle table, and core-owned gather outputs — the
+    paths that would accrete if a release were missed."""
+    import secrets
+
+    p, core_reports = run_under_sanitizer(
+        tmp_path, "reinit_worker.py", 4, tier="asan",
+        extra_env={"HVD_RENDEZVOUS_SECRET": secrets.token_hex(16),
+                   "REINIT_CYCLES": "2"})
+    assert_sanitizer_clean(p, 4, core_reports, tier="asan")
+
+
+# --- UBSAN -----------------------------------------------------------------
+
+def test_core_collective_matrix_under_ubsan(tmp_path):
+    p, core_reports = run_under_sanitizer(
+        tmp_path, "collective_worker.py", 2, tier="ubsan")
+    assert_sanitizer_clean(p, 2, core_reports, tier="ubsan")
+
+
+@pytest.mark.slow
+def test_fp16_bf16_converters_under_ubsan(tmp_path):
+    """The streamed ring across every dtype under UBSAN: the branchless
+    fp16/bf16 block converters shift and rebias exponent fields with
+    mask arithmetic — exactly where an invalid-shift-exponent or signed
+    overflow would sit."""
+    p, core_reports = run_under_sanitizer(
+        tmp_path, "ring_pipeline_worker.py", 2, tier="ubsan",
+        extra_env={"HVD_RING_PIPELINE": "4",
+                   "HVD_ZEROCOPY_THRESHOLD": "16384"})
+    assert_sanitizer_clean(p, 2, core_reports, tier="ubsan")
+
+
+@pytest.mark.slow
+def test_zerocopy_sg_ring_under_ubsan(tmp_path):
+    p, core_reports = run_under_sanitizer(
+        tmp_path, "zerocopy_worker.py", 2, tier="ubsan",
+        extra_env={"HVD_ZEROCOPY_THRESHOLD": "16384"})
+    assert_sanitizer_clean(p, 2, core_reports, tier="ubsan")

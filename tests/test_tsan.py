@@ -6,78 +6,25 @@ queues — is exactly what TSAN validates cheaply).
 Builds libhvd_tpu_tsan.so (`make tsan`), preloads libtsan into python,
 points HVD_LIB at the instrumented core, and runs multi-rank jobs. Any
 data race inside the core shows up as a ThreadSanitizer report naming
-hvd:: frames / the tsan lib.
+hvd:: frames / the tsan lib. The build/preload/report plumbing is the
+shared sanitizer harness in tests/util.py, which test_sanitizers.py
+reuses for the ASAN/UBSAN tiers (docs/static_analysis.md).
 """
-import os
-import subprocess
-import sys
-
 import pytest
 
-from .util import _REPO, WORKERS
+from .util import assert_sanitizer_clean, run_under_sanitizer
 
-CSRC = os.path.join(_REPO, "horovod_tpu", "csrc")
-TSAN_CORE = os.path.join(_REPO, "horovod_tpu", "lib", "libhvd_tpu_tsan.so")
-
-
-def _libtsan():
-    try:
-        out = subprocess.run(["gcc", "-print-file-name=libtsan.so"],
-                             capture_output=True, text=True, check=True)
-        path = out.stdout.strip()
-        return path if os.path.isabs(path) and os.path.exists(path) else None
-    except Exception:
-        return None
+pytestmark = pytest.mark.sanitizer
 
 
 def _run_under_tsan(tmp_path, worker, np_, extra_env=None):
-    """Shared harness: instrumented core + preload, run `worker` with
-    np_ ranks, return (proc, core_reports)."""
-    libtsan = _libtsan()
-    if libtsan is None:
-        pytest.skip("gcc/libtsan unavailable")
-    subprocess.run(["make", "-s", "tsan"], cwd=CSRC, check=True)
-
-    env = dict(os.environ)
-    env.update({
-        "PYTHONPATH": _REPO,
-        "JAX_PLATFORMS": "cpu",
-        "LD_PRELOAD": libtsan,
-        "HVD_LIB": TSAN_CORE,
-        # exitcode=0: we grade on the reports we parse, so an unrelated
-        # race in a third-party lib can't fail the job spuriously.
-        # log_path=%p-suffixed files: all ranks share the runner's stderr
-        # pipe, where concurrent reports could interleave and tear past
-        # the 'hvd' filter below.
-        "TSAN_OPTIONS": f"exitcode=0:log_path={tmp_path}/tsan",
-    })
-    env.update({k: str(v) for k, v in (extra_env or {}).items()})
-    p = subprocess.run(
-        [sys.executable, "-m", "horovod_tpu.runner.local", "-np",
-         str(np_), sys.executable, os.path.join(WORKERS, worker)],
-        env=env, capture_output=True, text=True, timeout=600)
-    # A failed preload runs everything UNinstrumented with exit 0 — a
-    # green result would be vacuous. ld.so names the failure on stderr.
-    assert "cannot be preloaded" not in p.stderr, p.stderr[-2000:]
-
-    reports = []
-    for f in os.listdir(tmp_path):
-        if f.startswith("tsan."):
-            with open(os.path.join(tmp_path, f)) as fh:
-                text = fh.read()
-            reports += [b for b in text.split("==================")
-                        if "WARNING: ThreadSanitizer" in b]
-    core_reports = [b for b in reports
-                    if "hvd" in b or "libhvd_tpu_tsan" in b]
-    return p, core_reports
+    return run_under_sanitizer(tmp_path, worker, np_, tier="tsan",
+                               extra_env=extra_env)
 
 
 def test_core_collective_matrix_under_tsan(tmp_path):
     p, core_reports = _run_under_tsan(tmp_path, "collective_worker.py", 2)
-    assert p.returncode == 0, p.stderr[-3000:]
-    assert p.stdout.count("PASS") == 2, p.stdout
-    assert not core_reports, "TSAN races in the core:\n" + \
-        "\n".join(core_reports[:3])
+    assert_sanitizer_clean(p, 2, core_reports, tier="tsan")
 
 
 def test_zerocopy_sg_ring_under_tsan(tmp_path):
@@ -89,10 +36,7 @@ def test_zerocopy_sg_ring_under_tsan(tmp_path):
     p, core_reports = _run_under_tsan(
         tmp_path, "zerocopy_worker.py", 2,
         extra_env={"HVD_ZEROCOPY_THRESHOLD": "16384"})
-    assert p.returncode == 0, p.stderr[-3000:]
-    assert p.stdout.count("PASS") == 2, p.stdout
-    assert not core_reports, "TSAN races in the core:\n" + \
-        "\n".join(core_reports[:3])
+    assert_sanitizer_clean(p, 2, core_reports, tier="tsan")
 
 
 def test_reinit_and_auth_under_tsan(tmp_path):
@@ -107,10 +51,7 @@ def test_reinit_and_auth_under_tsan(tmp_path):
         tmp_path, "reinit_worker.py", 4,
         extra_env={"HVD_RENDEZVOUS_SECRET": secrets.token_hex(16),
                    "REINIT_CYCLES": "2"})
-    assert p.returncode == 0, p.stderr[-3000:]
-    assert p.stdout.count("PASS") == 4, p.stdout
-    assert not core_reports, "TSAN races in the core:\n" + \
-        "\n".join(core_reports[:3])
+    assert_sanitizer_clean(p, 4, core_reports, tier="tsan")
 
 
 def test_streamed_ring_reduce_under_tsan(tmp_path):
@@ -125,7 +66,4 @@ def test_streamed_ring_reduce_under_tsan(tmp_path):
         tmp_path, "ring_pipeline_worker.py", 2,
         extra_env={"HVD_RING_PIPELINE": "4",
                    "HVD_ZEROCOPY_THRESHOLD": "16384"})
-    assert p.returncode == 0, p.stderr[-3000:]
-    assert p.stdout.count("PASS") == 2, p.stdout
-    assert not core_reports, "TSAN races in the core:\n" + \
-        "\n".join(core_reports[:3])
+    assert_sanitizer_clean(p, 2, core_reports, tier="tsan")
