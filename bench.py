@@ -666,27 +666,38 @@ def _bench_hostplane():
     memory system among all ranks, so this is a scaling *signal*, not an
     ICI-peak claim.
 
-    Runs the pod twice — streamed ring reduce-scatter (HVD_RING_PIPELINE
-    auto) vs forced serial (=1) — so the record carries the pipelined-vs-
-    serial A/B (ISSUE 5 acceptance). On a 1-core box the two are expected
-    to tie (the overlap has no second core to hide work on); the headline
-    value stays the pipelined figure."""
+    Runs the pod three times (ISSUE 5 + ISSUE 7 acceptance A/Bs):
+    streamed ring reduce-scatter over pure TCP (HVD_SHM=0, pipeline
+    auto), forced-serial pure TCP (=1), and the shared-memory
+    hierarchical compose (HVD_SHM=1 + HVD_HIERARCHICAL_ALLREDUCE=1 —
+    intra-host pointer handoff through /dev/shm slots). On a 1-core box
+    pipelined vs serial are expected to tie (the overlap has no second
+    core to hide work on); shm must still win — it removes the two
+    socket copies per exchange, not just overlaps them. The headline
+    value is the shm figure; the record carries both speedups plus the
+    shm counter proofs (bytes moved > 0, staged copies == 0)."""
     import tempfile
 
     from horovod_tpu.runner.local import run_local
 
     np_ = int(os.environ.get("BENCH_HOSTPLANE_RANKS", "8"))
+    modes = (
+        ("pipelined", {"HVD_RING_PIPELINE": "0", "HVD_SHM": "0"}),
+        ("serial", {"HVD_RING_PIPELINE": "1", "HVD_SHM": "0"}),
+        ("shm", {"HVD_SHM": "1", "HVD_HIERARCHICAL_ALLREDUCE": "1"}),
+    )
     runs = {}
-    for mode, depth in (("pipelined", "0"), ("serial", "1")):
+    for mode, mode_env in modes:
         fd, out_path = tempfile.mkstemp(prefix="hvd_bench_hostplane_")
         os.close(fd)
         try:
             env = {"PYTHONPATH":
                    _repo_pythonpath(os.environ.get("PYTHONPATH")),
                    "JAX_PLATFORMS": "cpu",
-                   "HVD_RING_PIPELINE": depth,
                    "_BENCH_HOSTPLANE_WORKER": "1",
+                   "_BENCH_HOSTPLANE_MODE": mode,
                    "_BENCH_HOSTPLANE_OUT": out_path}
+            env.update(mode_env)
             codes = run_local(np_,
                               [sys.executable, os.path.abspath(__file__)],
                               env=env, timeout=90)
@@ -699,12 +710,19 @@ def _bench_hostplane():
                 os.unlink(out_path)
             except OSError:
                 pass
-    d = runs["pipelined"]
-    serial = runs["serial"]
+    d = runs["shm"]
+    flat, serial = runs["pipelined"], runs["serial"]
+    d["flat_tcp_gbps"] = flat["value"]
     d["serial_gbps"] = serial["value"]
-    d["pipeline_speedup"] = (round(d["value"] / serial["value"], 3)
+    d["pipeline_speedup"] = (round(flat["value"] / serial["value"], 3)
                              if serial["value"] > 0 else None)
+    d["shm_speedup"] = (round(d["value"] / flat["value"], 3)
+                        if flat["value"] > 0 else None)
     assert serial.get("stream_steps", 0) == 0, serial
+    # ISSUE 7 counter proofs: the shm run moved real bytes through the
+    # plane with zero staging copies; the TCP runs never touched it.
+    assert d.get("shm_bytes", 0) > 0 and d.get("shm_staged") == 0, d
+    assert flat.get("shm_bytes", 0) == 0, flat
     return d
 
 
@@ -716,9 +734,15 @@ def _hostplane_worker():
 
     hvd.init()
     r, s = hvd.rank(), hvd.size()
+    mode = os.environ.get("_BENCH_HOSTPLANE_MODE", "pipelined")
     n = int(os.environ.get("_BENCH_HOSTPLANE_FLOATS",
                            str(4 * 1024 * 1024)))  # 16 MB fp32
     x = np.full(n, float(r), np.float32)
+    # Parity proof for the A/B: every transport mode must produce the
+    # exact staged-ring result before it is allowed to post a number.
+    chk = hvd.allreduce(np.full(1024, float(r + 1), np.float32),
+                        op=hvd.Sum, name="hostplane.parity")
+    assert np.allclose(chk, s * (s + 1) / 2.0), (mode, chk[:4])
     for _ in range(3):
         hvd.allreduce(x, op=hvd.Sum, name="hostplane.bw")
     hvd.barrier()
@@ -729,6 +753,8 @@ def _hostplane_worker():
         hvd.allreduce(x, op=hvd.Sum, name="hostplane.bw")
     dt = time.perf_counter() - t0
     steps1, _, serial1, us1 = hvd.pipeline_stats()
+    shm_ops, shm_bytes, _, shm_staged = hvd.shm_stats()
+    pool_threads, pool_jobs, _ = hvd.reduce_pool_stats()
     if r == 0:
         alg = x.nbytes * iters / dt / 1e9
         bus = alg * 2.0 * (s - 1) / s
@@ -740,13 +766,21 @@ def _hostplane_worker():
             # exactly the serialization signature).
             json.dump({"metric": "allreduce_hostplane_bus_bandwidth",
                        "value": round(bus, 3),
-                       "unit": "GB/s (bus bw, loopback TCP)",
+                       "unit": "GB/s (bus bw, loopback)",
+                       "mode": mode,
                        "alg_gbps": round(alg, 3), "n_ranks": s,
+                       "cpu_count": os.cpu_count(),
                        "cpu_cores": len(os.sched_getaffinity(0)),
+                       "reduce_threads": pool_threads,
+                       "reduce_affinity":
+                           sorted(os.sched_getaffinity(0)),
+                       "reduce_pool_jobs": pool_jobs,
                        "nbytes": x.nbytes, "iters": iters,
                        "stream_steps": steps1 - steps0,
                        "serial_steps": serial1 - serial0,
                        "overlap_ms": round((us1 - us0) / 1e3, 1),
+                       "shm_ops": shm_ops, "shm_bytes": shm_bytes,
+                       "shm_staged": shm_staged,
                        "vs_baseline": 1.0}, f)
     hvd.barrier()
     hvd.shutdown()

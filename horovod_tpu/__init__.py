@@ -146,6 +146,9 @@ reduce_stats = _basics.reduce_stats
 reduce_bench = _basics.reduce_bench
 pipeline_stats = _basics.pipeline_stats
 pipeline_state = _basics.pipeline_state
+shm_stats = _basics.shm_stats
+shm_state = _basics.shm_state
+reduce_pool_stats = _basics.reduce_pool_stats
 hier_stats = _basics.hier_stats
 lockdep_stats = _basics.lockdep_stats
 lockdep_report = _basics.lockdep_report

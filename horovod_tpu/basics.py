@@ -175,6 +175,12 @@ _lib.hvd_pipeline_stats.restype = c_int
 _lib.hvd_pipeline_stats.argtypes = [P_int64, P_int64, P_int64, P_int64]
 _lib.hvd_pipeline_state.restype = c_int
 _lib.hvd_pipeline_state.argtypes = [P_int64]
+_lib.hvd_shm_stats.restype = c_int
+_lib.hvd_shm_stats.argtypes = [P_int64, P_int64, P_int64, P_int64]
+_lib.hvd_shm_state.restype = c_int
+_lib.hvd_shm_state.argtypes = [P_int64]
+_lib.hvd_reduce_pool_stats.restype = c_int
+_lib.hvd_reduce_pool_stats.argtypes = [P_int64, P_int64, P_int64]
 _lib.hvd_reduce_bench.restype = c_double
 _lib.hvd_reduce_bench.argtypes = [c_int, c_int64, c_int, c_int]
 _lib.hvd_lockdep_stats.restype = c_int
@@ -378,6 +384,47 @@ class HorovodBasics:
         if v < 0:
             raise ValueError(f"reduce_bench: bad dtype/size ({dtype}, {n})")
         return v
+
+    def shm_stats(self):
+        """(shm_ops, shm_bytes, fallback_ops, staged_copies) for the
+        intra-host shared-memory plane: pointer-handoff exchanges executed
+        over /dev/shm ring segments and their payload bytes, collectives
+        the plane covered but that routed to TCP anyway (disabled or under
+        HVD_SHM_THRESHOLD), and intermediate copies on the shm path — 0 by
+        construction; the acceptance tests pin it there."""
+        ops = c_int64(0)
+        nbytes = c_int64(0)
+        fallback = c_int64(0)
+        staged = c_int64(0)
+        rc = _lib.hvd_shm_stats(
+            ctypes.byref(ops), ctypes.byref(nbytes),
+            ctypes.byref(fallback), ctypes.byref(staged))
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        return ops.value, nbytes.value, fallback.value, staged.value
+
+    def shm_state(self):
+        """(enabled, threshold_bytes): whether same-host collectives are
+        currently routed over the shm plane (segments mapped AND the
+        HVD_SHM / autotune `shm` arm toggle on) and the minimum payload
+        that leaves TCP (HVD_SHM_THRESHOLD)."""
+        threshold = c_int64(0)
+        rc = _lib.hvd_shm_state(ctypes.byref(threshold))
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        return bool(rc), threshold.value
+
+    def reduce_pool_stats(self):
+        """(threads, jobs, spans): configured reduce-pool lanes
+        (HVD_REDUCE_THREADS), reductions large enough to fan out across
+        the pool, and element spans executed on worker lanes. Works
+        without init — the pool is process-global."""
+        threads = c_int64(0)
+        jobs = c_int64(0)
+        spans = c_int64(0)
+        _lib.hvd_reduce_pool_stats(ctypes.byref(threads), ctypes.byref(jobs),
+                                   ctypes.byref(spans))
+        return threads.value, jobs.value, spans.value
 
     def hier_stats(self):
         """(hierarchical_ops, ring_ops): allreduce responses executed by the

@@ -434,9 +434,44 @@ void DataPlane::RingAllreduce(void* buf, int64_t nelem, DataType dtype,
   size_t esz = DataTypeSize(dtype);
   auto lens = SplitChunks(nelem, m);
   auto off = Offsets(lens);
+  uint8_t* p = (uint8_t*)buf;
+
+  if (UseShm(members, nelem * (int64_t)esz)) {
+    // Same-host ring over pointer handoffs: both phases consume the
+    // peer's slot in place (reduce into the owned chunk, then copy the
+    // finished chunk) — no scratch buffer, no socket copies.
+    int64_t t0 = MonoUs();
+    int to = members[(my + 1) % m], from = members[(my - 1 + m) % m];
+    for (int s = 0; s < m - 1; s++) {
+      int sc = ((my - s) % m + m) % m;
+      int rc = ((my - s - 1) % m + m) % m;
+      uint8_t* dst = p + off[rc] * esz;
+      bool ok = shm_.Exchange(
+          to, p + off[sc] * esz, lens[sc] * (int64_t)esz, from,
+          lens[rc] * (int64_t)esz, poll_timeout_ms_,
+          [&](const uint8_t* ptr, int64_t len, int64_t boff) {
+            PoolAccumulate(dst + boff, ptr, (int64_t)(len / esz), dtype, op);
+          });
+      if (!ok) throw std::runtime_error("shm allreduce exchange failed");
+    }
+    for (int s = 0; s < m - 1; s++) {
+      int sc = ((my + 1 - s) % m + m) % m;
+      int rc = ((my - s) % m + m) % m;
+      uint8_t* dst = p + off[rc] * esz;
+      bool ok = shm_.Exchange(
+          to, p + off[sc] * esz, lens[sc] * (int64_t)esz, from,
+          lens[rc] * (int64_t)esz, poll_timeout_ms_,
+          [&](const uint8_t* ptr, int64_t len, int64_t boff) {
+            memcpy(dst + boff, ptr, (size_t)len);
+          });
+      if (!ok) throw std::runtime_error("shm allreduce exchange failed");
+    }
+    stat_shm_us += MonoUs() - t0;
+    return;
+  }
+
   int64_t max_len = *std::max_element(lens.begin(), lens.end());
   std::vector<uint8_t> tmp((size_t)max_len * esz);
-  uint8_t* p = (uint8_t*)buf;
 
   // Phase 1: reduce-scatter. After m-1 steps, member i owns the complete
   // reduction of chunk (i+1) mod m. When the pipeline is on, each received
@@ -450,7 +485,7 @@ void DataPlane::RingAllreduce(void* buf, int64_t nelem, DataType dtype,
     if (block == 0) {
       FullDuplex(next, p + off[sc] * esz, (size_t)lens[sc] * esz, prev,
                  tmp.data(), rbytes);
-      Accumulate(p + off[rc] * esz, tmp.data(), lens[rc], dtype, op);
+      PoolAccumulate(p + off[rc] * esz, tmp.data(), lens[rc], dtype, op);
       stat_serial_steps++;
     } else {
       uint8_t* dst = p + off[rc] * esz;
@@ -458,8 +493,8 @@ void DataPlane::RingAllreduce(void* buf, int64_t nelem, DataType dtype,
                        tmp.data(), rbytes, block,
                        [&](size_t boff, size_t blen) {
                          int64_t t0 = MonoUs();
-                         Accumulate(dst + boff, tmp.data() + boff,
-                                    (int64_t)(blen / esz), dtype, op);
+                         PoolAccumulate(dst + boff, tmp.data() + boff,
+                                        (int64_t)(blen / esz), dtype, op);
                          stat_overlap_us += MonoUs() - t0;
                          stat_stream_blocks++;
                        });
@@ -518,7 +553,7 @@ void DataPlane::RingAllreduceSG(const std::vector<Segment>& in,
       const uint8_t* t = tmp.data();
       ForEachSpan(in, out, off[rc], lens[rc], esz,
                   [&](uint8_t* o, const uint8_t* a, int64_t n) {
-                    AccumulateTo(o, a, t, n, dtype, op);
+                    PoolAccumulateTo(o, a, t, n, dtype, op);
                     t += (size_t)n * esz;
                   });
       stat_serial_steps++;
@@ -534,7 +569,7 @@ void DataPlane::RingAllreduceSG(const std::vector<Segment>& in,
             ForEachSpan(in, out, off[rc] + (int64_t)(boff / esz),
                         (int64_t)(blen / esz), esz,
                         [&](uint8_t* o, const uint8_t* a, int64_t n) {
-                          AccumulateTo(o, a, t, n, dtype, op);
+                          PoolAccumulateTo(o, a, t, n, dtype, op);
                           t += (size_t)n * esz;
                         });
             stat_overlap_us += MonoUs() - t0;
@@ -563,8 +598,15 @@ void DataPlane::HierarchicalAllreduce(void* buf, int64_t nelem,
   int m = (int)members.size();
   if (m <= 1 || nelem == 0) return;
   int groups = local_size > 0 ? m / local_size : 0;
-  if (local_size <= 1 || groups <= 1 || m % local_size != 0 ||
-      nelem < local_size) {
+  // A single-host set (groups == 1) still benefits from the hierarchical
+  // decomposition when the local phases ride the shm plane: reduce-scatter
+  // + allgather over pointer handoffs, with a no-op cross phase. Without
+  // shm it degenerates to extra memcpys, so fall back to the flat ring.
+  size_t hesz = DataTypeSize(dtype);
+  bool single_host_shm =
+      groups == 1 && ShmRouted(members, nelem * (int64_t)hesz);
+  if (local_size <= 1 || m % local_size != 0 || nelem < local_size ||
+      (groups <= 1 && !single_host_shm)) {
     RingAllreduce(buf, nelem, dtype, op, members);
     return;
   }
@@ -608,6 +650,26 @@ void DataPlane::RingAllgatherv(const void* my_data, void* out,
   if (bytes_per_member[my] > 0 && my_data != o + off[my])
     memcpy(o + off[my], my_data, (size_t)bytes_per_member[my]);
   if (m <= 1) return;
+  if (UseShm(members, off[m])) {
+    int to = members[(my + 1) % m], from = members[(my - 1 + m) % m];
+    int64_t t0 = MonoUs();
+    for (int s = 0; s < m - 1; s++) {
+      int sc = ((my - s) % m + m) % m;
+      int rc = ((my - s - 1) % m + m) % m;
+      uint8_t* dst = o + off[rc];
+      bool ok = shm_.Exchange(
+          to, o + off[sc], bytes_per_member[sc], from, bytes_per_member[rc],
+          poll_timeout_ms_,
+          [&](const uint8_t* ptr, int64_t len, int64_t boff) {
+            // Slot-to-destination is the one required copy (the readv
+            // equivalent); there is no staging buffer in between.
+            memcpy(dst + boff, ptr, (size_t)len);
+          });
+      if (!ok) throw std::runtime_error("shm allgather exchange failed");
+    }
+    stat_shm_us += MonoUs() - t0;
+    return;
+  }
   Socket& next = peer(members[(my + 1) % m]);
   Socket& prev = peer(members[(my - 1 + m) % m]);
   // Ring: at step s, forward chunk (my - s) and receive chunk (my - s - 1).
@@ -679,6 +741,31 @@ void DataPlane::RingReduceScatter(void* work, void* out,
     if (chunk_elems[0] > 0) memcpy(out, p, (size_t)chunk_elems[0] * esz);
     return;
   }
+  int64_t total = 0;
+  for (int64_t c : chunk_elems) total += c;
+  if (UseShm(members, total * (int64_t)esz)) {
+    // Host-plane path: the received sub-chunk is reduced straight out of
+    // the peer's mapped slot (pointer handoff), sharded across the reduce
+    // pool — no scratch buffer, no socket copies.
+    int to = members[(my + 1) % m], from = members[(my - 1 + m) % m];
+    int64_t t0 = MonoUs();
+    for (int s = 0; s < m - 1; s++) {
+      int sc = ((my - s - 1) % m + m) % m;
+      int rc = ((my - s - 2) % m + m) % m;
+      uint8_t* dst = p + off[rc] * esz;
+      bool ok = shm_.Exchange(
+          to, p + off[sc] * esz, chunk_elems[sc] * (int64_t)esz, from,
+          chunk_elems[rc] * (int64_t)esz, poll_timeout_ms_,
+          [&](const uint8_t* ptr, int64_t len, int64_t boff) {
+            PoolAccumulate(dst + boff, ptr, len / (int64_t)esz, dtype, op);
+          });
+      if (!ok) throw std::runtime_error("shm reduce-scatter exchange failed");
+    }
+    stat_shm_us += MonoUs() - t0;
+    if (chunk_elems[my] > 0)
+      memcpy(out, p + off[my] * esz, (size_t)chunk_elems[my] * esz);
+    return;
+  }
   Socket& next = peer(members[(my + 1) % m]);
   Socket& prev = peer(members[(my - 1 + m) % m]);
   int64_t max_len = *std::max_element(chunk_elems.begin(), chunk_elems.end());
@@ -690,7 +777,7 @@ void DataPlane::RingReduceScatter(void* work, void* out,
     int rc = ((my - s - 2) % m + m) % m;
     FullDuplex(next, p + off[sc] * esz, (size_t)chunk_elems[sc] * esz, prev,
                tmp.data(), (size_t)chunk_elems[rc] * esz);
-    Accumulate(p + off[rc] * esz, tmp.data(), chunk_elems[rc], dtype, op);
+    PoolAccumulate(p + off[rc] * esz, tmp.data(), chunk_elems[rc], dtype, op);
   }
   if (chunk_elems[my] > 0)
     memcpy(out, p + off[my] * esz, (size_t)chunk_elems[my] * esz);

@@ -16,6 +16,7 @@
 #include <vector>
 
 #include "common.h"
+#include "shm.h"
 #include "tcp.h"
 
 namespace hvd {
@@ -60,6 +61,17 @@ class DataPlane {
   void set_pipeline(int depth) { pipeline_ = depth < 0 ? 0 : depth; }
   int pipeline() const { return pipeline_; }
 
+  // Intra-host shared-memory plane (shm.h). Established at mesh time when
+  // same-host peers exist; `enabled` follows HVD_SHM and the autotune shm
+  // arm; messages below `threshold` bytes stay on TCP (HVD_SHM_THRESHOLD).
+  ShmPlane& shm() { return shm_; }
+  void set_shm_enabled(bool on) { shm_enabled_ = on; }
+  bool shm_enabled() const { return shm_enabled_; }
+  void set_shm_threshold(int64_t bytes) {
+    shm_threshold_ = bytes < 0 ? 0 : bytes;
+  }
+  int64_t shm_threshold() const { return shm_threshold_; }
+
   // Pipeline proof counters. Background-thread-only writes (plain int64s,
   // not atomics); core.cc snapshots deltas into Global's atomic counters
   // BEFORE completing handles, per the established counter/completion
@@ -68,6 +80,12 @@ class DataPlane {
   int64_t stat_stream_blocks = 0;  // sub-block reductions fired in-loop
   int64_t stat_serial_steps = 0;   // RS steps that ran the serial path
   int64_t stat_overlap_us = 0;     // µs spent reducing inside the poll loop
+
+  // Shm proof counters (same background-thread-only contract). Transfer
+  // ops/bytes/staged-copies live on the ShmPlane itself; these two track
+  // the routing decisions and the time spent inside shm exchanges.
+  int64_t stat_shm_fallback = 0;  // covered by the plane, but routed to TCP
+  int64_t stat_shm_us = 0;        // µs inside shm exchange phases
 
   // In-place ring allreduce over `members` (sorted global ranks incl. self).
   // buf holds nelem elements of dtype; op applied elementwise.
@@ -161,10 +179,28 @@ class DataPlane {
   // pipeline_; 0 means run the serial path (depth 1 or chunk too small).
   size_t StreamBlockBytes(size_t chunk_bytes, size_t esz) const;
 
+  // Shm routing decision for a `bytes`-byte collective over `members`.
+  // ShmRouted is the pure predicate; UseShm additionally counts a
+  // covered-but-declined routing as a fallback (stat_shm_fallback).
+  bool ShmRouted(const std::vector<int32_t>& members, int64_t bytes) const {
+    return shm_enabled_ && bytes >= shm_threshold_ && shm_.Covers(members);
+  }
+  bool UseShm(const std::vector<int32_t>& members, int64_t bytes) {
+    if (!shm_.Covers(members)) return false;
+    if (!shm_enabled_ || bytes < shm_threshold_) {
+      stat_shm_fallback++;
+      return false;
+    }
+    return true;
+  }
+
   int rank_ = 0;
   int size_ = 1;
   int poll_timeout_ms_ = 300000;
   int pipeline_ = 0;
+  ShmPlane shm_;
+  bool shm_enabled_ = false;
+  int64_t shm_threshold_ = 0;
   std::vector<Socket> peers_;
 };
 

@@ -89,6 +89,8 @@ struct Global {
   // topology (rank 0 validates and broadcasts) — guarantees all ranks take
   // the same allreduce branch.
   bool hier_ok = false;
+  bool topo_explicit = false;  // HVD_LOCAL_SIZE was set, not defaulted
+
 
   TensorQueue queue;
   DataPlane data;
@@ -156,6 +158,26 @@ struct Global {
   std::atomic<int64_t> pipeline_stream_blocks{0};
   std::atomic<int64_t> pipeline_serial_steps{0};
   std::atomic<int64_t> pipeline_overlap_us{0};
+
+  // Intra-host shared-memory plane (shm.h). shm_allowed is the HVD_SHM
+  // master switch; the enabled/threshold runtime state lives on DataPlane
+  // (the autotune shm arm flips it via ResponseList.tuned_shm). Geometry
+  // knobs are parsed in hvd_init and consumed by EstablishMesh. Counters
+  // snapshot ShmPlane/DataPlane's background-thread-only stats, readable
+  // from user threads via hvd_shm_stats.
+  bool shm_allowed = true;
+  int64_t shm_slot_bytes = 512 * 1024;
+  int shm_nslots = 4;
+  std::atomic<int64_t> shm_ops_total{0};
+  std::atomic<int64_t> shm_bytes_total{0};
+  std::atomic<int64_t> shm_staged_total{0};
+  std::atomic<int64_t> shm_fallback_total{0};
+  std::atomic<int64_t> shm_us_total{0};
+
+  // Reduce worker pool lanes (HVD_REDUCE_THREADS); the pool itself is
+  // process-global (reduce.h GlobalReducePool) so the microbench can use
+  // it without a job up.
+  int reduce_threads = 1;
 
   std::thread background;
 
@@ -289,19 +311,35 @@ bool UseZeroCopy(bool sg_ok, int64_t bytes, const Response& resp, int m) {
 // counters — and overlap_us() sizes the TCP_REDUCE_OVERLAP timeline
 // sub-span (the slice of the ring span spent reducing inside the poll
 // loop).
+// The same scope also snapshots the shm host-plane counters: shm_us()
+// sizes the TCP_SHM_EXCHANGE timeline sub-span, and Publish() folds the
+// op/byte/staged/fallback deltas into Global under the same
+// counters-before-CompleteHandle rule.
 struct PipelineScope {
   int64_t steps0, blocks0, serial0, us0;
+  int64_t shm_ops0, shm_bytes0, shm_staged0, shm_fb0, shm_us0;
   PipelineScope()
       : steps0(g->data.stat_stream_steps),
         blocks0(g->data.stat_stream_blocks),
         serial0(g->data.stat_serial_steps),
-        us0(g->data.stat_overlap_us) {}
+        us0(g->data.stat_overlap_us),
+        shm_ops0(g->data.shm().stat_tx_ops),
+        shm_bytes0(g->data.shm().stat_tx_bytes),
+        shm_staged0(g->data.shm().stat_staged_copies),
+        shm_fb0(g->data.stat_shm_fallback),
+        shm_us0(g->data.stat_shm_us) {}
   int64_t overlap_us() const { return g->data.stat_overlap_us - us0; }
+  int64_t shm_us() const { return g->data.stat_shm_us - shm_us0; }
   void Publish() const {
     g->pipeline_stream_steps += g->data.stat_stream_steps - steps0;
     g->pipeline_stream_blocks += g->data.stat_stream_blocks - blocks0;
     g->pipeline_serial_steps += g->data.stat_serial_steps - serial0;
     g->pipeline_overlap_us += overlap_us();
+    g->shm_ops_total += g->data.shm().stat_tx_ops - shm_ops0;
+    g->shm_bytes_total += g->data.shm().stat_tx_bytes - shm_bytes0;
+    g->shm_staged_total += g->data.shm().stat_staged_copies - shm_staged0;
+    g->shm_fallback_total += g->data.stat_shm_fallback - shm_fb0;
+    g->shm_us_total += shm_us();
   }
 };
 
@@ -350,6 +388,9 @@ void ExecAllreduce(const Response& resp,
     if (ps.overlap_us() > 0)
       g->timeline.Record(e.req.name, "TCP_REDUCE_OVERLAP", t0,
                          t0 + ps.overlap_us());
+    if (ps.shm_us() > 0)
+      g->timeline.Record(e.req.name, "TCP_SHM_EXCHANGE", t0,
+                         t0 + ps.shm_us());
     if (post != 1.0) ScaleBuffer(e.output, n, resp.dtype, post);
     ps.Publish();
     CompleteHandle(e.handle, Status::Ok());
@@ -436,6 +477,9 @@ void ExecAllreduce(const Response& resp,
       if (ps.overlap_us() > 0)
         g->timeline.Record(e.req.name, "TCP_REDUCE_OVERLAP", t1,
                            t1 + ps.overlap_us());
+      if (ps.shm_us() > 0)
+        g->timeline.Record(e.req.name, "TCP_SHM_EXCHANGE", t1,
+                           t1 + ps.shm_us());
       g->timeline.Record(e.req.name, "MEMCPY_OUT_FUSION_BUFFER", t2, NowUs());
     }
     off += n;
@@ -798,15 +842,17 @@ void AutotuneCycle(ResponseList& rl) {
   if (g->autotune.active()) {
     int64_t fusion;
     double cycle_ms;
-    int cache_on, hier_on, zerocopy_on, pipeline_on;
+    int cache_on, hier_on, zerocopy_on, pipeline_on, shm_on;
     if (g->autotune.Record(PayloadBytes(rl), NowUs(), &fusion, &cycle_ms,
-                           &cache_on, &hier_on, &zerocopy_on, &pipeline_on)) {
+                           &cache_on, &hier_on, &zerocopy_on, &pipeline_on,
+                           &shm_on)) {
       rl.tuned_fusion = fusion;
       rl.tuned_cycle_ms = cycle_ms;
       rl.tuned_cache = (int8_t)cache_on;
       rl.tuned_hier = (int8_t)hier_on;
       rl.tuned_zerocopy = (int8_t)zerocopy_on;
       rl.tuned_pipeline = (int8_t)pipeline_on;
+      rl.tuned_shm = (int8_t)shm_on;
     }
   }
   rl.tuned_locked = !g->autotune.active();
@@ -826,6 +872,11 @@ void ProcessResponseList(ResponseList& rl) {
   // identically on every rank.
   if (rl.tuned_zerocopy >= 0 && g->zerocopy_allowed)
     g->zerocopy_on = rl.tuned_zerocopy != 0;
+  // The shm toggle is stateless in the same way (segments stay mapped;
+  // only the per-collective routing decision flips): adopt up front,
+  // identically on every rank.
+  if (rl.tuned_shm >= 0 && g->shm_allowed)
+    g->data.set_shm_enabled(rl.tuned_shm != 0);
   // The ring-pipeline toggle is stateless too (only the background thread
   // reads the depth, per-collective): arm on restores the user-configured
   // depth (auto unless they pinned one; a user-configured serial depth of
@@ -1026,10 +1077,17 @@ void EstablishMesh() {
   // check cannot do this — on heterogeneous host slot counts some ranks
   // would pick the hierarchical branch and others the flat ring, a
   // split-brain that deadlocks the data plane.
-  auto topo_ok = [&](int r, int lr, int ls, int cr, int cs) {
+  // cs == 1 (all ranks on one host) also validates: the hierarchical
+  // decomposition then runs its local phase over the shm plane and its
+  // cross phase degenerates to a single-member no-op, which is exactly
+  // the intra-host fast path — still uniform, so no split-brain risk.
+  // It requires every rank to have DECLARED its topology though (`ex`):
+  // HVD_LOCAL_SIZE merely defaulting to size would claim single-host for
+  // any launcher that didn't set topology env at all.
+  auto topo_ok = [&](int r, int lr, int ls, int cr, int cs, bool ex) {
     return ls == g->local_size && cs == g->cross_size &&
-           (int64_t)ls * cs == g->size && ls > 1 && cs > 1 &&
-           lr == r % ls && cr == r / ls;
+           (int64_t)ls * cs == g->size && ls > 1 && cs >= 1 &&
+           (cs > 1 || ex) && lr == r % ls && cr == r / ls;
   };
 
   if (g->rank == 0) {
@@ -1040,7 +1098,7 @@ void EstablishMesh() {
     hosts[0] = chost == "0.0.0.0" ? "127.0.0.1" : chost;
     ports[0] = g->data_listener.port();
     bool hier_ok = topo_ok(0, g->local_rank, g->local_size, g->cross_rank,
-                           g->cross_size);
+                           g->cross_size, g->topo_explicit);
     // Accept until every worker rank has a live, authenticated hello.
     // Unauthenticated peers, garbage frames, and half-open connections
     // from a dying epoch are dropped without aborting init; a worker
@@ -1068,8 +1126,9 @@ void EstablishMesh() {
         int r = rd.i32();
         int dport = rd.i32();
         int lr = rd.i32(), ls = rd.i32(), cr = rd.i32(), cs = rd.i32();
+        int ex = rd.i32();
         if (r <= 0 || r >= g->size) continue;  // not a worker hello
-        if (!topo_ok(r, lr, ls, cr, cs)) hier_ok = false;
+        if (!topo_ok(r, lr, ls, cr, cs, ex != 0)) hier_ok = false;
         hosts[r] = PeerAddr(s);
         ports[r] = dport;
         s.SetRecvTimeout(0);  // registered: back to blocking control IO
@@ -1122,6 +1181,7 @@ void EstablishMesh() {
         w.i32(g->local_size);
         w.i32(g->cross_rank);
         w.i32(g->cross_size);
+        w.i32(g->topo_explicit ? 1 : 0);
         c.SendFrame(w.buf);
         auto frame = c.RecvFrame();
         Reader rd(frame.data(), frame.size());
@@ -1215,6 +1275,34 @@ void EstablishMesh() {
   if (dial_err) std::rethrow_exception(dial_err);
   if (accept_err) std::rethrow_exception(accept_err);
   g->data.Init(g->rank, g->size, std::move(peers));
+
+  // Intra-host shm plane: each rank of a same-host block (the validated
+  // host-major slice [host*L, (host+1)*L), or the whole job when it is a
+  // single host) maps its peers' ring segments. Requires the
+  // handshake-validated uniform topology — local_size alone is a per-rank
+  // env claim and cannot prove ranks actually share a host layout. Attach
+  // is HMAC-gated with the job secret (segment names and header tags are
+  // derived from it); without a secret the key is derived from the
+  // controller address so concurrent unauthenticated jobs on one box
+  // still land on distinct, tagged segments. Init failure (exhausted
+  // /dev/shm, mixed versions) degrades to TCP with a warning — never
+  // fails init.
+  if (g->shm_allowed && g->hier_ok && g->local_size > 1) {
+    int L = g->local_size;
+    int host = g->rank / L;
+    std::vector<int> host_ranks(L);
+    for (int i = 0; i < L; i++) host_ranks[i] = host * L + i;
+    std::vector<uint8_t> key = secret;
+    if (key.empty()) {
+      std::string tag = "hvd-shm:" + ctrl;
+      key = Sha256((const uint8_t*)tag.data(), tag.size());
+    }
+    if (!g->data.shm().Init(g->rank, host_ranks, key, ctrl,
+                            g->shm_slot_bytes, g->shm_nslots,
+                            std::max(remaining(), 5.0)))
+      LogF(LogLevel::kWarn,
+           "shm host plane unavailable; intra-host traffic stays on TCP");
+  }
 }
 
 // ---------------------------------------------------------------------------
@@ -1291,6 +1379,10 @@ int hvd_init() {
     InitLoggingFromEnv(g->rank);
     g->local_rank = (int)EnvInt("HVD_LOCAL_RANK", g->rank);
     g->local_size = (int)EnvInt("HVD_LOCAL_SIZE", g->size);
+    // Launcher-declared topology vs the bare defaults above: single-host
+    // hierarchy/shm validation (EstablishMesh's topo_ok) only trusts an
+    // explicit declaration.
+    g->topo_explicit = EnvRaw("HVD_LOCAL_SIZE") != nullptr;
     g->cross_rank = (int)EnvInt("HVD_CROSS_RANK", 0);
     g->cross_size = (int)EnvInt("HVD_CROSS_SIZE", 1);
     g->hierarchical = EnvInt("HVD_HIERARCHICAL_ALLREDUCE", 0) != 0;
@@ -1313,6 +1405,25 @@ int hvd_init() {
     // count per reduce-scatter chunk.
     g->ring_pipeline_cfg = (int)EnvInt("HVD_RING_PIPELINE", 0);
     g->data.set_pipeline(g->ring_pipeline_cfg);
+    // Shm host plane: HVD_SHM=0 kills the plane outright (segments are
+    // never created); HVD_SHM_THRESHOLD (bytes) keeps small messages on
+    // TCP where the syscall already beats the ring-buffer handshake;
+    // HVD_SHM_SLOT_BYTES / HVD_SHM_SLOTS size the per-peer rings that
+    // EstablishMesh maps.
+    g->shm_allowed = EnvInt("HVD_SHM", 1) != 0;
+    g->data.set_shm_enabled(g->shm_allowed);
+    g->data.set_shm_threshold(EnvInt("HVD_SHM_THRESHOLD", 0));
+    g->shm_slot_bytes = EnvInt("HVD_SHM_SLOT_BYTES", 512 * 1024);
+    g->shm_nslots = (int)EnvInt("HVD_SHM_SLOTS", 4);
+    // Reduce worker pool: spans of large reductions fan out across
+    // HVD_REDUCE_THREADS lanes (default min(4, cores-1); 1 = inline, the
+    // pre-pool behavior and the only sane default on a 1-core box).
+    unsigned hw = std::thread::hardware_concurrency();
+    int64_t def_lanes = hw > 1 ? (int64_t)(hw - 1) : 1;
+    if (def_lanes > 4) def_lanes = 4;
+    int64_t lanes = EnvInt("HVD_REDUCE_THREADS", def_lanes);
+    g->reduce_threads = (int)(lanes < 1 ? 1 : lanes);
+    GlobalReducePool().Configure(g->reduce_threads);
     // Reduce-kernel tier: HVD_REDUCE_VECTOR=0 pins the scalar baseline
     // (the bench's A/B switch); default is the vectorized tier.
     ReduceVectorFlag().store(EnvInt("HVD_REDUCE_VECTOR", 1) != 0,
@@ -1338,12 +1449,21 @@ int hvd_init() {
         EnvInt("HVD_AUTOTUNE_MAX_SAMPLES", 30),
         g->cache.enabled(), g->hierarchical, g->zerocopy_on,
         /*init_pipeline=*/g->ring_pipeline_cfg != 1,
+        /*init_shm=*/g->data.shm_enabled(),
         /*can_toggle_cache=*/g->cache.enabled(),
-        /*can_toggle_hier=*/g->hier_ok && g->size > 1,
+        // On a single host the hierarchical arm only pays off when the
+        // local phase actually rides shm — without the plane it degrades
+        // to the flat ring and would burn a sample window measuring the
+        // same configuration twice.
+        /*can_toggle_hier=*/g->hier_ok && g->size > 1 &&
+            (g->cross_size > 1 || g->data.shm().active()),
         /*can_toggle_zerocopy=*/g->zerocopy_allowed && g->size > 1,
         // HVD_RING_PIPELINE=1 is the operator pinning serial: drop the
         // arm dimension instead of sweeping a config they opted out of.
-        /*can_toggle_pipeline=*/g->size > 1 && g->ring_pipeline_cfg != 1);
+        /*can_toggle_pipeline=*/g->size > 1 && g->ring_pipeline_cfg != 1,
+        // Same opt-out rule for shm: HVD_SHM=0 or no plane (single rank
+        // per host, non-uniform topology) drops the dimension.
+        /*can_toggle_shm=*/g->shm_allowed && g->data.shm().active());
     g->data.set_timeout_ms(
         (int)(EnvDouble("HVD_DATA_TIMEOUT_SECONDS", 300.0) * 1000.0));
     LogF(LogLevel::kInfo,
@@ -1398,6 +1518,12 @@ int hvd_shutdown() {
     }
     g->background.join();
   }
+  // Background thread is down: unmap + defensively unlink the shm
+  // segments (the creator already unlinked its own name once every peer
+  // attached, so crash paths cannot leak /dev/shm entries), and park the
+  // reduce pool's worker lanes.
+  g->data.shm().Shutdown();
+  GlobalReducePool().Configure(0);
   g->timeline.Shutdown();
   LogF(LogLevel::kInfo, "shutdown complete");
   delete g;
@@ -1737,6 +1863,41 @@ int hvd_pipeline_state(int64_t* depth) {
   return g->data.pipeline() != 1 ? 1 : 0;
 }
 
+// Shm host-plane observability: pointer-handoff exchanges and their
+// payload bytes, covered-but-declined routings (plane mapped but disabled
+// or under threshold), and staged copies on the shm path — 0 by
+// construction (spans are consumed in place from the peer's ring slot);
+// the acceptance tests pin it there.
+int hvd_shm_stats(int64_t* ops, int64_t* bytes, int64_t* fallback,
+                  int64_t* staged) {
+  if (!g || !g->initialized) return -1;
+  if (ops) *ops = g->shm_ops_total.load();
+  if (bytes) *bytes = g->shm_bytes_total.load();
+  if (fallback) *fallback = g->shm_fallback_total.load();
+  if (staged) *staged = g->shm_staged_total.load();
+  return 0;
+}
+
+// Current shm-plane state: returns -1 uninitialized, 0 when the plane is
+// unmapped or routing is off (HVD_SHM=0 or the autotune arm), 1 live;
+// *threshold gets the live byte threshold.
+int hvd_shm_state(int64_t* threshold) {
+  if (!g || !g->initialized) return -1;
+  if (threshold) *threshold = g->data.shm_threshold();
+  return g->data.shm().active() && g->data.shm_enabled() ? 1 : 0;
+}
+
+// Reduce-pool observability: configured lanes, pooled dispatches, and
+// worker-lane spans executed. Usable WITHOUT init like hvd_reduce_stats
+// (the pool is process-global).
+int hvd_reduce_pool_stats(int64_t* threads, int64_t* jobs, int64_t* spans) {
+  ReducePool& p = GlobalReducePool();
+  if (threads) *threads = p.threads();
+  if (jobs) *jobs = p.jobs.load(std::memory_order_relaxed);
+  if (spans) *spans = p.spans.load(std::memory_order_relaxed);
+  return 0;
+}
+
 // Standalone reduce-kernel microbench: time `iters` in-place Accumulate
 // sum calls over `n` elements of `dtype`, under the requested tier
 // (vector_on 0/1; the live tier is restored afterwards). Returns seconds
@@ -1800,14 +1961,22 @@ double hvd_reduce_bench(int dtype, int64_t n, int iters, int vector_on) {
   }
   bool prev = ReduceVectorFlag().load(std::memory_order_relaxed);
   ReduceVectorFlag().store(vector_on != 0, std::memory_order_relaxed);
-  // Warmup, then timed loop.
+  // Warmup, then timed loop. A single small Accumulate can finish inside
+  // one NowUs() tick (vectorized f32 @ 4K elements is sub-microsecond);
+  // double the batch until the measurement clears the timer's floor so
+  // the per-iteration quotient can never legitimately come back 0.
   Accumulate(dst.data(), src.data(), n, dt, ReduceOp::kSum);
-  int64_t t0 = NowUs();
-  for (int i = 0; i < iters; i++)
-    Accumulate(dst.data(), src.data(), n, dt, ReduceOp::kSum);
-  int64_t t1 = NowUs();
+  int64_t batch = iters, t0, t1;
+  for (;;) {
+    t0 = NowUs();
+    for (int64_t i = 0; i < batch; i++)
+      Accumulate(dst.data(), src.data(), n, dt, ReduceOp::kSum);
+    t1 = NowUs();
+    if (t1 - t0 >= 100 || batch >= (int64_t)1 << 20) break;
+    batch *= 8;
+  }
   ReduceVectorFlag().store(prev, std::memory_order_relaxed);
-  return (double)(t1 - t0) / 1e6 / (double)iters;
+  return (double)(t1 - t0) / 1e6 / (double)batch;
 }
 
 // Lockdep observability (debug_lock.h): counts of lock-order inversions,

@@ -19,10 +19,15 @@
 
 #include <atomic>
 #include <cmath>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <functional>
+#include <thread>
+#include <vector>
 
 #include "common.h"
+#include "debug_lock.h"
 
 namespace hvd {
 
@@ -624,6 +629,145 @@ inline void ScaleBuffer(void* buf, int64_t n, DataType dtype, double factor) {
       break;
     }
   }
+}
+
+// --- reduce worker pool ----------------------------------------------------
+// The PR 4 streamed ring overlaps wire time with reduce time, but every
+// reduce still runs on the one background thread — on a multi-core box the
+// reduces serialize behind it. The pool splits a large accumulate across
+// HVD_REDUCE_THREADS lanes (threads-1 workers + the calling thread) over
+// disjoint element spans; spans are independent, so the kernels above run
+// unchanged. Configure() is only called with the background loop quiescent
+// (hvd_init before collectives / hvd_shutdown after the join), so Run()
+// never races a reconfiguration.
+class ReducePool {
+ public:
+  using SpanJob = std::function<void(int64_t begin, int64_t end)>;
+
+  // Below the floor the split overhead beats the win: run inline.
+  static constexpr int64_t kFloorBytes = 128 * 1024;
+  // Minimum bytes per span — don't shard a job finer than this.
+  static constexpr int64_t kSpanBytes = 64 * 1024;
+
+  ~ReducePool() { Configure(0); }
+
+  // (Re)size to `threads` total lanes; <= 1 runs everything inline.
+  void Configure(int threads) {
+    {
+      std::unique_lock<DebugMutex> lk(mu_);
+      stop_ = true;
+      cv_.notify_all();
+    }
+    for (auto& t : workers_) t.join();
+    workers_.clear();
+    {
+      std::unique_lock<DebugMutex> lk(mu_);
+      stop_ = false;
+      queue_.clear();
+      threads_.store(threads < 1 ? 1 : threads, std::memory_order_relaxed);
+    }
+    for (int i = 0; i < threads_.load(std::memory_order_relaxed) - 1; i++)
+      workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  int threads() const { return threads_.load(std::memory_order_relaxed); }
+
+  // Partition [0, n) into up to threads() spans and run `job` over them on
+  // the workers plus the calling thread; returns when every span is done.
+  void Run(int64_t n, int64_t elem_bytes, const SpanJob& job) {
+    const int T = threads();
+    if (T <= 1 || n * elem_bytes < kFloorBytes) {
+      job(0, n);
+      return;
+    }
+    const int64_t span_elems = (kSpanBytes + elem_bytes - 1) / elem_bytes;
+    int64_t nspans = (n + span_elems - 1) / span_elems;
+    if (nspans > T) nspans = T;
+    if (nspans <= 1) {
+      job(0, n);
+      return;
+    }
+    const int64_t per = (n + nspans - 1) / nspans;
+    std::vector<std::pair<int64_t, int64_t>> parts;
+    for (int64_t b = 0; b < n; b += per)
+      parts.emplace_back(b, b + per < n ? b + per : n);
+    std::atomic<int> remaining((int)parts.size() - 1);
+    {
+      std::unique_lock<DebugMutex> lk(mu_);
+      for (size_t s = 1; s < parts.size(); s++)
+        queue_.push_back(Item{&job, parts[s].first, parts[s].second,
+                              &remaining});
+      cv_.notify_all();
+    }
+    job(parts[0].first, parts[0].second);  // caller takes span 0 inline
+    std::unique_lock<DebugMutex> lk(mu_);
+    while (remaining.load(std::memory_order_acquire) != 0) done_.wait(lk);
+    jobs.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  // Proof counters (hvd_reduce_pool_stats): pooled dispatches and the
+  // spans that actually ran on worker threads.
+  std::atomic<int64_t> jobs{0};
+  std::atomic<int64_t> spans{0};
+
+ private:
+  struct Item {
+    const SpanJob* job;
+    int64_t begin, end;
+    std::atomic<int>* remaining;
+  };
+
+  void WorkerLoop() {
+    std::unique_lock<DebugMutex> lk(mu_);
+    for (;;) {
+      while (!stop_ && queue_.empty()) cv_.wait(lk);
+      if (stop_) return;
+      Item it = queue_.back();
+      queue_.pop_back();
+      lk.unlock();
+      (*it.job)(it.begin, it.end);
+      spans.fetch_add(1, std::memory_order_relaxed);
+      lk.lock();
+      // Last span signals the caller; the mutex orders the job's writes
+      // before the caller's wake-up alongside the acq_rel counter.
+      if (it.remaining->fetch_sub(1, std::memory_order_acq_rel) == 1)
+        done_.notify_all();
+    }
+  }
+
+  DebugMutex mu_{"reduce_pool"};
+  // condition_variable_any: waits on DebugMutex (lockdep, debug_lock.h).
+  std::condition_variable_any cv_;    // queue not empty / stop
+  std::condition_variable_any done_;  // a job's last span completed
+  std::vector<Item> queue_;
+  std::vector<std::thread> workers_;
+  std::atomic<int> threads_{1};
+  bool stop_ = false;
+};
+
+inline ReducePool& GlobalReducePool() {
+  static ReducePool pool;
+  return pool;
+}
+
+// Pool-routed dispatchers: same contracts as Accumulate/AccumulateTo, with
+// the element range sharded across the pool lanes.
+inline void PoolAccumulate(void* dst, const void* src, int64_t n,
+                           DataType dtype, ReduceOp op) {
+  const int64_t esz = (int64_t)DataTypeSize(dtype);
+  GlobalReducePool().Run(n, esz, [&](int64_t b, int64_t e) {
+    Accumulate((uint8_t*)dst + b * esz, (const uint8_t*)src + b * esz, e - b,
+               dtype, op);
+  });
+}
+
+inline void PoolAccumulateTo(void* dst, const void* a, const void* b,
+                             int64_t n, DataType dtype, ReduceOp op) {
+  const int64_t esz = (int64_t)DataTypeSize(dtype);
+  GlobalReducePool().Run(n, esz, [&](int64_t s, int64_t e) {
+    AccumulateTo((uint8_t*)dst + s * esz, (const uint8_t*)a + s * esz,
+                 (const uint8_t*)b + s * esz, e - s, dtype, op);
+  });
 }
 
 }  // namespace hvd

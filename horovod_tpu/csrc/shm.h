@@ -1,0 +1,109 @@
+// shm.h — intra-host shared-memory data plane (the L2 layer of the
+// hierarchical host plane).
+//
+// Each rank owns ONE /dev/shm segment: its "outbox", holding an SPSC ring
+// channel per same-host peer. An intra-host sub-chunk exchange is then a
+// pointer handoff — producer memcpy into a mapped slot, consumer reduces
+// straight out of the peer's mapping — instead of two loopback-socket
+// copies (write + read) through the kernel.
+//
+// Lifecycle mirrors the TCP planes' trust model:
+//   * the segment header carries an HMAC tag keyed by the job secret
+//     (auth.h JobSecret(), falling back to a job-tag-derived key), so a
+//     stale or foreign segment with the right name is rejected, and the
+//     segment NAME itself is derived from HMAC(key, job-tag + rank) so
+//     concurrent jobs on one box can't collide;
+//   * the owner shm_unlink()s any stale name before creating, and unlinks
+//     its own segment again as soon as every peer has attached — POSIX shm
+//     persists while mapped, so a crashed rank can never leak a name.
+//
+// No getenv here (hvdlint raw-getenv): all configuration is passed in from
+// core.cc's env parsing.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+class ShmPlane {
+ public:
+  // Fixed geometry limits baked into the segment header.
+  static constexpr int kMaxSlots = 8;
+  static constexpr uint64_t kMagic = 0x68766453484d3031ull;  // "hvdSHM01"
+  static constexpr uint32_t kVersion = 1;
+
+  // Map/copy/reduce callback: a span of a peer's slot, delivered by
+  // pointer. `off` is the byte offset of this span within the message.
+  using SpanFn = std::function<void(const uint8_t* ptr, int64_t len,
+                                    int64_t off)>;
+
+  ShmPlane() = default;
+  ~ShmPlane();
+  ShmPlane(const ShmPlane&) = delete;
+  ShmPlane& operator=(const ShmPlane&) = delete;
+
+  // Establish the host plane for `rank` among `host_ranks` (the global
+  // ranks sharing this host, sorted; must contain `rank`). `key` is the
+  // HMAC key (job secret, or a derived fallback — never empty);
+  // `job_tag` disambiguates concurrent jobs (the controller address).
+  // Creates this rank's outbox, attaches every peer's, and unlinks.
+  // Returns false (and logs a warning upstream) on any failure; the
+  // plane is then inactive and callers fall back to TCP.
+  bool Init(int rank, const std::vector<int>& host_ranks,
+            const std::vector<uint8_t>& key, const std::string& job_tag,
+            int64_t slot_bytes, int nslots, double timeout_s);
+
+  // Unmap everything (and defensively unlink our own name). Idempotent.
+  void Shutdown();
+
+  bool active() const { return active_; }
+  int64_t slot_bytes() const { return slot_bytes_; }
+
+  // True when every rank in `members` lives on this host plane.
+  bool Covers(const std::vector<int32_t>& members) const;
+
+  // Full-duplex sub-chunk exchange with two (possibly equal, possibly
+  // absent) same-host peers: stream `sendlen` bytes from `src` to
+  // `to_rank`'s inbox-for-us while consuming `recvlen` bytes arriving
+  // from `from_rank`, delivering each received span to `on_span` by
+  // pointer into the mapped slot (zero staged copies by construction).
+  // Interleaved non-blocking progress on both directions — the same
+  // deadlock-freedom argument as tcp.cc's FullDuplex. to_rank/from_rank
+  // of -1 (or zero lengths) skip that direction. Returns false on
+  // timeout (timeout_ms) or inactive plane.
+  bool Exchange(int to_rank, const void* src, int64_t sendlen,
+                int from_rank, int64_t recvlen, int64_t timeout_ms,
+                const SpanFn& on_span);
+
+  // Counters (background-thread only, like DataPlane's stat fields).
+  int64_t stat_tx_ops = 0;       // Exchange calls that moved bytes
+  int64_t stat_tx_bytes = 0;     // payload bytes through shm slots
+  int64_t stat_staged_copies = 0;  // intermediate copies (0 by design)
+
+  struct Channel;  // SPSC ring control block (shm.cc)
+  struct Header;   // segment header (shm.cc)
+
+ private:
+  struct Segment { void* base = nullptr; size_t len = 0; };
+
+  // Channel `ch_index` of segment `seg_index` (both are host-rank
+  // indices: a segment's channel i is read by host peer i).
+  Channel* channel_at(int seg_index, int ch_index);
+  uint8_t* slot_at(int seg_index, int ch_index, uint64_t seq);
+  int peer_index(int rank) const;  // -1 when rank is off-host
+
+  bool active_ = false;
+  int rank_ = -1;
+  int my_index_ = -1;              // position of rank_ in host_ranks_
+  std::vector<int> host_ranks_;    // sorted global ranks on this host
+  std::vector<Segment> segments_;  // one per host rank (index-aligned)
+  std::string my_name_;            // our /dev/shm name (for defensive unlink)
+  int64_t slot_bytes_ = 0;
+  int nslots_ = 0;
+};
+
+}  // namespace hvd

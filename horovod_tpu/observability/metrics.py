@@ -396,13 +396,32 @@ REDUCE_SCALAR_OPS = gauge(
     "hvd_reduce_scalar_ops",
     "Accumulate dispatches taken by the pinned scalar baseline "
     "(HVD_REDUCE_VECTOR=0)")
+SHM_OPS = gauge(
+    "hvd_shm_ops",
+    "Intra-host collective exchanges executed over the /dev/shm ring "
+    "segments (pointer handoff, no socket copies)")
+SHM_BYTES = gauge(
+    "hvd_shm_bytes",
+    "Payload bytes moved over the intra-host shm plane")
+SHM_FALLBACKS = gauge(
+    "hvd_shm_fallbacks",
+    "Collectives the shm plane covered but that routed to TCP anyway "
+    "(plane toggled off, or payload under HVD_SHM_THRESHOLD)")
+REDUCE_POOL_JOBS = gauge(
+    "hvd_reduce_pool_jobs",
+    "Reductions fanned out across the reduce worker pool "
+    "(HVD_REDUCE_THREADS lanes)")
+REDUCE_POOL_SPANS = gauge(
+    "hvd_reduce_pool_spans",
+    "Element spans executed on reduce-pool worker lanes")
 
 
 def sample_core_stats(hvd=None):
-    """Snapshot the core's ring-pipeline and reduce-kernel counters into
-    the gauge families above. Call after synchronize() (or any quiesce
-    point); cheap, so callers may sample per step. `hvd` defaults to the
-    horovod_tpu package (parameter for tests)."""
+    """Snapshot the core's ring-pipeline, shm-plane, reduce-pool, and
+    reduce-kernel counters into the gauge families above. Call after
+    synchronize() (or any quiesce point); cheap, so callers may sample per
+    step. `hvd` defaults to the horovod_tpu package (parameter for
+    tests)."""
     if hvd is None:
         import horovod_tpu as hvd
     steps, blocks, serial, us = hvd.pipeline_stats()
@@ -410,9 +429,16 @@ def sample_core_stats(hvd=None):
     RING_STREAM_BLOCKS.set(blocks)
     RING_SERIAL_STEPS.set(serial)
     RING_OVERLAP_SECONDS.set(us / 1e6)
+    shm_ops, shm_bytes, shm_fallback, _ = hvd.shm_stats()
+    SHM_OPS.set(shm_ops)
+    SHM_BYTES.set(shm_bytes)
+    SHM_FALLBACKS.set(shm_fallback)
     fast_ops, _, scalar_ops, _ = hvd.reduce_stats()
     REDUCE_FAST_OPS.set(fast_ops)
     REDUCE_SCALAR_OPS.set(scalar_ops)
+    _, pool_jobs, pool_spans = hvd.reduce_pool_stats()
+    REDUCE_POOL_JOBS.set(pool_jobs)
+    REDUCE_POOL_SPANS.set(pool_spans)
 
 
 def record_call(op, seconds, nbytes, process_set=0):

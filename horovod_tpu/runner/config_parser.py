@@ -37,6 +37,9 @@ ARG_TO_ENV = {
     "zerocopy_threshold_mb": ("HVD_ZEROCOPY_THRESHOLD",
                               lambda v: str(int(float(v) * _MB))),
     "ring_pipeline": ("HVD_RING_PIPELINE", lambda v: str(int(v))),
+    "shm_threshold_mb": ("HVD_SHM_THRESHOLD",
+                         lambda v: str(int(float(v) * _MB))),
+    "reduce_threads": ("HVD_REDUCE_THREADS", lambda v: str(int(v))),
     "timeline_filename": ("HVD_TIMELINE", str),
     "timeline_mark_cycles": ("HVD_TIMELINE_MARK_CYCLES",
                              lambda v: "1" if v else "0"),
@@ -61,6 +64,8 @@ _FILE_SECTIONS = {
                "cache-capacity": "cache_capacity",
                "zerocopy-threshold-mb": "zerocopy_threshold_mb",
                "ring-pipeline": "ring_pipeline",
+               "shm-threshold-mb": "shm_threshold_mb",
+               "reduce-threads": "reduce_threads",
                "start-timeout": "start_timeout",
                "log-level": "log_level"},
     "timeline": {"filename": "timeline_filename",

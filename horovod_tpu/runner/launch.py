@@ -63,6 +63,16 @@ def parse_args(argv=None):
                         "(HVD_RING_PIPELINE): 0 auto-sizes sub-chunks per "
                         "ring step, 1 forces the serial recv-then-reduce "
                         "path, N>1 splits each chunk into N sub-blocks")
+    p.add_argument("--shm-threshold-mb", dest="shm_threshold_mb",
+                   type=float, default=None,
+                   help="min payload MB routed over the intra-host "
+                        "shared-memory plane (HVD_SHM_THRESHOLD); smaller "
+                        "same-host messages stay on TCP")
+    p.add_argument("--reduce-threads", dest="reduce_threads", type=int,
+                   default=None,
+                   help="reduce worker-pool lanes (HVD_REDUCE_THREADS): 1 "
+                        "runs reductions inline, N>1 shards large "
+                        "reductions across N-1 workers plus the caller")
     p.add_argument("--timeline-filename", dest="timeline_filename")
     p.add_argument("--timeline-mark-cycles", dest="timeline_mark_cycles",
                    action="store_true", default=None)

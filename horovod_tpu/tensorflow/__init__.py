@@ -311,13 +311,49 @@ def broadcast_global_variables(root_rank=0):
                                root_rank=root_rank)
 
 
+def _sparse_allreduce(g, op, name, process_set):
+    """Allreduce a tf.IndexedSlices without densifying (reference:
+    mpi_ops.py `_allreduce` on IndexedSlices): allgather the values and
+    indices — the result is a taller IndexedSlices whose duplicate
+    indices TF's optimizers scatter-add, which is exactly the sum over
+    ranks. Average divides the gathered values by the process-set size,
+    computed at EXECUTION time (a trace must not bake in the current
+    world size — same elastic contract as `_grouped_np`)."""
+    from ..basics import _lib
+
+    tf = _tf()
+    if op not in (Sum, Average):
+        raise ValueError(
+            f"sparse gradients support only Sum/Average (got op={op}); "
+            f"pass sparse_as_dense=True to densify first")
+    values = allgather(g.values, name=name + ".values",
+                       process_set=process_set)
+    indices = allgather(g.indices, name=name + ".indices",
+                        process_set=process_set)
+    if op == Average:
+        if tf.executing_eagerly():
+            psize = tf.constant(
+                _lib.hvd_process_set_size(int(process_set)), tf.int64)
+        else:
+            psize = tf.py_function(
+                lambda: np.int64(_lib.hvd_process_set_size(
+                    int(process_set))), [], tf.int64)
+        values = values / tf.cast(psize, values.dtype)
+    return tf.IndexedSlices(values, indices, dense_shape=g.dense_shape)
+
+
 def DistributedGradientTape(tape, op=Average, compression=None,
                             process_set=0, sparse_as_dense=False,
                             num_groups=0, gradient_predivide_factor=1.0):
     """Wrap tf.GradientTape so gradient() allreduces the results in one
     fused group (reference: `_DistributedGradientTape`).
     ``gradient_predivide_factor`` splits the averaging around the sum
-    (prescale 1/f, postscale f/size); requires op=Average."""
+    (prescale 1/f, postscale f/size); requires op=Average.
+
+    Sparse gradients (tf.IndexedSlices, e.g. from tf.gather): with
+    ``sparse_as_dense=True`` they densify and ride the fused dense group;
+    by default they stay sparse and reduce via allgather of values and
+    indices — no dense materialization of embedding-sized gradients."""
     tf = _tf()
     _core.validate_predivide(op, gradient_predivide_factor)
 
@@ -334,29 +370,24 @@ def DistributedGradientTape(tape, op=Average, compression=None,
             idx = [i for i, g in enumerate(flat) if g is not None]
             if not idx:
                 return grads
-            dense = []
+            dense_idx, dense = [], []
             for i in idx:
                 g = flat[i]
                 if isinstance(g, tf.IndexedSlices):
-                    # Reference semantics (horovod/torch sparse_as_dense):
-                    # densify before the dense allreduce, or fail loudly —
-                    # a sparse layout silently fed to the dense plane would
-                    # be garbage. Mirrors the torch binding's error.
                     if not sparse_as_dense:
-                        raise ValueError(
-                            f"gradient {i} produced a sparse gradient "
-                            f"(tf.IndexedSlices, e.g. from tf.gather); "
-                            f"pass sparse_as_dense=True to "
-                            f"DistributedGradientTape to densify it "
-                            f"before allreduce")
+                        flat[i] = _sparse_allreduce(
+                            g, op, f"tape.sparse.{i}", process_set)
+                        continue
                     g = tf.convert_to_tensor(g)
+                dense_idx.append(i)
                 dense.append(g)
-            outs = _grouped_np(
-                dense, op=op, name="tape.grads", process_set=process_set,
-                compression=compression,
-                gradient_predivide_factor=gradient_predivide_factor)
-            for j, i in enumerate(idx):
-                flat[i] = outs[j]
+            if dense:
+                outs = _grouped_np(
+                    dense, op=op, name="tape.grads",
+                    process_set=process_set, compression=compression,
+                    gradient_predivide_factor=gradient_predivide_factor)
+                for j, i in enumerate(dense_idx):
+                    flat[i] = outs[j]
             return tf.nest.pack_sequence_as(grads, flat)
 
     return _Wrapped(tape)

@@ -1,0 +1,132 @@
+"""Hierarchical host plane (ISSUE 7): the intra-host shared-memory
+transport (csrc/shm.cc), the reduce worker pool (csrc/reduce.h,
+HVD_REDUCE_THREADS), and hierarchical allreduce riding both.
+
+Every multi-rank case drives workers/hier_shm_worker.py, which runs the
+full parity sweep (all dtypes, Sum/Min/Max/Average, fused pair, odd
+length, tiny fallback, one pool-sized tensor) and grades the shm/pool
+counters — shm ops/bytes must move exactly when expected and the
+staged-copy counter must stay 0 (the pointer-handoff proof).
+"""
+import pytest
+
+import horovod_tpu as hvd
+
+from .util import run_worker_job
+
+
+def test_shm_stats_require_init():
+    if hvd.is_initialized():  # pragma: no cover - ordering guard
+        pytest.skip("core already initialized in this process")
+    with pytest.raises(ValueError):
+        hvd.shm_stats()
+    with pytest.raises(ValueError):
+        hvd.shm_state()
+
+
+def test_reduce_pool_stats_without_init():
+    # The pool is process-global (configured at init, queried any time).
+    threads, jobs, spans = hvd.reduce_pool_stats()
+    assert threads >= 1
+    assert jobs >= 0 and spans >= 0
+
+
+def test_hier_shm_2rank_timeline(tmp_path):
+    """Single-host hierarchical parity; rank 0 checks TCP_SHM_EXCHANGE
+    sub-spans land in the core timeline."""
+    run_worker_job(2, "hier_shm_worker.py", timeout=300, extra_env={
+        "HVD_HIERARCHICAL_ALLREDUCE": "1",
+        "EXPECT_SHM": "1",
+        "HVD_TIMELINE": str(tmp_path / "shm_tl.json"),
+    })
+
+
+def test_hier_shm_pool_4rank():
+    """Single-host hierarchical parity with a 3-lane reduce pool; the
+    pool's job/span counters must move on the 8 MiB tensor."""
+    run_worker_job(4, "hier_shm_worker.py", timeout=360, extra_env={
+        "HVD_HIERARCHICAL_ALLREDUCE": "1",
+        "EXPECT_SHM": "1",
+        "HVD_REDUCE_THREADS": "3",
+        "POOL_EXPECT_JOBS": "1",
+    })
+
+
+@pytest.mark.slow
+def test_hier_shm_multihost_8rank():
+    """Two fake hosts x 4 local ranks: local phases ride shm, the cross
+    ring stays on TCP (worker asserts local TCP bytes < cross bytes)."""
+    run_worker_job(8, "hier_shm_worker.py", timeout=480, extra_env={
+        "HIER_LOCAL_SIZE": "4",
+        "HVD_HIERARCHICAL_ALLREDUCE": "1",
+        "EXPECT_SHM": "1",
+    })
+
+
+def test_flat_ring_rides_shm_2rank():
+    """Without the hierarchical arm the flat staged ring still routes
+    same-host exchanges over the plane."""
+    run_worker_job(2, "hier_shm_worker.py", timeout=300, extra_env={
+        "EXPECT_SHM": "1",
+    })
+
+
+def test_shm_kill_switch_4rank():
+    """HVD_SHM=0: the identical sweep over pure TCP — the plane must not
+    even map, and parity must hold bit-for-bit with the shm runs."""
+    run_worker_job(4, "hier_shm_worker.py", timeout=360, extra_env={
+        "HVD_SHM": "0",
+        "HVD_HIERARCHICAL_ALLREDUCE": "1",
+        "EXPECT_SHM": "0",
+    })
+
+
+def test_ranks_spanning_hosts_fall_back_2rank():
+    """One rank per fake host: no same-host peers, so the plane never
+    maps and the hierarchical topology never validates."""
+    run_worker_job(2, "hier_shm_worker.py", timeout=300, extra_env={
+        "HIER_LOCAL_SIZE": "1",
+        "EXPECT_SHM": "0",
+    })
+
+
+def test_shm_threshold_fallback_2rank():
+    """A 1 GiB routing threshold declines every message: the fallback
+    counter must move while ops stay 0."""
+    run_worker_job(2, "hier_shm_worker.py", timeout=300, extra_env={
+        "HVD_SHM_THRESHOLD": str(1 << 30),
+        "EXPECT_SHM": "0",
+        "EXPECT_FALLBACK": "1",
+    })
+
+
+def test_autotune_shm_arm(tmp_path):
+    """The shm routing toggle as an autotune categorical arm: on a
+    2-rank single-host pod with zerocopy and ring-pipeline pinned off,
+    the sweep walks all 8 (cache, hier, shm) combinations, locks one,
+    and ships it in the ResponseList (autotune_worker.py asserts the CSV
+    arm walk and the lock)."""
+    log = tmp_path / "autotune_shm.csv"
+    run_worker_job(2, "autotune_worker.py", extra_env={
+        "HVD_AUTOTUNE": "1",
+        "HVD_AUTOTUNE_LOG": str(log),
+        "HVD_AUTOTUNE_CYCLES_PER_SAMPLE": "4",
+        "HVD_AUTOTUNE_MAX_SAMPLES": "12",
+        "HVD_ZEROCOPY": "0",
+        "HVD_RING_PIPELINE": "1",
+        "EXPECT_ARMS": "8",
+    }, timeout=240)
+    # The shm column really swept both states.
+    rows = [l for l in log.read_text().splitlines()[1:9]
+            if not l.startswith("#")]
+    assert {l.split(",")[7] for l in rows} == {"0", "1"}, rows
+
+
+def test_shm_and_scatter_gather_coexist_2rank():
+    """A low zerocopy threshold sends large tensors down the TCP
+    scatter-gather ring while small fused cycles still ride shm — both
+    transports in one job without cross-talk."""
+    run_worker_job(2, "hier_shm_worker.py", timeout=300, extra_env={
+        "HVD_ZEROCOPY_THRESHOLD": "16384",
+        "EXPECT_SHM": "1",
+    })

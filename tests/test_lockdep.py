@@ -46,3 +46,18 @@ def test_lockdep_clean_graph_and_seeded_inversion(tmp_path):
     # The seeded inversion must have been reported on stderr by the
     # checker itself (debug_lock.h prints as it records).
     assert "lock-order inversion" in p.stderr, p.stderr[-2000:]
+
+
+def test_lockdep_shm_pool_mutexes_edge_clean(tmp_path):
+    """Debug tier over the hierarchical shm path: the reduce pool's
+    "reduce_pool" DebugMutex and the shm plane's attach/exchange
+    blocking-syscall annotations must add only clean edges — every rank
+    asserts zero cycles and zero locks held across blocking syscalls
+    after the full parity sweep (HVD_LOCKDEP grade inside the worker)."""
+    p, _ = run_under_sanitizer(
+        tmp_path, "hier_shm_worker.py", 2, tier="debug",
+        extra_env={"HVD_LOCKDEP": "1",
+                   "HVD_HIERARCHICAL_ALLREDUCE": "1",
+                   "HVD_REDUCE_THREADS": "2",
+                   "EXPECT_SHM": "1"})
+    assert_sanitizer_clean(p, 2, [], tier="lockdep")

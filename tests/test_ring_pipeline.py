@@ -52,10 +52,15 @@ def test_forced_serial_equivalence_2rank():
 
 def test_pipelined_staged_ring_2rank():
     """HVD_ZEROCOPY=0 routes everything through the fusion-buffer staging
-    ring — its reduce-scatter must stream sub-chunks too."""
+    ring — its reduce-scatter must stream sub-chunks too. HVD_SHM=0: this
+    test pins the TCP staging path specifically; with the intra-host shm
+    plane on (the default for launcher-declared single-host jobs, ISSUE
+    7) the staged ring becomes a pointer handoff and never streams —
+    that routing is covered by test_hier_shm.py."""
     run_worker_job(2, "ring_pipeline_worker.py", timeout=300, extra_env={
         "HVD_RING_PIPELINE": "4",
         "HVD_ZEROCOPY": "0",
+        "HVD_SHM": "0",
     })
 
 

@@ -54,6 +54,21 @@ def test_reinit_and_auth_under_tsan(tmp_path):
     assert_sanitizer_clean(p, 4, core_reports, tier="tsan")
 
 
+def test_hier_shm_ring_under_tsan(tmp_path):
+    """The intra-host shm ring (csrc/shm.cc) under the sanitizer: SPSC
+    slot handoff between background threads of different ranks, the
+    on_span reduce callbacks consuming slots while the producer refills
+    them, and the reduce worker pool fanning accumulations across lanes
+    while the main thread polls the pool counters. 2 single-host ranks,
+    hierarchical arm on, 2 pool lanes."""
+    p, core_reports = _run_under_tsan(
+        tmp_path, "hier_shm_worker.py", 2,
+        extra_env={"HVD_HIERARCHICAL_ALLREDUCE": "1",
+                   "HVD_REDUCE_THREADS": "2",
+                   "EXPECT_SHM": "1"})
+    assert_sanitizer_clean(p, 2, core_reports, tier="tsan")
+
+
 def test_streamed_ring_reduce_under_tsan(tmp_path):
     """The streamed ring reduce-scatter (HVD_RING_PIPELINE) under the
     sanitizer: sub-blocks of the receive scratch are handed to Accumulate

@@ -1,0 +1,193 @@
+"""Worker: hierarchical allreduce over the intra-host shm plane (ISSUE 7).
+
+Fake-pod topology via HIER_LOCAL_SIZE (default: all ranks on one "host"),
+set before init like hier_worker.py. Runs the ring_pipeline_worker-style
+parity sweep (all dtypes, Sum/Min/Max, fused pair, odd length, tiny
+fallback, one pool-sized tensor) and then grades the shm/pool counters:
+
+* EXPECT_SHM=1: shm_stats() ops/bytes must move and staged copies stay 0
+  (the pointer-handoff proof); =0: the plane must stay silent.
+* EXPECT_FALLBACK=1: the plane covered collectives but routing declined
+  (HVD_SHM_THRESHOLD) — the fallback counter must move, ops must not.
+* POOL_EXPECT_JOBS=1: the reduce worker pool (HVD_REDUCE_THREADS) must
+  have fanned at least one reduction across its lanes.
+
+With HVD_TIMELINE set and shm expected, rank 0 asserts the core timeline
+recorded TCP_SHM_EXCHANGE sub-spans after shutdown.
+"""
+import os
+
+r = int(os.environ["HVD_RANK"])
+s = int(os.environ["HVD_SIZE"])
+# Fake topology (SURVEY.md §4 / hier_worker.py convention): host-major
+# blocks of L ranks. Default L = s — the single-host case, where the
+# hierarchical decomposition's cross phase degenerates and the local
+# phase rides the shm plane.
+L = int(os.environ.get("HIER_LOCAL_SIZE", str(s)))
+assert s % L == 0, (s, L)
+os.environ["HVD_LOCAL_RANK"] = str(r % L)
+os.environ["HVD_LOCAL_SIZE"] = str(L)
+os.environ["HVD_CROSS_RANK"] = str(r // L)
+os.environ["HVD_CROSS_SIZE"] = str(s // L)
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+try:
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _BF16 = None
+
+hvd.init()
+
+expect_shm = os.environ.get("EXPECT_SHM", "1") == "1"
+expect_fallback = os.environ.get("EXPECT_FALLBACK", "0") == "1"
+hier_on = os.environ.get("HVD_HIERARCHICAL_ALLREDUCE") == "1"
+shm_allowed = os.environ.get("HVD_SHM", "1") != "0"
+
+# Plane state: mapped iff same-host peers exist and HVD_SHM didn't kill
+# it; the routing threshold echoes HVD_SHM_THRESHOLD.
+enabled, threshold = hvd.shm_state()
+assert enabled == (shm_allowed and L > 1), (enabled, shm_allowed, L)
+assert threshold == int(os.environ.get("HVD_SHM_THRESHOLD", "0")), threshold
+
+threads, jobs0, spans0 = hvd.reduce_pool_stats()
+if "HVD_REDUCE_THREADS" in os.environ:
+    assert threads == int(os.environ["HVD_REDUCE_THREADS"]), threads
+
+ops0, bytes0, fb0, staged0 = hvd.shm_stats()
+
+# Large enough that every dtype's per-rank chunk is a real shm payload at
+# up to 8 ranks; POOL_N additionally clears the reduce pool's 128 KiB
+# fan-out floor per span on the shm slot path.
+N = 65536
+POOL_N = 1 << 21  # 8 MiB float32
+
+
+def rank_array(dtype, rk, n=N):
+    # Small integers: exactly representable in every dtype here.
+    return ((np.arange(n) % 13) + rk).astype(dtype)
+
+
+OPS = [(hvd.Sum, "sum"), (hvd.Min, "min"), (hvd.Max, "max")]
+DTYPES = [np.float32, np.float64, np.int32, np.int64, np.float16]
+if _BF16 is not None:
+    DTYPES.append(_BF16)
+
+for dtype in DTYPES:
+    dt = np.dtype(dtype)
+    all_ranks = np.stack(
+        [rank_array(dtype, rk).astype(np.float64) for rk in range(s)])
+    for op, opname in OPS:
+        out = hvd.allreduce(rank_array(dtype, r), op=op,
+                            name=f"hs.{dt.name}.{opname}")
+        if opname == "sum":
+            expect = all_ranks.sum(axis=0)
+        elif opname == "min":
+            expect = all_ranks.min(axis=0)
+        else:
+            expect = all_ranks.max(axis=0)
+        got = np.asarray(out).astype(np.float64)
+        if dt.kind in "iu":
+            assert np.array_equal(got, expect), \
+                (dt.name, opname, got[:4], expect[:4])
+        else:
+            assert np.allclose(got, expect, rtol=1e-2, atol=1e-2), \
+                (dt.name, opname, got[:4], expect[:4])
+
+SUM = s * (s + 1) // 2  # sum over ranks of (r+1)
+RSUM = s * (s - 1) // 2  # sum over ranks of r
+
+# Average (postscale path on the hierarchical composition).
+out = hvd.allreduce(np.full(N, float(r + 1), np.float32), op=hvd.Average,
+                    name="hs.avg")
+assert np.allclose(out, SUM / s), out[:4]
+
+# Odd length with distinct per-element data (chunk-remainder spread).
+M = (1 << 12) + 3
+out = hvd.allreduce(np.arange(M, dtype=np.float32) + r * 1000.0,
+                    op=hvd.Sum, name="hs.odd")
+expect = s * np.arange(M, dtype=np.float32) + 1000.0 * RSUM
+assert np.allclose(out, expect), (out[:4], expect[:4])
+
+# Fused pair (two tensors in one cycle ride the fusion buffer).
+ha = hvd.allreduce_async(np.full(257, float(r), np.float32), op=hvd.Sum,
+                         name="hs.fa")
+hb = hvd.allreduce_async(np.full(123, 2.0 * r, np.float32), op=hvd.Sum,
+                         name="hs.fb")
+from horovod_tpu.ops import collective_ops as cops  # noqa: E402
+
+va, vb = cops.synchronize(ha), cops.synchronize(hb)
+assert np.allclose(va, float(RSUM)), va[:4]
+assert np.allclose(vb, 2.0 * RSUM), vb[:4]
+
+# Tiny tensor (nelem < local_size): hierarchical falls back to the flat
+# ring; on a multi-host topology that ring spans hosts, so it must route
+# over TCP regardless of the plane.
+out = hvd.allreduce(np.full(1, float(r + 1), np.float32), op=hvd.Sum,
+                    name="hs.tiny")
+assert np.allclose(out, float(SUM)), out
+
+# Pool-sized tensor: each shm slot span clears the fan-out floor.
+out = hvd.allreduce(np.full(POOL_N, float(r + 1), np.float32), op=hvd.Sum,
+                    name="hs.pool")
+assert np.allclose(out, float(SUM)), out[:4]
+
+# --- Counter grading -------------------------------------------------------
+
+ops1, bytes1, fb1, staged1 = hvd.shm_stats()
+assert staged1 == staged0 == 0, \
+    f"staged copies on the shm path: {staged0} -> {staged1}"
+if expect_shm:
+    assert ops1 > ops0 and bytes1 > bytes0, (ops0, ops1, bytes0, bytes1)
+else:
+    assert ops1 == ops0 and bytes1 == bytes0, (ops0, ops1, bytes0, bytes1)
+if expect_fallback:
+    assert fb1 > fb0, (fb0, fb1)
+
+if os.environ.get("POOL_EXPECT_JOBS") == "1":
+    _, jobs1, spans1 = hvd.reduce_pool_stats()
+    assert jobs1 > jobs0, (jobs0, jobs1)
+    assert spans1 > spans0, (spans0, spans1)
+
+# Dispatch observability: HVD_HIERARCHICAL_ALLREDUCE must select the
+# hierarchical backend for every allreduce, and never otherwise.
+assert (hvd.backend_uses("hierarchical_allreduce") > 0) == hier_on
+assert (hvd.backend_uses("ring_allreduce") == 0) == hier_on
+
+if hier_on and expect_shm and L < s:
+    # Multi-host: same-host traffic rides shm, so this rank's TCP bytes
+    # to same-host peers stay far below its cross-plane shard traffic
+    # (only sub-local_size fallbacks touch local TCP).
+    host = r // L
+    cross_tx = sum(hvd.peer_tx_bytes(q) for q in range(s) if q // L != host)
+    local_tx = sum(hvd.peer_tx_bytes(q) for q in range(s)
+                   if q // L == host and q != r)
+    assert local_tx < cross_tx, (local_tx, cross_tx)
+
+if os.environ.get("HVD_LOCKDEP") == "1":
+    # Debug tier: the new shm/pool mutexes ("reduce_pool", the plane's
+    # channel locks) and the shm-attach/shm-exchange blocking-syscall
+    # annotations must leave the lock graph edge-clean.
+    enabled, cycles, blocking, edges, acq = hvd.lockdep_stats()
+    assert enabled
+    assert cycles == 0 and blocking == 0, hvd.lockdep_report()
+    # Real acquisitions were checked; zero EDGES is the ideal outcome
+    # (the shm/pool paths never hold two core locks at once).
+    assert acq > 0, (edges, acq)
+
+hvd.barrier(name="hs.done")
+hvd.shutdown()
+
+tl = os.environ.get("HVD_TIMELINE")
+if tl and r == 0 and expect_shm:
+    text = open(tl).read()
+    assert "TCP_SHM_EXCHANGE" in text, \
+        "no TCP_SHM_EXCHANGE sub-events in the core timeline"
+
+print(f"rank {r}: hier_shm PASS L={L} hier={int(hier_on)} "
+      f"shm_ops={ops1 - ops0} shm_bytes={bytes1 - bytes0} "
+      f"fallback={fb1 - fb0}", flush=True)

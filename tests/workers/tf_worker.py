@@ -205,15 +205,31 @@ g_pg = pd_graph_step()
 assert np.allclose(g_pg.numpy(), expect_pd, atol=1e-5), g_pg.numpy()
 
 # sparse gradients (tf.IndexedSlices from tf.gather): the default
-# sparse_as_dense=False must fail loudly — mirroring the torch binding —
-# never silently densify; sparse_as_dense=True densifies and allreduces.
+# sparse_as_dense=False keeps them sparse — allgathered values/indices
+# (reference mpi_ops.py IndexedSlices allreduce), never a silent densify;
+# sparse_as_dense=True densifies and rides the fused dense group. Both
+# must land on the same dense equivalent.
 emb = tf.Variable(tf.ones((4, 3)) * (r + 1.0))
 with tf.GradientTape() as t_sp:
-    loss_sp = tf.reduce_sum(tf.gather(emb, [0, 2]))
+    # Scale per rank so the averaged gradient actually mixes rank data.
+    loss_sp = tf.reduce_sum(tf.gather(emb, [0, 2]) * (r + 1.0))
 tape_sp = hvd.DistributedGradientTape(t_sp)
+g_sp = tape_sp.gradient(loss_sp, [emb])[0]
+assert isinstance(g_sp, tf.IndexedSlices), type(g_sp)
+# s ranks x 2 rows gathered; every rank contributes rows {0, 2}.
+assert int(tf.shape(g_sp.values)[0]) == 2 * s, g_sp.values.shape
+g_sp_dense = tf.convert_to_tensor(g_sp).numpy()  # scatter-adds dup rows
+expect_sparse = np.sum([(i + 1.0) for i in range(s)]) / s
+assert np.allclose(g_sp_dense[0], expect_sparse, atol=1e-5), g_sp_dense
+assert np.allclose(g_sp_dense[2], expect_sparse, atol=1e-5), g_sp_dense
+assert np.allclose(g_sp_dense[1], 0.0), g_sp_dense
+# Sparse Min has no gather-based form: still a loud error.
+with tf.GradientTape() as t_sm:
+    loss_sm = tf.reduce_sum(tf.gather(emb, [1]))
+tape_sm = hvd.DistributedGradientTape(t_sm, op=hvd.Min)
 try:
-    tape_sp.gradient(loss_sp, [emb])
-    raise SystemExit("expected ValueError (sparse_as_dense=False)")
+    tape_sm.gradient(loss_sm, [emb])
+    raise SystemExit("expected ValueError (sparse Min)")
 except ValueError as e:
     assert "sparse_as_dense=True" in str(e), e
 with tf.GradientTape() as t_sd:
