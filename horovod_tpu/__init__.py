@@ -148,6 +148,8 @@ pipeline_stats = _basics.pipeline_stats
 pipeline_state = _basics.pipeline_state
 shm_stats = _basics.shm_stats
 shm_state = _basics.shm_state
+bucket_stats = _basics.bucket_stats
+bucket_state = _basics.bucket_state
 reduce_pool_stats = _basics.reduce_pool_stats
 hier_stats = _basics.hier_stats
 lockdep_stats = _basics.lockdep_stats

@@ -179,6 +179,11 @@ _lib.hvd_shm_stats.restype = c_int
 _lib.hvd_shm_stats.argtypes = [P_int64, P_int64, P_int64, P_int64]
 _lib.hvd_shm_state.restype = c_int
 _lib.hvd_shm_state.argtypes = [P_int64]
+_lib.hvd_bucket_stats.restype = c_int
+_lib.hvd_bucket_stats.argtypes = [P_int64, P_int64, P_int64, P_int64,
+                                  P_int64, P_int64]
+_lib.hvd_bucket_state.restype = c_int
+_lib.hvd_bucket_state.argtypes = [P_int64]
 _lib.hvd_reduce_pool_stats.restype = c_int
 _lib.hvd_reduce_pool_stats.argtypes = [P_int64, P_int64, P_int64]
 _lib.hvd_reduce_bench.restype = c_double
@@ -413,6 +418,43 @@ class HorovodBasics:
         if rc < 0:
             raise ValueError("horovod_tpu has not been initialized")
         return bool(rc), threshold.value
+
+    def bucket_stats(self):
+        """(launched, early, assembled, flushes, invalidations,
+        plan_buckets) for the backprop-ordered bucket assembler
+        (HVD_BUCKET / the autotune `bucket` arm): buckets whose allreduce
+        launched the cycle their last member arrived, buckets that
+        launched BEFORE the step's backward finished producing gradients
+        (the overlap proof the acceptance tests pin), tensors that rode a
+        completed bucket, incomplete buckets released ungrouped on the
+        HVD_BUCKET_FLUSH_MS timeout, learned-plan rebuilds (graph/shape
+        change), and the current plan's bucket count (0 = learning or
+        disabled)."""
+        launched = c_int64(0)
+        early = c_int64(0)
+        assembled = c_int64(0)
+        flushes = c_int64(0)
+        invalidations = c_int64(0)
+        plan_buckets = c_int64(0)
+        rc = _lib.hvd_bucket_stats(
+            ctypes.byref(launched), ctypes.byref(early),
+            ctypes.byref(assembled), ctypes.byref(flushes),
+            ctypes.byref(invalidations), ctypes.byref(plan_buckets))
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        return (launched.value, early.value, assembled.value, flushes.value,
+                invalidations.value, plan_buckets.value)
+
+    def bucket_state(self):
+        """(enabled, bucket_bytes): whether the bucket assembler is live
+        (HVD_BUCKET=1 or the autotune `bucket` arm adopted it, and it has
+        not self-disabled after repeated flush timeouts) and the
+        per-bucket size bound (HVD_BUCKET_BYTES)."""
+        nbytes = c_int64(0)
+        rc = _lib.hvd_bucket_state(ctypes.byref(nbytes))
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        return bool(rc), nbytes.value
 
     def reduce_pool_stats(self):
         """(threads, jobs, spans): configured reduce-pool lanes

@@ -33,11 +33,26 @@ from .basics import basics as _basics
 from .ops import collective_ops as _core
 
 
+def _dist_initialized():
+    """jax.distributed.is_initialized with a fallback for jax releases
+    that don't expose it (0.4.x): probe the distributed client state."""
+    import jax
+
+    if hasattr(jax.distributed, "is_initialized"):
+        return jax.distributed.is_initialized()
+    try:
+        from jax._src import distributed as _d
+
+        return _d.global_state.client is not None
+    except Exception:
+        return False
+
+
 def _ckptr():
     import jax
     import orbax.checkpoint as ocp
 
-    me = jax.process_index() if jax.distributed.is_initialized() else 0
+    me = jax.process_index() if _dist_initialized() else 0
     return ocp.Checkpointer(
         ocp.StandardCheckpointHandler(),
         multiprocessing_options=ocp.options.MultiprocessingOptions(

@@ -72,6 +72,33 @@ class Compression:
     bf16 = BF16Compressor
 
 
+# Wire-cast engagement counters: every framework fast path that consults
+# wire_cast_dtype reports whether the cast actually engaged (`engaged`) or
+# fell back to compress/decompress or no-op (`fallback`), so the
+# `compression=` kwarg is measurably live rather than silently ignored.
+# Process-global like the core's stat counters; read via stats().
+_wire_cast_engaged = 0
+_wire_cast_fallback = 0
+
+
+def record_wire_cast(engaged):
+    """Count one wire-cast routing decision (True = the bucket/grouped
+    path cast the payload to the compressor's wire dtype; False = counted
+    fallback: custom compressor, non-float payload, or a path without the
+    cast hook)."""
+    global _wire_cast_engaged, _wire_cast_fallback
+    if engaged:
+        _wire_cast_engaged += 1
+    else:
+        _wire_cast_fallback += 1
+
+
+def stats():
+    """{"engaged": n, "fallback": n} wire-cast routing decisions since
+    process start."""
+    return {"engaged": _wire_cast_engaged, "fallback": _wire_cast_fallback}
+
+
 def wire_cast_dtype(compression):
     """The wire dtype name implementing `compression` as a bare cast on a
     fast path ("float16" / "bfloat16"), None for no compression, or

@@ -358,6 +358,7 @@ struct ResponseList {
   int8_t tuned_zerocopy = -1;  // scatter-gather allreduce toggle
   int8_t tuned_pipeline = -1;  // ring-pipeline (streamed reduce) toggle
   int8_t tuned_shm = -1;       // intra-host shared-memory plane toggle
+  int8_t tuned_bucket = -1;    // backprop-ordered gradient bucketing toggle
   bool tuned_locked = false;  // coordinator's search finished
 
   void serialize(Writer& w) const {
@@ -374,6 +375,7 @@ struct ResponseList {
     w.u8((uint8_t)(tuned_zerocopy + 1));
     w.u8((uint8_t)(tuned_pipeline + 1));
     w.u8((uint8_t)(tuned_shm + 1));
+    w.u8((uint8_t)(tuned_bucket + 1));
     w.u8(tuned_locked ? 1 : 0);
   }
   static ResponseList deserialize(Reader& r) {
@@ -393,6 +395,7 @@ struct ResponseList {
     l.tuned_zerocopy = (int8_t)r.u8() - 1;
     l.tuned_pipeline = (int8_t)r.u8() - 1;
     l.tuned_shm = (int8_t)r.u8() - 1;
+    l.tuned_bucket = (int8_t)r.u8() - 1;
     l.tuned_locked = r.u8() != 0;
     return l;
   }

@@ -179,6 +179,15 @@ struct Global {
   // it without a job up.
   int reduce_threads = 1;
 
+  // Backprop-ordered gradient bucketing (tensor_queue.h BucketAssembler).
+  // bucket_allowed is the HVD_BUCKET master switch (0 kills the assembler
+  // AND its autotune arm); the live on/off state and all counters live on
+  // TensorQueue under its own lock. Bucketed members ride the coordinator's
+  // atomic-group release, which bypasses the response cache — so the live
+  // default is OFF unless HVD_BUCKET=1 or the autotune bucket arm adopts
+  // it, keeping steady-state cache behavior unchanged for unbucketed jobs.
+  bool bucket_allowed = true;
+
   std::thread background;
 
   DebugMutex handle_mu{"handle_table"};
@@ -842,10 +851,10 @@ void AutotuneCycle(ResponseList& rl) {
   if (g->autotune.active()) {
     int64_t fusion;
     double cycle_ms;
-    int cache_on, hier_on, zerocopy_on, pipeline_on, shm_on;
+    int cache_on, hier_on, zerocopy_on, pipeline_on, shm_on, bucket_on;
     if (g->autotune.Record(PayloadBytes(rl), NowUs(), &fusion, &cycle_ms,
                            &cache_on, &hier_on, &zerocopy_on, &pipeline_on,
-                           &shm_on)) {
+                           &shm_on, &bucket_on)) {
       rl.tuned_fusion = fusion;
       rl.tuned_cycle_ms = cycle_ms;
       rl.tuned_cache = (int8_t)cache_on;
@@ -853,6 +862,7 @@ void AutotuneCycle(ResponseList& rl) {
       rl.tuned_zerocopy = (int8_t)zerocopy_on;
       rl.tuned_pipeline = (int8_t)pipeline_on;
       rl.tuned_shm = (int8_t)shm_on;
+      rl.tuned_bucket = (int8_t)bucket_on;
     }
   }
   rl.tuned_locked = !g->autotune.active();
@@ -887,6 +897,11 @@ void ProcessResponseList(ResponseList& rl) {
                                     ? 0
                                     : g->ring_pipeline_cfg)
                              : 1);
+  // The bucket toggle is adopted up front like the other stateless arms;
+  // turning it OFF flushes everything the assembler holds back into
+  // pending_, so no request is stranded across the flip.
+  if (rl.tuned_bucket >= 0 && g->bucket_allowed)
+    g->queue.SetBucketEnabled(rl.tuned_bucket != 0, NowUs());
   if (rl.tuned_locked && g->autotune.enabled()) g->autotune.SetDone();
   if (CacheOn()) {
     for (uint32_t b : rl.evict_bits) {
@@ -957,6 +972,10 @@ void BackgroundLoop() {
       RequestList mine;
       mine.requests = g->queue.PopRequests(NowUs());
       mine.shutdown = g->shutdown_requested.load();
+      // Bucket assembler sub-events (hold spans, launches, flushes) are
+      // accumulated under the queue lock and recorded here, off it.
+      for (auto& ev : g->queue.TakeBucketEvents())
+        g->timeline.Record(ev.name, ev.phase, ev.start_us, ev.end_us);
       CacheFilterRequests(mine);
 
       ResponseList rl;
@@ -1415,6 +1434,17 @@ int hvd_init() {
     g->data.set_shm_threshold(EnvInt("HVD_SHM_THRESHOLD", 0));
     g->shm_slot_bytes = EnvInt("HVD_SHM_SLOT_BYTES", 512 * 1024);
     g->shm_nslots = (int)EnvInt("HVD_SHM_SLOTS", 4);
+    // Gradient bucketing: HVD_BUCKET=0 kills the assembler and its
+    // autotune arm; HVD_BUCKET=1 turns it on live from the first step;
+    // unset = allowed-but-off (the autotune bucket arm can adopt it).
+    // HVD_BUCKET_BYTES bounds each bucket (default 32 MiB);
+    // HVD_BUCKET_FLUSH_MS bounds how long an incomplete bucket may hold
+    // its members back from negotiation.
+    g->bucket_allowed = EnvInt("HVD_BUCKET", -1) != 0;
+    g->queue.ConfigureBuckets(EnvInt("HVD_BUCKET_BYTES", 32 << 20),
+                              EnvInt("HVD_BUCKET_FLUSH_MS", 250) * 1000);
+    g->queue.SetBucketEnabled(
+        g->bucket_allowed && EnvInt("HVD_BUCKET", -1) == 1, NowUs());
     // Reduce worker pool: spans of large reductions fan out across
     // HVD_REDUCE_THREADS lanes (default min(4, cores-1); 1 = inline, the
     // pre-pool behavior and the only sane default on a 1-core box).
@@ -1450,6 +1480,7 @@ int hvd_init() {
         g->cache.enabled(), g->hierarchical, g->zerocopy_on,
         /*init_pipeline=*/g->ring_pipeline_cfg != 1,
         /*init_shm=*/g->data.shm_enabled(),
+        /*init_bucket=*/g->queue.bucket_enabled(),
         /*can_toggle_cache=*/g->cache.enabled(),
         // On a single host the hierarchical arm only pays off when the
         // local phase actually rides shm — without the plane it degrades
@@ -1463,7 +1494,10 @@ int hvd_init() {
         /*can_toggle_pipeline=*/g->size > 1 && g->ring_pipeline_cfg != 1,
         // Same opt-out rule for shm: HVD_SHM=0 or no plane (single rank
         // per host, non-uniform topology) drops the dimension.
-        /*can_toggle_shm=*/g->shm_allowed && g->data.shm().active());
+        /*can_toggle_shm=*/g->shm_allowed && g->data.shm().active(),
+        // Bucketing pays off only when a peer exists to overlap comms
+        // against; HVD_BUCKET=0 is the operator opting out of the arm.
+        /*can_toggle_bucket=*/g->bucket_allowed && g->size > 1);
     g->data.set_timeout_ms(
         (int)(EnvDouble("HVD_DATA_TIMEOUT_SECONDS", 300.0) * 1000.0));
     LogF(LogLevel::kInfo,
@@ -1885,6 +1919,34 @@ int hvd_shm_state(int64_t* threshold) {
   if (!g || !g->initialized) return -1;
   if (threshold) *threshold = g->data.shm_threshold();
   return g->data.shm().active() && g->data.shm_enabled() ? 1 : 0;
+}
+
+// Bucket-assembler observability: buckets launched complete, buckets
+// launched BEFORE the step's backward finished producing gradients (the
+// overlap proof), tensors that rode a completed bucket, timeout flushes,
+// and plan invalidations; plan_buckets is the current learned plan's size
+// (0 = still learning / disabled).
+int hvd_bucket_stats(int64_t* launched, int64_t* early, int64_t* assembled,
+                     int64_t* flushes, int64_t* invalidations,
+                     int64_t* plan_buckets) {
+  if (!g || !g->initialized) return -1;
+  BucketStatsSnapshot s = g->queue.BucketStats();
+  if (launched) *launched = s.launched;
+  if (early) *early = s.early;
+  if (assembled) *assembled = s.assembled;
+  if (flushes) *flushes = s.flushes;
+  if (invalidations) *invalidations = s.invalidations;
+  if (plan_buckets) *plan_buckets = s.plan_buckets;
+  return 0;
+}
+
+// Current bucket-assembler state: returns -1 uninitialized, 0 off
+// (HVD_BUCKET=0, the autotune arm, or self-disabled after repeated
+// flushes), 1 live; *bucket_bytes gets the per-bucket size bound.
+int hvd_bucket_state(int64_t* bucket_bytes) {
+  if (!g || !g->initialized) return -1;
+  if (bucket_bytes) *bucket_bytes = g->queue.bucket_bytes();
+  return g->bucket_allowed && g->queue.bucket_enabled() ? 1 : 0;
 }
 
 // Reduce-pool observability: configured lanes, pooled dispatches, and

@@ -9,6 +9,7 @@
 #include <mutex>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include "common.h"
@@ -23,6 +24,25 @@ struct TensorTableEntry {
   int handle = -1;
   int64_t enqueue_us = 0;  // timeline QUEUE phase start
   int64_t popped_us = 0;   // announce time: QUEUE -> NEGOTIATE_* boundary
+};
+
+// One TCP_BUCKET_* timeline sub-event, drained by the background loop each
+// cycle (timeline.Record must not run under the queue lock).
+struct BucketEvent {
+  std::string name;
+  std::string phase;  // TCP_BUCKET_ASSEMBLE / TCP_BUCKET_LAUNCH / _FLUSH
+  int64_t start_us = 0;
+  int64_t end_us = 0;
+};
+
+// Counters for hvd.bucket_stats(); snapshot under the queue lock.
+struct BucketStatsSnapshot {
+  int64_t launched = 0;       // buckets released with all members present
+  int64_t early = 0;          // ...released BEFORE the step's last tensor
+  int64_t assembled = 0;      // tensors that rode a completed bucket
+  int64_t flushes = 0;        // buckets released ungrouped on timeout
+  int64_t invalidations = 0;  // plan rebuilds (graph/shape change)
+  int64_t plan_buckets = 0;   // buckets in the current learned plan
 };
 
 class TensorQueue {
@@ -48,11 +68,16 @@ class TensorQueue {
 
   // Drain requests not yet sent to the coordinator (called once per cycle);
   // stamps each drained entry's announce time for the timeline's
-  // QUEUE -> NEGOTIATE_* phase boundary.
+  // QUEUE -> NEGOTIATE_* phase boundary. When the bucket assembler is live,
+  // eligible allreduces are routed through it first: a request whose learned
+  // bucket is still filling is held back (not announced) and released — as
+  // one atomic group — the cycle its bucket's last member arrives.
   std::vector<Request> PopRequests(int64_t now_us = 0) {
     std::lock_guard<DebugMutex> l(mu_);
     std::vector<Request> out;
     out.swap(pending_);
+    if (bucket_on_ && !bucket_self_disabled_)
+      out = BucketFilter(std::move(out), now_us);
     for (auto& q : out) {
       auto it = table_.find(Key(q.process_set, q.name));
       if (it != table_.end()) it->second.popped_us = now_us;
@@ -101,6 +126,8 @@ class TensorQueue {
     for (auto& kv : table_) out.push_back(std::move(kv.second));
     table_.clear();
     pending_.clear();
+    for (auto& h : held_) h.clear();  // entries above already cover them
+    arrived_.clear();
     return out;
   }
 
@@ -109,10 +136,258 @@ class TensorQueue {
     return table_.size();
   }
 
+  // --- Bucket assembler (backprop-ordered gradient bucketing) -------------
+  // Tensors are assigned to size-bounded buckets in the order backward
+  // completion was OBSERVED on the first step (learning); later steps replay
+  // the plan, holding each request until its bucket's last member arrives.
+  // Early buckets therefore negotiate + reduce while backward is still
+  // producing the rest — the overlap this subsystem exists for. An unknown
+  // name or a changed byte size invalidates the plan (graph change); a
+  // bucket held past the flush timeout is released ungrouped and the plan
+  // is dropped (partial step / frozen params), so nothing can deadlock on a
+  // plan the workload stopped following.
+
+  void ConfigureBuckets(int64_t bucket_bytes, int64_t flush_us) {
+    std::lock_guard<DebugMutex> l(mu_);
+    bucket_bytes_ = bucket_bytes > 0 ? bucket_bytes : 32 << 20;
+    bucket_flush_us_ = flush_us > 0 ? flush_us : 250000;
+  }
+
+  // Adopt the live toggle (HVD_BUCKET / the autotune arm, cycle-synchronized
+  // via ResponseList.tuned_bucket). Disabling releases everything held into
+  // pending_ so no request is stranded; re-enabling re-arms a self-disabled
+  // assembler and starts a fresh learning pass.
+  void SetBucketEnabled(bool on, int64_t now_us) {
+    std::lock_guard<DebugMutex> l(mu_);
+    if (bucket_on_ && !on) ResetPlanLocked(now_us, &pending_, false);
+    if (!bucket_on_ && on) {
+      bucket_self_disabled_ = false;
+      bucket_flush_streak_ = 0;
+    }
+    bucket_on_ = on;
+  }
+
+  bool bucket_enabled() {
+    std::lock_guard<DebugMutex> l(mu_);
+    return bucket_on_ && !bucket_self_disabled_;
+  }
+
+  int64_t bucket_bytes() {
+    std::lock_guard<DebugMutex> l(mu_);
+    return bucket_bytes_;
+  }
+
+  BucketStatsSnapshot BucketStats() {
+    std::lock_guard<DebugMutex> l(mu_);
+    BucketStatsSnapshot s = bucket_stats_;
+    s.plan_buckets = (int64_t)plan_.size();
+    return s;
+  }
+
+  // Drained by the background loop each cycle; bounded so an idle timeline
+  // (nobody draining) cannot grow it without limit.
+  std::vector<BucketEvent> TakeBucketEvents() {
+    std::lock_guard<DebugMutex> l(mu_);
+    std::vector<BucketEvent> out;
+    out.swap(bucket_events_);
+    return out;
+  }
+
  private:
+  struct PlanBucket {
+    std::vector<std::string> names;
+    int32_t gid = -1;  // content hash; identical plans agree across ranks
+  };
+  struct HeldMember {
+    Request req;
+    int64_t since_us = 0;
+  };
+
+  // FNV-1a over the bucket's member names: ranks that learned the same
+  // bucket (same members, same order) stamp the same group id without any
+  // extra negotiation. Masked into [0x40000000, 0x7fffffff] so it can never
+  // collide with Python's alloc_group_id() counter (counts up from 0).
+  static int32_t BucketGid(const std::vector<std::string>& names) {
+    uint64_t h = 1469598103934665603ull;
+    for (auto& n : names) {
+      for (char c : n) {
+        h ^= (uint8_t)c;
+        h *= 1099511628211ull;
+      }
+      h ^= 0x1f;  // member boundary
+      h *= 1099511628211ull;
+    }
+    return (int32_t)((h & 0x3fffffff) | 0x40000000);
+  }
+
+  static int64_t PayloadBytesOf(const Request& q) {
+    return NumElements(q.shape) * (int64_t)DataTypeSize(q.dtype);
+  }
+
+  // Only plain allreduces on the global process set ride the assembler:
+  // explicitly grouped submissions already carry atomic-launch semantics,
+  // and sub-process-set traffic is too rare to learn a stable order from.
+  static bool BucketEligible(const Request& q) {
+    return q.op_type == OpType::kAllreduce && q.group_id < 0 &&
+           q.process_set == 0;
+  }
+
+  void Emit(const std::string& name, const char* phase, int64_t start_us,
+            int64_t end_us) {
+    if (bucket_events_.size() >= 4096) return;  // bound when nobody drains
+    bucket_events_.push_back({name, phase, start_us, end_us});
+  }
+
+  // Release bucket b's held members into `out` (grouped when complete, plain
+  // when flushing). Caller holds mu_.
+  void ReleaseBucketLocked(size_t b, int64_t now_us,
+                           std::vector<Request>* out, bool complete) {
+    auto& held = held_[b];
+    if (held.empty()) return;
+    const char* phase = complete ? "TCP_BUCKET_LAUNCH" : "TCP_BUCKET_FLUSH";
+    Emit("bucket." + std::to_string(b), phase, held.front().since_us, now_us);
+    bool grouped = complete && held.size() > 1;
+    for (auto& m : held) {
+      Emit(m.req.name, "TCP_BUCKET_ASSEMBLE", m.since_us, now_us);
+      if (grouped) {
+        m.req.group_id = plan_[b].gid;
+        m.req.group_size = (int32_t)held.size();
+      }
+      out->push_back(std::move(m.req));
+    }
+    if (complete) {
+      bucket_stats_.launched++;
+      bucket_stats_.assembled += (int64_t)held.size();
+      // Released while the step's later tensors are still outstanding: the
+      // overlap proof the acceptance counters pin.
+      if (arrived_.size() < plan_names_.size()) bucket_stats_.early++;
+    } else {
+      bucket_stats_.flushes++;
+    }
+    held.clear();
+  }
+
+  // Drop the plan (flush/invalidate/disable) and reset to learning; held
+  // members are released ungrouped into `out` first. Caller holds mu_.
+  void ResetPlanLocked(int64_t now_us, std::vector<Request>* out,
+                       bool count_invalidation) {
+    for (size_t b = 0; b < held_.size(); b++)
+      ReleaseBucketLocked(b, now_us, out, false);
+    if (count_invalidation && !plan_.empty()) bucket_stats_.invalidations++;
+    plan_.clear();
+    plan_index_.clear();
+    plan_names_.clear();
+    held_.clear();
+    arrived_.clear();
+    learn_order_.clear();
+    learn_bytes_.clear();
+  }
+
+  // Greedy partition of the learned order into size-bounded buckets.
+  // Caller holds mu_.
+  void BuildPlanLocked() {
+    PlanBucket cur;
+    int64_t cur_bytes = 0;
+    for (auto& name : learn_order_) {
+      int64_t b = learn_bytes_[name];
+      if (!cur.names.empty() && cur_bytes + b > bucket_bytes_) {
+        plan_.push_back(std::move(cur));
+        cur = PlanBucket();
+        cur_bytes = 0;
+      }
+      cur.names.push_back(name);
+      cur_bytes += b;
+    }
+    if (!cur.names.empty()) plan_.push_back(std::move(cur));
+    for (size_t i = 0; i < plan_.size(); i++) {
+      plan_[i].gid = BucketGid(plan_[i].names);
+      for (auto& n : plan_[i].names) {
+        plan_index_[n] = i;
+        plan_names_.insert(n);
+      }
+    }
+    held_.assign(plan_.size(), {});
+  }
+
+  std::vector<Request> BucketFilter(std::vector<Request> in, int64_t now_us) {
+    std::vector<Request> out;
+    out.reserve(in.size());
+    for (auto& q : in) {
+      if (!BucketEligible(q)) {
+        out.push_back(std::move(q));
+        continue;
+      }
+      int64_t bytes = PayloadBytesOf(q);
+      if (plan_.empty()) {
+        // Learning: pass through unchanged while recording the observed
+        // completion order. The first REPEATED name signals step 2 — build
+        // the plan and replay this request under it.
+        auto it = learn_bytes_.find(q.name);
+        if (it == learn_bytes_.end()) {
+          learn_order_.push_back(q.name);
+          learn_bytes_[q.name] = bytes;
+          out.push_back(std::move(q));
+          continue;
+        }
+        BuildPlanLocked();
+      }
+      auto pit = plan_index_.find(q.name);
+      if (pit == plan_index_.end() || learn_bytes_[q.name] != bytes) {
+        // Graph change: unknown tensor or a resized one. Flush + relearn,
+        // seeding the fresh pass with this request.
+        ResetPlanLocked(now_us, &out, true);
+        learn_order_.push_back(q.name);
+        learn_bytes_[q.name] = bytes;
+        out.push_back(std::move(q));
+        continue;
+      }
+      // A name re-arriving before the step closed means the previous step
+      // never completed (some plan members skipped); start a new step.
+      if (arrived_.count(q.name)) arrived_.clear();
+      arrived_.insert(q.name);
+      size_t b = pit->second;
+      held_[b].push_back({std::move(q), now_us});
+      if (held_[b].size() == plan_[b].names.size()) {
+        ReleaseBucketLocked(b, now_us, &out, true);
+        bucket_flush_streak_ = 0;
+      }
+      if (arrived_.size() == plan_names_.size()) arrived_.clear();
+    }
+    // Flush timeout: a bucket held past the deadline (partial step, frozen
+    // params, a blocking caller between same-bucket submissions) releases
+    // ungrouped and drops the plan. Repeated flushing means the workload's
+    // submission pattern fights the assembler — self-disable after a few so
+    // a blocking sync loop pays a bounded, not recurring, latency cost.
+    for (size_t b = 0; b < held_.size(); b++) {
+      if (held_[b].empty() ||
+          now_us - held_[b].front().since_us < bucket_flush_us_)
+        continue;
+      ResetPlanLocked(now_us, &out, false);
+      if (++bucket_flush_streak_ >= 4) bucket_self_disabled_ = true;
+      break;
+    }
+    return out;
+  }
+
   DebugMutex mu_{"tensor_queue"};
   std::unordered_map<std::string, TensorTableEntry> table_;
   std::vector<Request> pending_;
+
+  // Bucket assembler state (all guarded by mu_).
+  bool bucket_on_ = false;
+  bool bucket_self_disabled_ = false;
+  int bucket_flush_streak_ = 0;
+  int64_t bucket_bytes_ = 32 << 20;
+  int64_t bucket_flush_us_ = 250000;
+  std::vector<std::string> learn_order_;
+  std::unordered_map<std::string, int64_t> learn_bytes_;
+  std::vector<PlanBucket> plan_;
+  std::unordered_map<std::string, size_t> plan_index_;
+  std::unordered_set<std::string> plan_names_;
+  std::vector<std::vector<HeldMember>> held_;
+  std::unordered_set<std::string> arrived_;  // distinct names this step
+  BucketStatsSnapshot bucket_stats_;
+  std::vector<BucketEvent> bucket_events_;
 };
 
 }  // namespace hvd

@@ -21,7 +21,7 @@ from jax import shard_map  # requires jax >= 0.8
 
 def make_train_step(loss_fn, tx, mesh, data_axis="data", extra_reduce=None,
                     jit=True, donate=True, accum_steps=1,
-                    grad_reduce="mean"):
+                    grad_reduce="mean", bucket_bytes=None):
     """Build `step(params, opt_state, batch) -> (params, opt_state, loss)`.
 
     - `loss_fn(params, batch) -> scalar loss` written for ONE shard of the
@@ -43,7 +43,20 @@ def make_train_step(loss_fn, tx, mesh, data_axis="data", extra_reduce=None,
       Adasum (ops/jax_ops.py `adasum` — the op=hvd.Adasum analog, VHDD
       over ICI; requires power-of-two axis sizes). The loss stays
       pmean-averaged either way.
+    - ``bucket_bytes`` enables bucketed psum scheduling: gradient leaves
+      are grouped — in reversed (≈ backward-completion) order, bounded by
+      ``bucket_bytes`` per bucket and split on dtype changes — each
+      bucket's raveled leaves concatenated and reduced as ONE pmean.
+      Per-leaf tree.map emits collectives XLA tends to coalesce at the
+      end of backward; per-bucket collectives give the scheduler
+      independent units it can interleave with the (possibly remat'd)
+      backward. Default None defers to HVD_BUCKET / HVD_BUCKET_BYTES
+      (the core assembler's knobs); 0 disables. Applies to
+      ``grad_reduce="mean"``; adasum keeps per-leaf reduction (bucket
+      concatenation would change its per-tensor VHDD geometry).
     """
+    import os
+
     axes = (data_axis,) if isinstance(data_axis, str) else tuple(data_axis)
     accum_steps = int(accum_steps)
     if accum_steps < 1:
@@ -51,6 +64,12 @@ def make_train_step(loss_fn, tx, mesh, data_axis="data", extra_reduce=None,
     if grad_reduce not in ("mean", "adasum"):
         raise ValueError(f"grad_reduce must be 'mean' or 'adasum', "
                          f"got {grad_reduce!r}")
+    if bucket_bytes is None:
+        bucket_bytes = int(os.environ.get("HVD_BUCKET_BYTES", str(32 << 20))) \
+            if os.environ.get("HVD_BUCKET") == "1" else 0
+    bucket_bytes = int(bucket_bytes)
+    if grad_reduce != "mean":
+        bucket_bytes = 0
 
     # Gradient reducer picked ONCE at build time: "adasum" = the
     # device-plane Adasum (ops/jax_ops.py `adasum` — op=hvd.Adasum
@@ -70,6 +89,36 @@ def make_train_step(loss_fn, tx, mesh, data_axis="data", extra_reduce=None,
         for ax in axes:
             x = _reduce_one(x, ax)
         return x
+
+    def _bucketed_grad_reduce(grads):
+        """One pmean per size-bounded bucket of raveled leaves, visited in
+        reversed flatten order (the leaves whose grads complete first in
+        backward). Buckets never mix dtypes — concatenate would promote."""
+        leaves, treedef = jax.tree.flatten(grads)
+        buckets, cur, cur_bytes = [], [], 0
+        for i in reversed(range(len(leaves))):
+            nbytes = leaves[i].size * leaves[i].dtype.itemsize
+            if cur and (cur_bytes + nbytes > bucket_bytes
+                        or leaves[cur[-1]].dtype != leaves[i].dtype):
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += nbytes
+        if cur:
+            buckets.append(cur)
+        out = [None] * len(leaves)
+        for b in buckets:
+            if len(b) == 1:
+                out[b[0]] = _grad_reduce_all(leaves[b[0]])
+                continue
+            flat = jnp.concatenate([leaves[i].ravel() for i in b])
+            red = _grad_reduce_all(flat)
+            off = 0
+            for i in b:
+                n = leaves[i].size
+                out[i] = red[off:off + n].reshape(leaves[i].shape)
+                off += n
+        return jax.tree.unflatten(treedef, out)
 
     def _shard_grad(params, batch):
         if accum_steps == 1:
@@ -114,7 +163,10 @@ def make_train_step(loss_fn, tx, mesh, data_axis="data", extra_reduce=None,
     )
     def step(params, opt_state, batch):
         loss, grads = _shard_grad(params, batch)
-        grads = jax.tree.map(_grad_reduce_all, grads)
+        if bucket_bytes > 0:
+            grads = _bucketed_grad_reduce(grads)
+        else:
+            grads = jax.tree.map(_grad_reduce_all, grads)
         if extra_reduce is not None:
             grads = extra_reduce(grads)
         updates, opt_state = tx.update(grads, opt_state, params)

@@ -39,6 +39,9 @@ ARG_TO_ENV = {
     "ring_pipeline": ("HVD_RING_PIPELINE", lambda v: str(int(v))),
     "shm_threshold_mb": ("HVD_SHM_THRESHOLD",
                          lambda v: str(int(float(v) * _MB))),
+    "bucket": ("HVD_BUCKET", lambda v: str(int(v))),
+    "bucket_bytes": ("HVD_BUCKET_BYTES", lambda v: str(int(v))),
+    "bucket_flush_ms": ("HVD_BUCKET_FLUSH_MS", lambda v: str(int(v))),
     "reduce_threads": ("HVD_REDUCE_THREADS", lambda v: str(int(v))),
     "timeline_filename": ("HVD_TIMELINE", str),
     "timeline_mark_cycles": ("HVD_TIMELINE_MARK_CYCLES",
@@ -65,6 +68,9 @@ _FILE_SECTIONS = {
                "zerocopy-threshold-mb": "zerocopy_threshold_mb",
                "ring-pipeline": "ring_pipeline",
                "shm-threshold-mb": "shm_threshold_mb",
+               "bucket": "bucket",
+               "bucket-bytes": "bucket_bytes",
+               "bucket-flush-ms": "bucket_flush_ms",
                "reduce-threads": "reduce_threads",
                "start-timeout": "start_timeout",
                "log-level": "log_level"},

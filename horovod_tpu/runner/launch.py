@@ -68,6 +68,23 @@ def parse_args(argv=None):
                    help="min payload MB routed over the intra-host "
                         "shared-memory plane (HVD_SHM_THRESHOLD); smaller "
                         "same-host messages stay on TCP")
+    p.add_argument("--bucket", dest="bucket", type=int, choices=[0, 1],
+                   default=None,
+                   help="backprop-ordered gradient bucketing (HVD_BUCKET): "
+                        "1 forces it live from init, 0 disables it and "
+                        "removes the autotune arm; unset leaves it off but "
+                        "sweepable by autotune")
+    p.add_argument("--bucket-bytes", dest="bucket_bytes", type=int,
+                   default=None,
+                   help="gradient bucket size bound in bytes "
+                        "(HVD_BUCKET_BYTES, default 32 MiB): allreduces "
+                        "are grouped into buckets of at most this many "
+                        "payload bytes in backward-completion order")
+    p.add_argument("--bucket-flush-ms", dest="bucket_flush_ms", type=int,
+                   default=None,
+                   help="ms an incomplete gradient bucket may hold its "
+                        "members before flushing ungrouped "
+                        "(HVD_BUCKET_FLUSH_MS, default 250)")
     p.add_argument("--reduce-threads", dest="reduce_threads", type=int,
                    default=None,
                    help="reduce worker-pool lanes (HVD_REDUCE_THREADS): 1 "

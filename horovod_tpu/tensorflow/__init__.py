@@ -17,6 +17,7 @@ everything falls back to the bridge; `HVD_TF_NATIVE_OPS=0` forces that.
 import numpy as np
 
 from ..basics import basics as _basics
+from .. import compression as _compression
 from ..compression import Compression  # noqa: F401
 from ..exceptions import (  # noqa: F401
     HorovodInternalError,
@@ -344,11 +345,19 @@ def _sparse_allreduce(g, op, name, process_set):
 
 def DistributedGradientTape(tape, op=Average, compression=None,
                             process_set=0, sparse_as_dense=False,
-                            num_groups=0, gradient_predivide_factor=1.0):
+                            num_groups=0, gradient_predivide_factor=1.0,
+                            bucket_bytes=None):
     """Wrap tf.GradientTape so gradient() allreduces the results in one
     fused group (reference: `_DistributedGradientTape`).
     ``gradient_predivide_factor`` splits the averaging around the sum
     (prescale 1/f, postscale f/size); requires op=Average.
+
+    ``bucket_bytes`` enables ordered tape-gradient slicing on the eager
+    path: dense grads are cut, in tape order, into size-bounded buckets
+    and each bucket's grouped allreduce launches async as soon as it is
+    sliced, overlapping reduction with the host-side prep of later
+    buckets. Default None defers to the HVD_BUCKET / HVD_BUCKET_BYTES
+    env knobs (same live-default as the core assembler); 0 disables.
 
     Sparse gradients (tf.IndexedSlices, e.g. from tf.gather): with
     ``sparse_as_dense=True`` they densify and ride the fused dense group;
@@ -382,15 +391,88 @@ def DistributedGradientTape(tape, op=Average, compression=None,
                 dense_idx.append(i)
                 dense.append(g)
             if dense:
-                outs = _grouped_np(
-                    dense, op=op, name="tape.grads",
-                    process_set=process_set, compression=compression,
-                    gradient_predivide_factor=gradient_predivide_factor)
+                bb = _resolve_bucket_bytes(bucket_bytes)
+                if bb > 0 and len(dense) > 1 and tf.executing_eagerly():
+                    outs = _bucketed_np(
+                        dense, op=op, name="tape.grads",
+                        process_set=process_set, compression=compression,
+                        gradient_predivide_factor=gradient_predivide_factor,
+                        bucket_bytes=bb)
+                else:
+                    outs = _grouped_np(
+                        dense, op=op, name="tape.grads",
+                        process_set=process_set, compression=compression,
+                        gradient_predivide_factor=gradient_predivide_factor)
                 for j, i in enumerate(dense_idx):
                     flat[i] = outs[j]
             return tf.nest.pack_sequence_as(grads, flat)
 
     return _Wrapped(tape)
+
+
+def _resolve_bucket_bytes(bucket_bytes):
+    """Tape-slicing bucket size: an explicit kwarg wins (0 disables); with
+    no kwarg, slicing engages only when HVD_BUCKET=1, sized by
+    HVD_BUCKET_BYTES (default 32 MiB) — the same live-default as the
+    core's ordered bucket assembler, so one env flips both layers."""
+    import os
+
+    if bucket_bytes is not None:
+        return int(bucket_bytes)
+    if os.environ.get("HVD_BUCKET") != "1":
+        return 0
+    return int(os.environ.get("HVD_BUCKET_BYTES", str(32 << 20)))
+
+
+def _bucketed_np(tensors, op, name, process_set, compression,
+                 gradient_predivide_factor, bucket_bytes):
+    """Ordered tape-gradient slicing (eager): cut `tensors` — already in
+    tape order — into buckets bounded by `bucket_bytes`, submitting each
+    bucket's grouped allreduce the moment it is sliced. Bucket k then
+    reduces on the core's background thread while bucket k+1 is still
+    being converted/compressed here; synchronize drains in order. Each
+    bucket is its own atomic group, so the coordinator releases it as
+    soon as its own members are ready — not when the whole step is."""
+    tf = _tf()
+    eff_op, pre, post = _core.predivide_factors(
+        op, gradient_predivide_factor, process_set)
+    if compression is not None:
+        # bridge compress/decompress, not a wire cast: counted fallback
+        _compression.record_wire_cast(False)
+    handles, ctxs = [], []
+    start, bucket = 0, 0
+    while start < len(tensors):
+        end, size = start, 0
+        while end < len(tensors):
+            nbytes = (tensors[end].shape.num_elements() or 1) \
+                * tensors[end].dtype.size
+            if end > start and size + nbytes > bucket_bytes:
+                break
+            size += nbytes
+            end += 1
+        arrs, cs = [], []
+        for t in tensors[start:end]:
+            a = t.numpy() if hasattr(t, "numpy") else np.asarray(t)
+            if compression is not None:
+                a, c = compression.compress(a)
+            else:
+                c = None
+            arrs.append(a)
+            cs.append(c)
+        handles.extend(_core.grouped_allreduce_async(
+            arrs, op=eff_op, name=f"{name}.bucket{bucket}",
+            process_set=process_set, prescale_factor=pre,
+            postscale_factor=post))
+        ctxs.extend(cs)
+        start = end
+        bucket += 1
+    outs = []
+    for h, c in zip(handles, ctxs):
+        o = _core.synchronize(h)
+        if compression is not None:
+            o = compression.decompress(o, c)
+        outs.append(o)
+    return [tf.convert_to_tensor(o) for o in outs]
 
 
 def _grouped_np(tensors, op, name, process_set, compression,
@@ -412,6 +494,7 @@ def _grouped_np(tensors, op, name, process_set, compression,
             op, gradient_predivide_factor, process_set)
         ctxs = []
         if compression is not None:
+            _compression.record_wire_cast(False)
             pairs = [compression.compress(a) for a in arrs]
             arrs = [p[0] for p in pairs]
             ctxs = [p[1] for p in pairs]
@@ -430,6 +513,9 @@ def _grouped_np(tensors, op, name, process_set, compression,
 
     if native_ops.xla_enabled() \
             and _xla_compression_cast(compression) is not ...:
+        if compression is not None \
+                and _xla_compression_cast(compression) is not None:
+            _compression.record_wire_cast(True)  # in-graph wire cast
         return _xla_per_tensor(tensors, op, name, process_set, compression,
                                gradient_predivide_factor)
     # Unknown (custom) compressors can't be expressed as in-graph casts:

@@ -18,6 +18,7 @@ import numpy as np
 import torch
 
 from ..basics import basics as _basics
+from .. import compression as _compression
 from ..compression import Compression  # noqa: F401
 from ..exceptions import (  # noqa: F401
     HorovodInternalError,
@@ -236,6 +237,12 @@ def grouped_allreduce_async_(tensors, op=Average, name=None, process_set=0,
     wire buffers inside the extension."""
     nat = _native_grouped_for(tensors, compression)
     base = name or _core._auto_name("grouped_allreduce", None)
+    if compression is not None:
+        # Wire-cast engagement accounting (compression.stats()): the native
+        # extension casts fp16/bf16 payloads on the wire; every other route
+        # runs compress/decompress on the bridge — a counted fallback.
+        _compression.record_wire_cast(
+            nat is not None and _wire_dtype_code(compression) in (4, 8))
     if nat is not None:
         wire = _wire_dtype_code(compression)
         # _f32: the native ext takes doubles; round like the bridge does
@@ -478,7 +485,7 @@ class _DistributedOptimizerMixin:
     def _hvd_init(self, named_parameters, op, compression,
                   backward_passes_per_step, process_set,
                   gradient_predivide_factor=1.0, num_groups=0,
-                  sparse_as_dense=False):
+                  sparse_as_dense=False, fused_apply=True):
         self._hvd_op = op
         self._hvd_compression = compression
         self._hvd_bpps = backward_passes_per_step
@@ -488,6 +495,15 @@ class _DistributedOptimizerMixin:
         _core.validate_predivide(op, self._hvd_predivide)
         self._hvd_step_count = 0
         self._hvd_handles = {}
+        # Fused apply: once all gradient buckets have synchronized, the
+        # weight update itself should be one multi-tensor pass, not a
+        # per-parameter Python loop — route supported torch optimizers
+        # through their foreach (multi-tensor) apply path.
+        self._hvd_fused_apply = bool(fused_apply) and "foreach" in self.defaults
+        if self._hvd_fused_apply:
+            for group in self.param_groups:
+                if group.get("foreach") is None:
+                    group["foreach"] = True
         # submission-path counters, observable by tests/users: the native
         # extension must carry the hook path whenever it can
         self._hvd_stats = {"native": 0, "bridge": 0}
@@ -553,6 +569,7 @@ class _DistributedOptimizerMixin:
             self._hvd_handles[p] = hs[0]
             return
         # custom compressor: numpy bridge, compress before enqueue
+        _compression.record_wire_cast(False)
         a, ctx = comp.compress(p.grad.detach().cpu().numpy())
         if self._hvd_bpps > 1:
             a = a / self._hvd_bpps
@@ -648,7 +665,8 @@ class _DistributedOptimizerMixin:
 def DistributedOptimizer(optimizer, named_parameters=None, op=Average,
                          compression=None, backward_passes_per_step=1,
                          process_set=0, gradient_predivide_factor=1.0,
-                         num_groups=0, sparse_as_dense=False):
+                         num_groups=0, sparse_as_dense=False,
+                         fused_apply=True):
     """Wrap a torch optimizer: backward hooks launch async allreduces per
     gradient (overlapped with the rest of backward); step() synchronizes
     then applies (reference: horovod/torch DistributedOptimizer).
@@ -662,14 +680,19 @@ def DistributedOptimizer(optimizer, named_parameters=None, op=Average,
     use the numpy bridge. ``sparse_as_dense=True`` densifies sparse
     gradients (nn.Embedding(sparse=True)) before allreduce (reference:
     the torch optimizer's sparse_as_dense flag); without it a sparse
-    gradient fails loudly."""
+    gradient fails loudly. ``fused_apply=True`` (default) applies the
+    post-synchronize weight update as a single multi-tensor (foreach)
+    pass on optimizers that support it, so the apply stage after the
+    last bucket lands is one fused sweep rather than a per-parameter
+    loop."""
     cls = type("DistributedOptimizer",
                (_DistributedOptimizerMixin, optimizer.__class__), {})
     dist = cls.__new__(cls)
     dist.__dict__.update(optimizer.__dict__)
     dist._hvd_init(named_parameters, op, compression,
                    backward_passes_per_step, process_set,
-                   gradient_predivide_factor, num_groups, sparse_as_dense)
+                   gradient_predivide_factor, num_groups, sparse_as_dense,
+                   fused_apply)
     return dist
 
 

@@ -1,0 +1,35 @@
+"""Pins for hvd.checkpoint.save with TP-sharded train state (ISSUE 8
+satellite / ROADMAP item 5 prep): what the orbax-backed save/restore
+actually does today, BEFORE any sharded-checkpoint refactor.
+
+Today's contract (tp_ckpt_worker.py asserts it rank-side):
+
+- Fully-addressable sharded leaves (model axis within one process) are
+  gathered by the root's host pull and written as FULL arrays; restore
+  hands back plain replicated numpy — sharding is not round-tripped.
+- Non-fully-addressable leaves (model axis spanning processes) make
+  save raise on the root before anything hits disk — a loud failure,
+  not a silently-wrong partial checkpoint.
+"""
+
+import pytest
+
+pytest.importorskip("jax")
+pytest.importorskip("orbax.checkpoint")
+
+from .util import run_worker_job
+
+
+def test_tp_sharded_save_gathers_full_arrays(tmp_path):
+    run_worker_job(1, "tp_ckpt_worker.py", timeout=180, extra_env={
+        "CKPT_MODE": "local",
+        "CKPT_DIR": str(tmp_path / "ck"),
+    })
+
+
+def test_cross_process_sharded_save_fails_loudly(tmp_path):
+    run_worker_job(2, "tp_ckpt_worker.py", timeout=240, jax_coord=True,
+                   extra_env={
+                       "CKPT_MODE": "global",
+                       "CKPT_DIR": str(tmp_path / "ck"),
+                   })

@@ -85,11 +85,13 @@ def test_autotune(tmp_path):
         "HVD_AUTOTUNE_MAX_SAMPLES": "20",
         # 2 fake hosts x 2 locals: the hierarchical arm is toggleable, so
         # the categorical sweep covers all 16 (cache, hier, zerocopy,
-        # pipeline) combinations. HVD_SHM=0 removes the shm dimension
-        # (32 arms would outgrow the 20-sample budget); the shm arm is
-        # covered by test_hier_shm.py::test_autotune_shm_arm.
+        # pipeline) combinations. HVD_SHM=0 / HVD_BUCKET=0 remove those
+        # dimensions (32/64 arms would outgrow the 20-sample budget); the
+        # shm arm is covered by test_hier_shm.py::test_autotune_shm_arm,
+        # the bucket arm by test_bucket.py::test_autotune_bucket_arm.
         "AT_LOCAL_SIZE": "2",
         "HVD_SHM": "0",
+        "HVD_BUCKET": "0",
         "EXPECT_ARMS": "16",
     }, timeout=240)
 
@@ -108,14 +110,16 @@ def test_autotune_beats_defaults_32rank(tmp_path):
         "HVD_AUTOTUNE_MAX_SAMPLES": "8",
         "HVD_CYCLE_TIME_MS": "25",
         "AT_LOCAL_SIZE": "8",  # 4 fake hosts x 8: all 4 arms toggleable
-        # Pin the zero-copy, ring-pipeline, and shm arms off: keeps the
-        # 4-arm (cache x hier) sweep inside the tight 8-sample budget (8
-        # arms would need >= 11 samples, 16 would need 19). Those arms
-        # are covered by test_autotune above and
-        # test_hier_shm.py::test_autotune_shm_arm.
+        # Pin the zero-copy, ring-pipeline, shm, and bucket arms off:
+        # keeps the 4-arm (cache x hier) sweep inside the tight 8-sample
+        # budget (8 arms would need >= 11 samples, 16 would need 19).
+        # Those arms are covered by test_autotune above,
+        # test_hier_shm.py::test_autotune_shm_arm, and
+        # test_bucket.py::test_autotune_bucket_arm.
         "HVD_ZEROCOPY": "0",
         "HVD_RING_PIPELINE": "1",
         "HVD_SHM": "0",
+        "HVD_BUCKET": "0",
     }, timeout=600)
     text = log.read_text()
     assert text.startswith("sample,fusion_kb,cycle_ms,cache,hier,"), text

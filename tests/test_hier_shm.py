@@ -114,6 +114,9 @@ def test_autotune_shm_arm(tmp_path):
         "HVD_AUTOTUNE_MAX_SAMPLES": "12",
         "HVD_ZEROCOPY": "0",
         "HVD_RING_PIPELINE": "1",
+        # bucket arm off: 16 arms would outgrow the 12-sample budget
+        # (covered by test_bucket.py::test_autotune_bucket_arm)
+        "HVD_BUCKET": "0",
         "EXPECT_ARMS": "8",
     }, timeout=240)
     # The shm column really swept both states.

@@ -82,3 +82,18 @@ def test_streamed_ring_reduce_under_tsan(tmp_path):
         extra_env={"HVD_RING_PIPELINE": "4",
                    "HVD_ZEROCOPY_THRESHOLD": "16384"})
     assert_sanitizer_clean(p, 2, core_reports, tier="tsan")
+
+
+def test_bucketed_ring_under_tsan(tmp_path):
+    """The ordered bucket assembler (ISSUE 8) under the sanitizer:
+    frontend threads feed PushRequest while the background thread runs
+    BucketFilter/ResetPlanLocked over the same held-member maps and
+    drains the bounded event buffer into the timeline; bucket_stats()
+    polls the counters from the frontend concurrently. 2 ranks, 8 KB
+    buckets so the 4-grad burst replays a real 2-bucket plan."""
+    p, core_reports = _run_under_tsan(
+        tmp_path, "bucket_worker.py", 2,
+        extra_env={"HVD_BUCKET": "1",
+                   "HVD_BUCKET_BYTES": "8192",
+                   "BUCKET_MODE": "early"})
+    assert_sanitizer_clean(p, 2, core_reports, tier="tsan")

@@ -1,0 +1,106 @@
+"""Worker: backprop-ordered gradient bucketing (csrc/tensor_queue.h
+ordered bucket assembler, ISSUE 8). BUCKET_MODE selects the scenario;
+every rank asserts the correctness of every collective while the
+assembler learns/replays/flushes underneath, then checks the
+bucket_stats() counters the scenario promises.
+"""
+import os
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+mode = os.environ.get("BUCKET_MODE", "early")
+
+
+def burst(names, count=1024, dtype=np.float32, steps=1):
+    """One fake backward pass per step: async submit every gradient in
+    order (like the torch hook path), then synchronize in order."""
+    for _ in range(steps):
+        hs = [hvd.allreduce_async(
+                  np.full(count, dtype(r + 1 + i), dtype),
+                  op=hvd.Sum, name=n)
+              for i, n in enumerate(names)]
+        for i, h in enumerate(hs):
+            out = hvd.synchronize(h)
+            expect = sum(range(1 + i, s + 1 + i))
+            assert np.allclose(np.asarray(out, np.float64), expect), \
+                (names[i], out[:2], expect)
+
+
+if mode == "early":
+    # 4 gradients of 4 KB under an 8 KB bound -> a 2-bucket plan learned
+    # on step 0 and replayed; the first bucket of every replayed step
+    # launches while grads 2/3 are still outstanding (early > 0 is the
+    # backward/comms overlap claim).
+    on, bb = hvd.bucket_state()
+    assert on and bb == 8192, (on, bb)
+    burst([f"grad.{i}" for i in range(4)], steps=6)
+    launched, early, assembled, flushes, invalid, plan = hvd.bucket_stats()
+    assert plan == 2, plan
+    assert launched >= 10 and assembled >= 20, (launched, assembled)
+    assert early >= 5, f"no early launches: {early}"
+    assert flushes == 0 and invalid == 0, (flushes, invalid)
+elif mode == "dtypes":
+    # Mixed-dtype plans: members keep their own dtype through the grouped
+    # release (the wire serializes per tensor); results stay exact.
+    names = ["g.f32", "g.f64", "g.i32", "g.i64"]
+    dtypes = [np.float32, np.float64, np.int32, np.int64]
+    for _ in range(5):
+        hs = [hvd.allreduce_async(
+                  np.full(512, dt(r + 1 + i), dt), op=hvd.Sum, name=n)
+              for i, (n, dt) in enumerate(zip(names, dtypes))]
+        for i, h in enumerate(hs):
+            out = hvd.synchronize(h)
+            expect = sum(range(1 + i, s + 1 + i))
+            assert np.allclose(np.asarray(out, np.float64), expect), \
+                (names[i], out[:2])
+    launched, early, assembled, flushes, invalid, plan = hvd.bucket_stats()
+    assert launched > 0 and assembled > 0, (launched, assembled)
+    assert flushes == 0 and invalid == 0, (flushes, invalid)
+elif mode == "invalidate":
+    # Graph change: a new name (and later a resized member) mid-run drops
+    # the plan, releases held members ungrouped, and relearns — counted,
+    # never wrong.
+    base = [f"grad.{i}" for i in range(4)]
+    burst(base, steps=3)
+    burst(base + ["grad.extra"], steps=3)  # unknown name -> invalidate
+    burst(base, count=2048, steps=3)       # resized members -> invalidate
+    launched, early, assembled, flushes, invalid, plan = hvd.bucket_stats()
+    assert invalid >= 2, invalid
+    assert launched > 0, launched
+elif mode == "flush":
+    # A blocking sync loop submits bucket members one at a time: the
+    # assembler must flush held members at the deadline (bounded stall),
+    # then self-disable after a few streaks instead of taxing every step.
+    # Each flush drops the plan and relearns (~5 calls per cycle with 4
+    # names), so 30 calls cover the 4 flushes the latch needs.
+    for i in range(30):
+        out = hvd.allreduce(np.full(1024, float(r + 1), np.float32),
+                            op=hvd.Sum, name=f"sync.{i % 4}")
+        assert np.allclose(out, s * (s + 1) / 2.0), out[:2]
+    launched, early, assembled, flushes, invalid, plan = hvd.bucket_stats()
+    assert flushes >= 1, flushes
+    on, _ = hvd.bucket_state()
+    assert not on, "self-disable should have parked the assembler"
+elif mode == "off":
+    assert hvd.bucket_state() == (False, 32 << 20), hvd.bucket_state()
+    burst([f"grad.{i}" for i in range(4)], steps=3)
+    assert hvd.bucket_stats() == (0, 0, 0, 0, 0, 0), hvd.bucket_stats()
+elif mode == "coexist":
+    # Bucketing + scatter-gather zero-copy in one job: the fused bucket
+    # payload crosses HVD_ZEROCOPY_THRESHOLD, so grouped buckets ride the
+    # SG ring while the assembler keeps launching early.
+    burst([f"grad.{i}" for i in range(4)], count=2048, steps=6)
+    launched, early, assembled, flushes, invalid, plan = hvd.bucket_stats()
+    assert launched >= 10 and early >= 5, (launched, early)
+    zc_ops, zc_bytes, st_ops, st_bytes = hvd.zerocopy_stats()
+    assert zc_ops > 0, (zc_ops, zc_bytes)
+else:
+    raise SystemExit(f"unknown BUCKET_MODE {mode!r}")
+
+hvd.barrier()
+hvd.shutdown()
+print(f"rank {r}: bucket[{mode}] PASS", flush=True)

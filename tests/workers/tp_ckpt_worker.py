@@ -1,0 +1,76 @@
+"""Worker: what hvd.checkpoint.save does with a TP-sharded train state
+(ISSUE 8 satellite; ROADMAP item 5 prep). Two modes:
+
+- CKPT_MODE=local: single process, params sharded over a model axis of
+  local devices. Pinned behavior: the root's host pull (checkpoint.py
+  _to_host) GATHERS each fully-addressable sharded leaf, so the written
+  checkpoint holds FULL arrays; restore returns plain replicated host
+  arrays — sharding metadata is NOT round-tripped.
+- CKPT_MODE=global: the model axis spans processes, so the root holds
+  only its own shards. Pinned behavior: save FAILS LOUDLY on the root's
+  host pull (np.asarray of a non-fully-addressable jax.Array) before
+  anything is written — not a silently-truncated checkpoint.
+"""
+import os
+
+import numpy as np
+
+from horovod_tpu.jax.distributed import force_cpu_platform
+
+mode = os.environ.get("CKPT_MODE", "local")
+force_cpu_platform(8 if mode == "local" else 4)
+
+import jax  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import checkpoint  # noqa: E402
+
+if mode == "global":
+    from horovod_tpu.jax import distributed as jd
+
+    assert jd.initialize_from_env(), "no HVD_JAX_COORD_ADDR in env"
+
+hvd.init()
+r = hvd.rank()
+ckdir = os.environ["CKPT_DIR"]
+full = np.arange(32.0, dtype=np.float32).reshape(8, 4)
+
+mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("model",))
+sharding = NamedSharding(mesh, P("model"))
+
+if mode == "local":
+    w = jax.device_put(full, sharding)
+    assert len(w.sharding.device_set) == 8  # really TP-sharded
+    tree = {"w": w, "b": np.ones(4, np.float32)}
+    checkpoint.save(ckdir, 1, tree)
+    like = {"w": np.zeros((8, 4), np.float32),
+            "b": np.zeros(4, np.float32)}
+    out, step = checkpoint.restore(ckdir, like)
+    assert step == 1, step
+    # The sharded leaf was gathered: the checkpoint holds the FULL array.
+    assert np.allclose(out["w"], full), out["w"]
+    # ...and comes back as a plain host array — the TP layout is gone.
+    # A later refactor that round-trips shardings should break THIS line.
+    assert isinstance(out["w"], np.ndarray), type(out["w"])
+elif mode == "global":
+    w = jax.make_array_from_callback(full.shape, sharding,
+                                     lambda idx: full[idx])
+    assert not w.is_fully_addressable
+    if r == 0:
+        err = None
+        try:
+            checkpoint.save(ckdir, 1, {"w": w})
+        except Exception as e:  # noqa: BLE001 — the pin IS the exception
+            err = e
+        assert err is not None, \
+            "save silently accepted a non-addressable sharded state"
+        assert "addressable" in str(err).lower(), err
+        # Failed BEFORE writing: no half checkpoint on disk.
+        assert checkpoint.latest_step(ckdir) is None
+    hvd.barrier()
+else:
+    raise SystemExit(f"unknown CKPT_MODE {mode!r}")
+
+print(f"rank {r}: tp-ckpt[{mode}] PASS", flush=True)
+hvd.shutdown()

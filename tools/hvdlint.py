@@ -12,7 +12,11 @@ system and to pytest, so this lint parses the sources and enforces:
                  docs/perf_tuning.md or docs/running.md
   arm-stats      every autotune categorical arm (`int8_t tuned_X` in
                  csrc/common.h) has a matching `X_stats()` introspection
-                 in basics.py
+                 in basics.py, a column named X in autotune.cc's CSV
+                 header, and `init_X`/`can_toggle_X` parameters on
+                 Autotuner::Configure (autotune.h) — the three places a
+                 new arm must be threaded through or the sweep silently
+                 never walks it
   config-parity  config_parser.ARG_TO_ENV attrs <-> launch.py CLI flags
                  <-> _FILE_SECTIONS YAML keys stay in sync (both ways
                  for YAML, env->CLI for flags)
@@ -141,12 +145,29 @@ def check_knob_docs(root):
 
 # --- rule: arm-stats -------------------------------------------------------
 
+def _autotune_csv_columns(src):
+    """Column names of the autotune CSV header fprintf in autotune.cc,
+    or None if the anchor string moved. The header literal may span
+    several adjacent C string pieces."""
+    m = re.search(r'"sample,[^;]*?score_mbps\\n"', src, re.S)
+    if not m:
+        return None
+    joined = "".join(re.findall(r'"([^"]*)"', m.group(0)))
+    return joined.replace("\\n", "").split(",")
+
+
 def check_arm_stats(root):
     common = os.path.join(root, "horovod_tpu", "csrc", "common.h")
     basics = os.path.join(root, "horovod_tpu", "basics.py")
+    at_h = os.path.join(root, "horovod_tpu", "csrc", "autotune.h")
+    at_cc = os.path.join(root, "horovod_tpu", "csrc", "autotune.cc")
     if not (os.path.exists(common) and os.path.exists(basics)):
         return []
     basics_src = _read(basics)
+    at_h_src = _read(at_h) if os.path.exists(at_h) else ""
+    csv_cols = None
+    if os.path.exists(at_cc):
+        csv_cols = _autotune_csv_columns(_read(at_cc))
     out = []
     for i, line in enumerate(_read(common).splitlines(), 1):
         for m in re.finditer(r"\bint8_t\s+tuned_([a-z0-9_]+)", line):
@@ -156,6 +177,19 @@ def check_arm_stats(root):
                     "arm-stats", _rel(root, common), i, "tuned_" + arm,
                     "autotune arm has no %s_stats() introspection in "
                     "basics.py" % arm))
+            if csv_cols is not None and arm not in csv_cols:
+                out.append(Violation(
+                    "arm-stats", _rel(root, common), i, "tuned_" + arm,
+                    "autotune arm missing from the CSV header columns in "
+                    "autotune.cc (%s)" % ",".join(csv_cols)))
+            for param in ("init_%s" % arm, "can_toggle_%s" % arm):
+                if at_h_src and not re.search(
+                        r"\b%s\b" % param, at_h_src):
+                    out.append(Violation(
+                        "arm-stats", _rel(root, common), i, "tuned_" + arm,
+                        "Autotuner::Configure (autotune.h) has no %s "
+                        "parameter — the arm can never be seeded or "
+                        "swept" % param))
     return out
 
 
