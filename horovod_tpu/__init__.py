@@ -29,6 +29,7 @@ from .basics import basics as _basics
 from .exceptions import (  # noqa: F401
     HorovodInternalError,
     HostsUpdatedInterrupt,
+    RankEvictedError,
 )
 from .compression import Compression  # noqa: F401
 from .ops.collective_ops import (  # noqa: F401
@@ -152,6 +153,9 @@ bucket_stats = _basics.bucket_stats
 bucket_state = _basics.bucket_state
 reduce_pool_stats = _basics.reduce_pool_stats
 hier_stats = _basics.hier_stats
+elastic_stats = _basics.elastic_stats
+elastic_state = _basics.elastic_state
+fault_trigger = _basics.fault_trigger
 lockdep_stats = _basics.lockdep_stats
 lockdep_report = _basics.lockdep_report
 lockdep_selftest = _basics.lockdep_selftest

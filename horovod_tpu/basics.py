@@ -188,6 +188,12 @@ _lib.hvd_reduce_pool_stats.restype = c_int
 _lib.hvd_reduce_pool_stats.argtypes = [P_int64, P_int64, P_int64]
 _lib.hvd_reduce_bench.restype = c_double
 _lib.hvd_reduce_bench.argtypes = [c_int, c_int64, c_int, c_int]
+_lib.hvd_elastic_stats.restype = c_int
+_lib.hvd_elastic_stats.argtypes = [P_int64, P_int64, P_int64]
+_lib.hvd_elastic_state.restype = c_int
+_lib.hvd_elastic_state.argtypes = [P_int64, P_int64]
+_lib.hvd_fault_trigger.restype = c_int
+_lib.hvd_fault_trigger.argtypes = [c_char_p]
 _lib.hvd_lockdep_stats.restype = c_int
 _lib.hvd_lockdep_stats.argtypes = [P_int64, P_int64, P_int64, P_int64]
 _lib.hvd_lockdep_report.restype = c_int
@@ -476,6 +482,49 @@ class HorovodBasics:
         pipeline_stats for theirs."""
         return (self.backend_uses("hierarchical_allreduce"),
                 self.backend_uses("ring_allreduce"))
+
+    def elastic_stats(self):
+        """Elastic-churn counters as a dict: ``heartbeat_misses`` and
+        ``evictions`` observed by this process's core (all zero with
+        HVD_PEER_TIMEOUT_MS unset), ``last_evicted_rank`` (-1 = none),
+        ``kv_retries`` (transient rendezvous-client retries in this
+        process), and — when running under the elastic driver and it has
+        published them — the driver-side ``promotions``,
+        ``incremental_epochs``, ``full_epochs`` and ``driver_evictions``
+        counters."""
+        hb = c_int64(0)
+        ev = c_int64(0)
+        er = c_int64(-1)
+        rc = _lib.hvd_elastic_stats(
+            ctypes.byref(hb), ctypes.byref(ev), ctypes.byref(er))
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        from .runner import http_server
+        stats = {"heartbeat_misses": hb.value, "evictions": ev.value,
+                 "last_evicted_rank": er.value,
+                 "kv_retries": http_server.retry_count()}
+        from .runner.elastic import worker as _elastic_worker
+        if _elastic_worker.is_elastic():
+            stats.update(_elastic_worker.fetch_driver_stats())
+        return stats
+
+    def elastic_state(self):
+        """(enabled, timeout_ms, evict_misses): whether peer-liveness
+        eviction is armed (HVD_PEER_TIMEOUT_MS > 0), the per-cycle
+        control-plane deadline, and the consecutive-miss count that
+        escalates a warning into an eviction (HVD_PEER_EVICT_MISSES)."""
+        tmo = c_int64(0)
+        misses = c_int64(0)
+        rc = _lib.hvd_elastic_state(ctypes.byref(tmo), ctypes.byref(misses))
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        return bool(rc), tmo.value, misses.value
+
+    def fault_trigger(self, mode):
+        """Chaos hook (tests): flip the native socket fault mode
+        ("blackhole" | "reset" | "off"). Requires the process to have been
+        started with HVD_FAULT_INJECT=1; returns False otherwise."""
+        return _lib.hvd_fault_trigger(str(mode).encode()) == 0
 
     def lockdep_stats(self):
         """(enabled, cycles, blocking, edges, acquisitions) from the in-core

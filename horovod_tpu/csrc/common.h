@@ -360,6 +360,10 @@ struct ResponseList {
   int8_t tuned_shm = -1;       // intra-host shared-memory plane toggle
   int8_t tuned_bucket = -1;    // backprop-ordered gradient bucketing toggle
   bool tuned_locked = false;  // coordinator's search finished
+  // Rank the coordinator evicted this cycle (-1 = none). Survivors abort
+  // in-flight work with a retriable RankEvictedError instead of hanging in
+  // send/recv against the dead peer; the elastic driver rebuilds around it.
+  int32_t evicted_rank = -1;
 
   void serialize(Writer& w) const {
     w.u8(shutdown ? 1 : 0);
@@ -377,6 +381,7 @@ struct ResponseList {
     w.u8((uint8_t)(tuned_shm + 1));
     w.u8((uint8_t)(tuned_bucket + 1));
     w.u8(tuned_locked ? 1 : 0);
+    w.i32(evicted_rank);
   }
   static ResponseList deserialize(Reader& r) {
     ResponseList l;
@@ -397,6 +402,7 @@ struct ResponseList {
     l.tuned_shm = (int8_t)r.u8() - 1;
     l.tuned_bucket = (int8_t)r.u8() - 1;
     l.tuned_locked = r.u8() != 0;
+    l.evicted_rank = r.i32();
     return l;
   }
 };

@@ -12,13 +12,15 @@ namespace hvd {
 
 bool StallInspector::Check(
     const std::unordered_map<std::string, std::map<int32_t, Request>>& table,
-    const ProcessSetTable& process_sets, int64_t now_us) {
+    const ProcessSetTable& process_sets, int64_t now_us, int32_t* culprit) {
   // warn_sec <= 0 disables the warning (--no-stall-check /
   // HVD_STALL_CHECK_TIME_SECONDS=0) but NOT the shutdown threshold: an
   // explicitly configured HVD_STALL_SHUTDOWN_TIME_SECONDS still fires even
   // when warnings are silenced.
+  if (culprit) *culprit = -1;
   if (warn_sec_ <= 0 && shutdown_sec_ <= 0) return false;
   bool shutdown = false;
+  int64_t oldest_us = now_us;
   for (auto& kv : table) {
     const std::string& key = kv.first;
     const std::string& name = kv.second.begin()->second.name;
@@ -28,11 +30,21 @@ bool StallInspector::Check(
       continue;
     }
     double age = (now_us - it->second) / 1e6;
+    // A rank can only stall a tensor it has NOT submitted; already-evicted
+    // ranks don't count (their absence is expected, not a stall).
+    int ps = kv.second.begin()->second.process_set;
+    int32_t lowest_missing = -1;
+    if (process_sets.Contains(ps)) {
+      for (int32_t r : process_sets.Members(ps))
+        if (!kv.second.count(r) && !evicted_.count(r)) {
+          lowest_missing = r;
+          break;
+        }
+    }
     if (warn_sec_ > 0 && age > warn_sec_) {
       auto& lw = last_warned_[key];
       if ((now_us - lw) / 1e6 > warn_sec_) {
         lw = now_us;
-        int ps = kv.second.begin()->second.process_set;
         std::string present, missing;
         if (process_sets.Contains(ps)) {
           for (int32_t r : process_sets.Members(ps)) {
@@ -49,7 +61,17 @@ bool StallInspector::Check(
              name.c_str(), present.c_str(), missing.c_str(), age);
       }
     }
-    if (shutdown_sec_ > 0 && age > shutdown_sec_) shutdown = true;
+    // With no evictions recorded this matches the legacy verdict exactly;
+    // once ranks have been evicted, a tensor whose only missing submitters
+    // are evicted ranks no longer re-fires the shutdown.
+    if (shutdown_sec_ > 0 && age > shutdown_sec_ &&
+        (lowest_missing >= 0 || evicted_.empty())) {
+      shutdown = true;
+      if (culprit && lowest_missing >= 0 && it->second < oldest_us) {
+        oldest_us = it->second;
+        *culprit = lowest_missing;
+      }
+    }
   }
   // Drop trackers for names no longer pending.
   for (auto it = first_seen_.begin(); it != first_seen_.end();) {
@@ -460,12 +482,16 @@ ResponseList Coordinator::Update(std::vector<RequestList>& lists,
   // shutdown flag rides the broadcast ResponseList, every rank's background
   // loop exits, and pending ops fail with HorovodInternalError (reference:
   // HOROVOD_STALL_SHUTDOWN_TIME_SECONDS in stall-check docs).
+  int32_t stall_culprit = -1;
   bool stall_shutdown =
-      stall_.Check(message_table_, *process_sets_, NowUs());
+      stall_.Check(message_table_, *process_sets_, NowUs(),
+                   stall_evict_ ? &stall_culprit : nullptr);
   if (stall_shutdown)
     LogF(LogLevel::kError,
          "stall shutdown: a collective exceeded the stall shutdown "
          "threshold; aborting the job");
+  if (stall_shutdown && stall_culprit >= 0)
+    stall_.MarkEvicted(stall_culprit);
 
   // Join completions are delivered LAST (reference: ComputeResponseList
   // appends the final join response after all tensor responses): an
@@ -482,9 +508,19 @@ ResponseList Coordinator::Update(std::vector<RequestList>& lists,
   out.evict_bits.assign(evict.begin(), evict.end());
   *all_shutdown = (int)shutdown_ranks_.size() >= size_ || stall_shutdown;
   out.shutdown = *all_shutdown;
-  if (stall_shutdown)
+  if (stall_shutdown) {
     out.shutdown_reason =
         "a collective stalled past HVD_STALL_SHUTDOWN_TIME_SECONDS";
+    if (stall_culprit >= 0) {
+      // Stall-driven eviction: name the wedge so the elastic driver can
+      // kill and replace it instead of respawning blind.
+      out.evicted_rank = stall_culprit;
+      out.shutdown_reason =
+          "RankEvictedError: rank " + std::to_string(stall_culprit) +
+          " evicted: stalled a collective past "
+          "HVD_STALL_SHUTDOWN_TIME_SECONDS";
+    }
+  }
   return out;
 }
 

@@ -96,17 +96,27 @@ class StallInspector {
     shutdown_sec_ = shutdown_sec;
   }
   // Called by the coordinator each cycle with the partially-ready table.
-  // Returns true if the stall exceeded the shutdown threshold.
+  // Returns true if the stall exceeded the shutdown threshold. When
+  // `culprit` is non-null, it receives the lowest non-evicted rank missing
+  // from the oldest over-threshold tensor (-1 if none) — the eviction
+  // target for stall-driven rank eviction.
   bool Check(
       const std::unordered_map<std::string, std::map<int32_t, Request>>& table,
-      const ProcessSetTable& process_sets, int64_t now_us);
+      const ProcessSetTable& process_sets, int64_t now_us,
+      int32_t* culprit = nullptr);
   void OnReady(const std::string& name) { first_seen_.erase(name); }
+  // Ranks already evicted stop counting toward (or being blamed for)
+  // stalls: a tensor whose only missing submitters are evicted ranks must
+  // not re-fire the shutdown verdict while the job tears down.
+  void MarkEvicted(int32_t rank) { evicted_.insert(rank); }
+  bool IsEvicted(int32_t rank) const { return evicted_.count(rank) > 0; }
 
  private:
   double warn_sec_ = 60.0;
   double shutdown_sec_ = -1.0;  // <0 => never shut down
   std::unordered_map<std::string, int64_t> first_seen_;
   std::unordered_map<std::string, int64_t> last_warned_;
+  std::set<int32_t> evicted_;
 };
 
 // Coordinator bookkeeping that runs on rank 0 only.
@@ -132,6 +142,12 @@ class Coordinator {
 
   // Autotune proposals change the fusion packing limit mid-run.
   void set_fusion_threshold(int64_t t) { fusion_threshold_ = t; }
+
+  // Stall-driven rank eviction (HVD_PEER_TIMEOUT_MS > 0): a stall past the
+  // shutdown threshold names the lowest missing rank in
+  // ResponseList.evicted_rank instead of aborting anonymously, so the
+  // elastic driver can kill/replace the wedge instead of respawning blind.
+  void set_stall_evict(bool on) { stall_evict_ = on; }
 
   // Ingest one cycle's worth of RequestLists (index = global rank; rank 0's
   // own list included). Returns the ordered, fused ResponseList every rank
@@ -160,6 +176,7 @@ class Coordinator {
   std::map<int32_t, int32_t> last_joined_;
   ProcessSetTable* process_sets_ = nullptr;
   StallInspector stall_;
+  bool stall_evict_ = false;
   // Grouped collectives staged until every member tensor of the group is
   // ready on every rank (reference: group_table.cc).
   std::map<int32_t, std::vector<Response>> pending_groups_;

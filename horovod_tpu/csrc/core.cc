@@ -188,6 +188,20 @@ struct Global {
   // it, keeping steady-state cache behavior unchanged for unbucketed jobs.
   bool bucket_allowed = true;
 
+  // Elastic churn: per-peer liveness on the control plane. peer_timeout_ms
+  // (HVD_PEER_TIMEOUT_MS) bounds rank 0's per-cycle RequestList gather;
+  // 0 (the default) keeps the legacy unbounded gather — byte-identical
+  // off-path. A peer missing peer_evict_misses consecutive deadlines (or
+  // whose control socket dies) is evicted: all survivors abort with a
+  // retriable RankEvictedError naming the rank instead of hanging.
+  // Counters are written by the background thread, read by user threads
+  // via hvd_elastic_stats — atomic, relaxed (counts, not sync points).
+  int peer_timeout_ms = 0;
+  int peer_evict_misses = 3;
+  std::atomic<int64_t> heartbeat_misses_total{0};
+  std::atomic<int64_t> evictions_total{0};
+  std::atomic<int32_t> last_evicted_rank{-1};
+
   std::thread background;
 
   DebugMutex handle_mu{"handle_table"};
@@ -960,6 +974,83 @@ void FailAllPending(const std::string& why) {
   for (auto& e : entries) CompleteHandle(e.handle, Status::Aborted(why));
 }
 
+// Rank 0: evict a peer — broadcast a shutdown ResponseList naming the rank
+// so every survivor aborts with a retriable RankEvictedError (instead of a
+// generic peer-closed cascade), then throw into BackgroundLoop's elastic
+// error path. The victim's socket may already be dead; sends are
+// best-effort. t_detect_us anchors the TCP_EVICT timeline span at the
+// moment the first deadline was missed.
+[[noreturn]] void EvictRank(int victim, const std::string& why,
+                            int64_t t_detect_us) {
+  g->evictions_total.fetch_add(1, std::memory_order_relaxed);
+  g->last_evicted_rank.store(victim, std::memory_order_relaxed);
+  ResponseList rl;
+  rl.shutdown = true;
+  rl.evicted_rank = victim;
+  rl.shutdown_reason =
+      "RankEvictedError: rank " + std::to_string(victim) + " evicted: " + why;
+  Writer w;
+  rl.serialize(w);
+  for (int r = 1; r < g->size; r++) {
+    if (!g->workers[r].valid()) continue;
+    try {
+      g->workers[r].SendFrame(w.buf);
+    } catch (...) {
+      // Survivors with a dead link unblock via the socket close below
+      // (BackgroundLoop's catch) — the broadcast is advisory.
+    }
+  }
+  g->timeline.Record("rank" + std::to_string(victim), "TCP_EVICT",
+                     t_detect_us, NowUs());
+  LogF(LogLevel::kError, "%s", rl.shutdown_reason.c_str());
+  throw std::runtime_error(rl.shutdown_reason);
+}
+
+// Rank 0's per-cycle RequestList gather. With HVD_PEER_TIMEOUT_MS unset
+// this is exactly the legacy unbounded RecvFrameEach. With it set, the
+// gather is deadline-bounded: a missed deadline is a heartbeat miss
+// (warned, counted), peer_evict_misses consecutive misses or a dead
+// control socket evicts the offending rank. A slow-but-alive rank keeps
+// sending its per-cycle frame and is never evicted — the miss counter
+// only advances while the SAME gather stays incomplete.
+std::vector<std::vector<uint8_t>> GatherRequestFrames(
+    const std::vector<Socket*>& socks) {
+  if (g->peer_timeout_ms <= 0) return RecvFrameEach(socks);
+  FrameGather fg;
+  fg.Reset(socks.size());
+  int misses = 0;
+  int64_t t_first_miss = 0;
+  while (!fg.Gather(socks, g->peer_timeout_ms)) {
+    misses++;
+    g->heartbeat_misses_total.fetch_add(1, std::memory_order_relaxed);
+    if (t_first_miss == 0) t_first_miss = NowUs();
+    int victim = -1;
+    std::string pending;
+    for (size_t i = 0; i < socks.size(); i++) {
+      if (fg.completed(i)) continue;
+      if (victim < 0) victim = (int)i + 1;
+      pending += std::to_string(i + 1) + " ";
+    }
+    if (misses >= g->peer_evict_misses) {
+      EvictRank(victim,
+                "missed " + std::to_string(misses) +
+                    " consecutive heartbeat deadlines of " +
+                    std::to_string(g->peer_timeout_ms) +
+                    " ms (HVD_PEER_TIMEOUT_MS); wedged or partitioned",
+                t_first_miss);
+    }
+    LogF(LogLevel::kWarn,
+         "heartbeat: ranks [ %s] missed control-plane deadline %d/%d "
+         "(HVD_PEER_TIMEOUT_MS=%d)",
+         pending.c_str(), misses, g->peer_evict_misses, g->peer_timeout_ms);
+  }
+  for (size_t i = 0; i < socks.size(); i++)
+    if (fg.failed(i))
+      EvictRank((int)i + 1, "control connection lost",
+                t_first_miss ? t_first_miss : NowUs());
+  return fg.Take();
+}
+
 void BackgroundLoop() {
   std::string shutdown_reason;
   try {
@@ -995,7 +1086,7 @@ void BackgroundLoop() {
         std::vector<Socket*> socks;
         socks.reserve(g->size - 1);
         for (int r = 1; r < g->size; r++) socks.push_back(&g->workers[r]);
-        auto frames = RecvFrameEach(socks);
+        auto frames = GatherRequestFrames(socks);
         for (int r = 1; r < g->size; r++) {
           Reader rd(frames[r - 1].data(), frames[r - 1].size());
           lists[r] = RequestList::deserialize(rd);
@@ -1017,6 +1108,13 @@ void BackgroundLoop() {
 
       ProcessResponseList(rl);
       if (rl.shutdown) {
+        if (rl.evicted_rank >= 0) {
+          // Stall-driven eviction from the coordinator, or a heartbeat
+          // eviction broadcast received on a worker.
+          g->evictions_total.fetch_add(1, std::memory_order_relaxed);
+          g->last_evicted_rank.store(rl.evicted_rank,
+                                     std::memory_order_relaxed);
+        }
         if (!rl.shutdown_reason.empty())
           shutdown_reason = rl.shutdown_reason;
         break;
@@ -1466,6 +1564,13 @@ int hvd_init() {
     g->coordinator.stall().Configure(
         EnvDouble("HVD_STALL_CHECK_TIME_SECONDS", 60.0),
         EnvDouble("HVD_STALL_SHUTDOWN_TIME_SECONDS", -1.0));
+    // Peer liveness / rank eviction (docs/elastic.md). 0 = off: the
+    // control-plane gather, stall verdicts, and every timeout below stay
+    // byte-identical to the legacy behavior.
+    g->peer_timeout_ms = (int)EnvInt("HVD_PEER_TIMEOUT_MS", 0);
+    int64_t evict_misses = EnvInt("HVD_PEER_EVICT_MISSES", 3);
+    g->peer_evict_misses = (int)(evict_misses < 1 ? 1 : evict_misses);
+    g->coordinator.set_stall_evict(g->peer_timeout_ms > 0);
     if (g->size > 1) EstablishMesh();
     // After EstablishMesh: the categorical arms must know which toggles
     // can actually take effect — a cache arm with capacity 0 or a
@@ -1498,8 +1603,27 @@ int hvd_init() {
         // Bucketing pays off only when a peer exists to overlap comms
         // against; HVD_BUCKET=0 is the operator opting out of the arm.
         /*can_toggle_bucket=*/g->bucket_allowed && g->size > 1);
-    g->data.set_timeout_ms(
-        (int)(EnvDouble("HVD_DATA_TIMEOUT_SECONDS", 300.0) * 1000.0));
+    double data_tmo = EnvDouble("HVD_DATA_TIMEOUT_SECONDS", -1.0);
+    if (data_tmo <= 0) {
+      data_tmo = 300.0;
+      // With liveness on, a peer wedged MID-collective must unblock the
+      // data plane on the heartbeat's timescale, not the 5-minute legacy
+      // default; an explicit HVD_DATA_TIMEOUT_SECONDS always wins.
+      if (g->peer_timeout_ms > 0) {
+        double derived =
+            g->peer_timeout_ms * (g->peer_evict_misses + 2) / 1000.0;
+        data_tmo = derived < 5.0 ? 5.0 : derived;
+      }
+    }
+    g->data.set_timeout_ms((int)(data_tmo * 1000.0));
+    if (g->peer_timeout_ms > 0 && g->rank != 0 && g->size > 1) {
+      // Workers bound their wait for the coordinator's ResponseList: rank
+      // 0 legitimately takes up to peer_evict_misses deadlines deciding an
+      // eviction, so the bound is a comfortable multiple of that window.
+      double bound =
+          g->peer_timeout_ms * (g->peer_evict_misses + 5) / 1000.0;
+      g->to_coordinator.SetRecvTimeout(bound < 30.0 ? 30.0 : bound);
+    }
     LogF(LogLevel::kInfo,
          "init: size=%d fusion=%lldB cycle=%.2fms cache=%lld autotune=%d",
          g->size, (long long)g->fusion_threshold, g->cycle_time_ms,
@@ -1948,6 +2072,41 @@ int hvd_bucket_state(int64_t* bucket_bytes) {
   if (bucket_bytes) *bucket_bytes = g->queue.bucket_bytes();
   return g->bucket_allowed && g->queue.bucket_enabled() ? 1 : 0;
 }
+
+// Elastic-churn observability: control-plane heartbeat deadline misses
+// observed by this process, evictions it saw (decided on rank 0, received
+// via the shutdown broadcast on workers), and the last evicted rank (-1 =
+// none). All zeros with HVD_PEER_TIMEOUT_MS unset. Python's
+// hvd.elastic_stats() merges these with the driver-side promotion
+// counters.
+int hvd_elastic_stats(int64_t* heartbeat_misses, int64_t* evictions,
+                      int64_t* evicted_rank) {
+  if (!g || !g->initialized) return -1;
+  if (heartbeat_misses)
+    *heartbeat_misses =
+        g->heartbeat_misses_total.load(std::memory_order_relaxed);
+  if (evictions)
+    *evictions = g->evictions_total.load(std::memory_order_relaxed);
+  if (evicted_rank)
+    *evicted_rank = g->last_evicted_rank.load(std::memory_order_relaxed);
+  return 0;
+}
+
+// Current liveness state: -1 uninitialized, 0 off (HVD_PEER_TIMEOUT_MS
+// unset), 1 armed; *timeout_ms gets the per-cycle deadline and
+// *evict_misses the escalation count.
+int hvd_elastic_state(int64_t* timeout_ms, int64_t* evict_misses) {
+  if (!g || !g->initialized) return -1;
+  if (timeout_ms) *timeout_ms = g->peer_timeout_ms;
+  if (evict_misses) *evict_misses = g->peer_evict_misses;
+  return g->peer_timeout_ms > 0 ? 1 : 0;
+}
+
+// Chaos hook (tests only): flip the process-wide socket fault mode
+// ("blackhole" | "reset" | "off"). Usable before init — the chaos worker
+// arms the mode from a signal handler or a timer thread. Returns -1
+// unless the process was started with HVD_FAULT_INJECT=1.
+int hvd_fault_trigger(const char* mode) { return fault::Trigger(mode); }
 
 // Reduce-pool observability: configured lanes, pooled dispatches, and
 // worker-lane spans executed. Usable WITHOUT init like hvd_reduce_stats

@@ -7,6 +7,7 @@
 #include <netinet/in.h>
 #include <poll.h>
 #include <netinet/tcp.h>
+#include <stdlib.h>
 #include <string.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -22,6 +23,54 @@ namespace hvd {
 static void throw_errno(const std::string& what) {
   throw std::runtime_error(what + ": " + strerror(errno));
 }
+
+namespace fault {
+
+// Armed once from the environment; mode flips at runtime via Trigger()
+// (hvd_fault_trigger from the chaos worker). Relaxed is enough: the hook
+// is a test-only tripwire, not a synchronization point.
+static std::atomic<int> g_mode{kOff};
+
+bool Armed() {
+  static const bool armed = [] {
+    const char* v = EnvRaw("HVD_FAULT_INJECT");
+    return v != nullptr && v[0] != '\0' && strcmp(v, "0") != 0;
+  }();
+  return armed;
+}
+
+int Trigger(const char* mode) {
+  if (!Armed() || mode == nullptr) return -1;
+  if (strcmp(mode, "blackhole") == 0) {
+    g_mode.store(kBlackhole, std::memory_order_relaxed);
+    return 0;
+  }
+  if (strcmp(mode, "reset") == 0) {
+    g_mode.store(kReset, std::memory_order_relaxed);
+    return 0;
+  }
+  if (strcmp(mode, "off") == 0) {
+    g_mode.store(kOff, std::memory_order_relaxed);
+    return 0;
+  }
+  return -1;
+}
+
+void Check(const char* where) {
+  if (!Armed()) return;
+  int m = g_mode.load(std::memory_order_relaxed);
+  if (m == kOff) return;
+  if (m == kReset)
+    throw std::runtime_error(std::string(where) +
+                             ": connection reset (fault injection)");
+  // Blackhole: this thread's traffic silently stops — the peer sees a
+  // partition, not an error. Park forever; the process is torn down by
+  // the driver (eviction) or the test harness.
+  while (g_mode.load(std::memory_order_relaxed) == kBlackhole)
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+}
+
+}  // namespace fault
 
 Socket& Socket::operator=(Socket&& o) noexcept {
   if (this != &o) {
@@ -59,6 +108,7 @@ void Socket::SetNonBlocking(bool on) {
 void Socket::SendAll(const void* buf, size_t n) {
   const uint8_t* p = (const uint8_t*)buf;
   while (n > 0) {
+    fault::Check("send");
     lockdep::OnBlockingSyscall("send");
     ssize_t k = ::send(fd_, p, n, MSG_NOSIGNAL);
     if (k < 0) {
@@ -75,6 +125,7 @@ void Socket::SendAll(const void* buf, size_t n) {
 void Socket::RecvAll(void* buf, size_t n) {
   uint8_t* p = (uint8_t*)buf;
   while (n > 0) {
+    fault::Check("recv");
     lockdep::OnBlockingSyscall("recv");
     ssize_t k = ::recv(fd_, p, n, 0);
     if (k < 0) {
@@ -143,6 +194,7 @@ std::vector<std::vector<uint8_t>> RecvFrameEach(
       idx[nf] = i;
       nf++;
     }
+    fault::Check("poll");
     lockdep::OnBlockingSyscall("poll");
     int rc = ::poll(fds.data(), (nfds_t)nf, -1);
     if (rc < 0) {
@@ -193,6 +245,116 @@ std::vector<std::vector<uint8_t>> RecvFrameEach(
     }
   }
   return out;
+}
+
+void FrameGather::Reset(size_t n) {
+  out_.assign(n, {});
+  len_.assign(n, 0);
+  got_.assign(n, 0);
+  hdr_.assign(n * 4, 0);
+  in_header_.assign(n, true);
+  done_.assign(n, false);
+  failed_.assign(n, false);
+  remaining_ = n;
+}
+
+bool FrameGather::Gather(const std::vector<Socket*>& socks, int timeout_ms) {
+  size_t n = socks.size();
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms);
+  std::vector<pollfd> fds(n);
+  std::vector<size_t> idx(n);
+  auto fail = [&](size_t i) {
+    // A dead socket is hard evidence, not a missed deadline: record the
+    // slot so the coordinator can evict that rank by name instead of
+    // cascading a generic "peer closed" through every survivor.
+    failed_[i] = true;
+    done_[i] = true;
+    remaining_--;
+  };
+  while (remaining_ > 0) {
+    size_t nf = 0;
+    for (size_t i = 0; i < n; i++) {
+      if (done_[i]) continue;
+      if (!socks[i]->valid()) {
+        fail(i);
+        continue;
+      }
+      fds[nf].fd = socks[i]->fd();
+      fds[nf].events = POLLIN;
+      fds[nf].revents = 0;
+      idx[nf] = i;
+      nf++;
+    }
+    if (remaining_ == 0) break;
+    int wait_ms = -1;
+    if (timeout_ms >= 0) {
+      auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                      deadline - std::chrono::steady_clock::now())
+                      .count();
+      if (left <= 0) return false;
+      wait_ms = (int)left;
+    }
+    fault::Check("poll");
+    lockdep::OnBlockingSyscall("poll");
+    int rc = ::poll(fds.data(), (nfds_t)nf, wait_ms);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      throw_errno("poll");
+    }
+    if (rc == 0) return false;  // deadline: pending slots keep their state
+    for (size_t k = 0; k < nf; k++) {
+      size_t i = idx[k];
+      if (fds[k].revents & POLLNVAL) {
+        fail(i);
+        continue;
+      }
+      if (!(fds[k].revents & (POLLIN | POLLERR | POLLHUP))) continue;
+      if (in_header_[i]) {
+        ssize_t r = ::recv(socks[i]->fd(), hdr_.data() + i * 4 + got_[i],
+                           4 - got_[i], 0);
+        if (r < 0) {
+          if (errno == EINTR) continue;
+          fail(i);
+          continue;
+        }
+        if (r == 0) {
+          fail(i);
+          continue;
+        }
+        got_[i] += (size_t)r;
+        if (got_[i] == 4) {
+          memcpy(&len_[i], hdr_.data() + i * 4, 4);
+          Socket::CheckFrameLen(len_[i]);
+          out_[i].resize(len_[i]);
+          in_header_[i] = false;
+          got_[i] = 0;
+          if (len_[i] == 0) {
+            done_[i] = true;
+            remaining_--;
+          }
+        }
+      } else {
+        ssize_t r = ::recv(socks[i]->fd(), out_[i].data() + got_[i],
+                           len_[i] - got_[i], 0);
+        if (r < 0) {
+          if (errno == EINTR) continue;
+          fail(i);
+          continue;
+        }
+        if (r == 0) {
+          fail(i);
+          continue;
+        }
+        got_[i] += (size_t)r;
+        if (got_[i] == len_[i]) {
+          done_[i] = true;
+          remaining_--;
+        }
+      }
+    }
+  }
+  return true;
 }
 
 void Listener::Listen(int port) {

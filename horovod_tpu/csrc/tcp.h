@@ -107,6 +107,51 @@ class Listener {
 std::vector<std::vector<uint8_t>> RecvFrameEach(
     const std::vector<Socket*>& socks);
 
+// Deadline-bounded, resumable variant of RecvFrameEach for peer-liveness
+// detection (HVD_PEER_TIMEOUT_MS). One instance covers one negotiation
+// cycle: Gather() polls until every pending slot has a full frame or the
+// deadline passes, and may be called again on the SAME cycle to extend the
+// wait — partial frames (a peer caught mid-payload by the deadline) are
+// retained across calls, so no stream desync. A peer whose socket dies
+// (close/reset) is marked failed, not thrown: the coordinator needs to
+// know WHICH rank died to evict it by name. Call Reset() to start the
+// next cycle (only after every slot completed — an evicted cycle tears
+// the whole mesh down instead).
+class FrameGather {
+ public:
+  void Reset(size_t n);
+  // Returns true when all slots are complete (frame landed or peer
+  // failed). timeout_ms < 0 blocks until completion like RecvFrameEach.
+  bool Gather(const std::vector<Socket*>& socks, int timeout_ms);
+  const std::vector<std::vector<uint8_t>>& frames() const { return out_; }
+  // Move the gathered frames out (call once, after Gather returned true).
+  std::vector<std::vector<uint8_t>> Take() { return std::move(out_); }
+  bool completed(size_t i) const { return done_[i] && !failed_[i]; }
+  bool failed(size_t i) const { return failed_[i]; }
+
+ private:
+  std::vector<std::vector<uint8_t>> out_;
+  std::vector<uint32_t> len_;
+  std::vector<size_t> got_;
+  std::vector<uint8_t> hdr_;
+  std::vector<bool> in_header_, done_, failed_;
+  size_t remaining_ = 0;
+};
+
+// Chaos fault hook (tests/workers/chaos_worker.py). Compiled in always but
+// dormant unless the process was started with HVD_FAULT_INJECT=1 — the
+// unarmed fast path is one relaxed atomic load per blocking socket call.
+// Modes: kBlackhole makes every subsequent send/recv/poll in this process
+// block forever (iptables-free network partition — traffic neither flows
+// nor errors); kReset makes them fail immediately with a connection-reset
+// style error (abrupt connection loss without process death).
+namespace fault {
+enum Mode { kOff = 0, kBlackhole = 1, kReset = 2 };
+bool Armed();                 // HVD_FAULT_INJECT=1 at first call
+int Trigger(const char* mode);  // 0 ok, -1 unarmed/unknown mode
+void Check(const char* where);  // hook point inside socket ops
+}  // namespace fault
+
 // Blocking connect with retry (rendezvous races are expected at startup).
 Socket ConnectRetry(const std::string& host, int port, double timeout_sec);
 

@@ -29,10 +29,30 @@ import copy
 import functools
 import time
 
-from .exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from .exceptions import (HorovodInternalError, HostsUpdatedInterrupt,
+                         RankEvictedError)
 from .observability import metrics as _metrics
 from .observability import spans as _spans
 from .ops import collective_ops as _core
+
+
+def _note_eviction(e):
+    """A RankEvictedError names the culprit. Clear its ops from the
+    Python stall inspector (a survivor must not be shut down for a stall
+    the evictee caused) and push the eviction to the elastic driver so it
+    SIGKILLs the wedged process now instead of waiting for the liveness
+    backstop to notice."""
+    if not isinstance(e, RankEvictedError) or e.rank < 0:
+        return
+    from .observability import stall as _stall
+    from .runner.elastic import worker as _worker
+
+    _stall.inspector.mark_rank_evicted(e.rank)
+    if _metrics.enabled():
+        _metrics.ELASTIC_EVENTS.labels(event="evict").inc()
+        _spans.instant("RANK_EVICTED", rank=e.rank)
+    if _worker.is_elastic():
+        _worker.report_eviction(e.rank, _worker.notification_manager.epoch)
 
 
 class State:
@@ -287,7 +307,8 @@ def run_fn(func, reset):
                 state.sync()
                 try:
                     return func(state, *args, **kwargs)
-                except HorovodInternalError:
+                except HorovodInternalError as e:
+                    _note_eviction(e)
                     if _metrics.enabled():
                         _metrics.ELASTIC_EVENTS.labels(
                             event="failure").inc()

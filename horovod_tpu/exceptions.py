@@ -12,6 +12,20 @@ class HorovodInternalError(RuntimeError):
     """Collective failed: a peer died or the communication plane broke."""
 
 
+class RankEvictedError(HorovodInternalError):
+    """A rank was evicted from the job (wedged, partitioned, or dead peer).
+
+    Subclasses :class:`HorovodInternalError` so the elastic retry loop
+    treats it as the same retriable signal; ``rank`` carries the evicted
+    rank when the core could name it (-1 otherwise) so the worker can push
+    the eviction to the driver for targeted kill + spare promotion.
+    """
+
+    def __init__(self, message, rank=-1):
+        super().__init__(message)
+        self.rank = rank
+
+
 class HostsUpdatedInterrupt(RuntimeError):
     """Host membership changed (elastic); re-initialize and continue.
 

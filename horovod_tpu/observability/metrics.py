@@ -414,6 +414,22 @@ REDUCE_POOL_JOBS = gauge(
 REDUCE_POOL_SPANS = gauge(
     "hvd_reduce_pool_spans",
     "Element spans executed on reduce-pool worker lanes")
+ELASTIC_HEARTBEAT_MISSES = gauge(
+    "hvd_elastic_heartbeat_misses",
+    "Control-plane heartbeat deadlines missed by some peer "
+    "(HVD_PEER_TIMEOUT_MS; core counter snapshot)")
+ELASTIC_EVICTIONS = gauge(
+    "hvd_elastic_evictions",
+    "Rank evictions this process observed (decided on rank 0, received "
+    "via the shutdown broadcast elsewhere)")
+ELASTIC_KV_RETRIES = gauge(
+    "hvd_elastic_kv_retries",
+    "Transient rendezvous KV-client retries performed by this process "
+    "(bounded exponential backoff, HVD_KV_RETRIES)")
+ELASTIC_PROMOTIONS = gauge(
+    "hvd_elastic_promotions",
+    "Hot-spare promotions the driver reported (spare swapped in for an "
+    "evicted/dead rank via an incremental epoch)")
 
 
 def sample_core_stats(hvd=None):
@@ -439,6 +455,11 @@ def sample_core_stats(hvd=None):
     _, pool_jobs, pool_spans = hvd.reduce_pool_stats()
     REDUCE_POOL_JOBS.set(pool_jobs)
     REDUCE_POOL_SPANS.set(pool_spans)
+    es = hvd.elastic_stats()
+    ELASTIC_HEARTBEAT_MISSES.set(es["heartbeat_misses"])
+    ELASTIC_EVICTIONS.set(es["evictions"])
+    ELASTIC_KV_RETRIES.set(es["kv_retries"])
+    ELASTIC_PROMOTIONS.set(es.get("promotions", 0))
 
 
 def record_call(op, seconds, nbytes, process_set=0):

@@ -78,17 +78,62 @@ class StallInspector:
         self.on_shutdown = on_shutdown
         self._lock = threading.Lock()
         self._ops = {}      # name -> last-progress monotonic timestamp
+        self._op_ranks = {}  # name -> rank that owns the op (when tagged)
+        self._evicted_ranks = set()
         self._warned = set()
         self._thread = None
         self._stop = threading.Event()
         self.shutdown_fired = False
         self._pending_error = None
 
-    # -- reporting surface (instrumentation sites) -----------------------
-    def report_start(self, name):
-        """An op entered flight (e.g. its async enqueue returned)."""
+    def configure(self, warning_sec=None, shutdown_sec=None,
+                  check_interval=None):
+        """Reload thresholds at runtime (the elastic driver tightens them
+        mid-run once it has seen real step times). Only the arguments
+        given change; the watcher picks the new values up on its next
+        scan. Loosening the shutdown threshold also clears a pending
+        (not-yet-raised) StallError decided under the old one."""
         with self._lock:
+            if warning_sec is not None:
+                self.warning_sec = float(warning_sec)
+                self._warned.clear()  # re-warn under the new threshold
+            if shutdown_sec is not None:
+                self.shutdown_sec = float(shutdown_sec)
+                self.shutdown_fired = False
+                self._pending_error = None
+            if check_interval is not None and float(check_interval) > 0:
+                self.check_interval = float(check_interval)
+
+    def mark_rank_evicted(self, rank):
+        """A peer rank was evicted: ops attributed to it leave the stall
+        set, and any pending shutdown verdict is cleared — the elastic
+        reset supersedes it (an op that stalled BECAUSE the peer died must
+        not kill the survivor after it already recovered)."""
+        with self._lock:
+            self._evicted_ranks.add(rank)
+            for name, r in list(self._op_ranks.items()):
+                if r == rank:
+                    self._ops.pop(name, None)
+                    self._op_ranks.pop(name, None)
+                    self._warned.discard(name)
+            self.shutdown_fired = False
+            self._pending_error = None
+
+    def evicted_ranks(self):
+        with self._lock:
+            return set(self._evicted_ranks)
+
+    # -- reporting surface (instrumentation sites) -----------------------
+    def report_start(self, name, rank=None):
+        """An op entered flight (e.g. its async enqueue returned). `rank`
+        optionally attributes the op to a peer rank so eviction can clear
+        it (see mark_rank_evicted)."""
+        with self._lock:
+            if rank is not None and rank in self._evicted_ranks:
+                return  # the rank is gone; never track its ops
             self._ops[name] = time.monotonic()
+            if rank is not None:
+                self._op_ranks[name] = rank
             self._warned.discard(name)
             if self._thread is None and not self._stop.is_set():
                 self._thread = threading.Thread(
@@ -106,6 +151,7 @@ class StallInspector:
     def report_done(self, name):
         with self._lock:
             self._ops.pop(name, None)
+            self._op_ranks.pop(name, None)
             self._warned.discard(name)
 
     def check_shutdown(self):
@@ -174,6 +220,8 @@ class StallInspector:
         """Forget all state (tests / elastic re-init)."""
         with self._lock:
             self._ops.clear()
+            self._op_ranks.clear()
+            self._evicted_ranks.clear()
             self._warned.clear()
         self.shutdown_fired = False
         self._pending_error = None

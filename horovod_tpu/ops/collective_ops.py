@@ -10,6 +10,7 @@ host buffers and call through here; the TPU in-graph path
 """
 
 import ctypes
+import re
 import threading
 
 import numpy as np
@@ -22,7 +23,7 @@ except ImportError:  # pragma: no cover
     _BFLOAT16 = None
 
 from ..basics import _lib, last_error
-from ..exceptions import HorovodInternalError
+from ..exceptions import HorovodInternalError, RankEvictedError
 from . import zerocopy as _zerocopy
 
 # ReduceOp values (must match csrc/common.h).
@@ -78,11 +79,28 @@ def _ptr(arr):
     return ctypes.c_void_p(arr.ctypes.data)
 
 
+def _raise_internal(err):
+    """Map a native failure string to the right retriable exception.
+
+    The core tags evictions with "RankEvictedError: rank N ..." inside the
+    usual HorovodInternalError envelope; surfacing the subclass (with the
+    parsed rank) lets the elastic worker push a targeted eviction to the
+    driver instead of a blind reset."""
+    if "RankEvictedError" in err:
+        raise RankEvictedError(err, rank=_parse_evicted_rank(err))
+    raise HorovodInternalError(err)
+
+
+def _parse_evicted_rank(err):
+    m = re.search(r"RankEvictedError: rank (\d+)", err)
+    return int(m.group(1)) if m else -1
+
+
 def _check_handle(h):
     if h < 0:
         err = last_error()
         if err.startswith("HorovodInternalError"):
-            raise HorovodInternalError(err)
+            _raise_internal(err)
         raise ValueError(err or "enqueue failed")
     return h
 
@@ -116,7 +134,7 @@ def synchronize(handle):
         if rc != 1:
             err = last_error()
             if "HorovodInternalError" in err or "shutdown" in err:
-                raise HorovodInternalError(err)
+                _raise_internal(err)
             raise RuntimeError(f"collective '{handle.name}' failed: {err}")
         return _collect_result(handle)
     finally:

@@ -12,6 +12,7 @@ YAML schema (any subset):
       cache-capacity: 1024
       start-timeout: 120
       log-level: info
+      peer-timeout-ms: 2000
     timeline:
       filename: /tmp/tl.json
       mark-cycles: true
@@ -54,6 +55,7 @@ ARG_TO_ENV = {
     "autotune_log_file": ("HVD_AUTOTUNE_LOG", str),
     "start_timeout": ("HVD_START_TIMEOUT", str),
     "log_level": ("HVD_LOG_LEVEL", str),
+    "peer_timeout_ms": ("HVD_PEER_TIMEOUT_MS", lambda v: str(int(v))),
     # Observability (horovod_tpu/observability/): the metrics registry,
     # span recorder, and Python-side stall inspector all gate on
     # HVD_METRICS; HVD_METRICS_PORT adds a per-worker /metrics endpoint.
@@ -73,7 +75,8 @@ _FILE_SECTIONS = {
                "bucket-flush-ms": "bucket_flush_ms",
                "reduce-threads": "reduce_threads",
                "start-timeout": "start_timeout",
-               "log-level": "log_level"},
+               "log-level": "log_level",
+               "peer-timeout-ms": "peer_timeout_ms"},
     "timeline": {"filename": "timeline_filename",
                  "mark-cycles": "timeline_mark_cycles"},
     "stall-check": {"warning-time-seconds":

@@ -24,6 +24,11 @@ FAILURE_WINDOW_S = 60.0
 FAILURES_TO_BLACKLIST = 3
 DEFAULT_COOLDOWN_RANGE = (10.0, 60.0)
 WIND_DOWN_GRACE_S = 30.0
+# Transient failures (driver-initiated evictions of wedged/partitioned
+# workers — the elastic reset already absorbed them) age out of the
+# blacklist window faster than hard crashes: one flaky switch port must
+# not retire a 4-chip host for a full minute.
+TRANSIENT_DECAY_S = 20.0
 
 
 class _Worker:
@@ -42,7 +47,7 @@ class _Worker:
 
 class ElasticDriver:
     def __init__(self, command, discovery, min_np, max_np, extra_env=None,
-                 verbose=False, cooldown_range=None):
+                 verbose=False, cooldown_range=None, hot_spares=0):
         self.command = list(command)
         self.discovery = discovery
         self.min_np = min_np
@@ -50,6 +55,24 @@ class ElasticDriver:
         self.extra_env = dict(extra_env or {})
         self.verbose = verbose
         self.cooldown_range = cooldown_range or DEFAULT_COOLDOWN_RANGE
+        # Hot spares: extra workers kept rendezvoused-but-rankless so an
+        # eviction is repaired by a rank assignment (incremental epoch)
+        # instead of a cold spawn + import + rendezvous.
+        self.hot_spares = int(hot_spares or 0)
+        self.stats = {"promotions": 0, "incremental_epochs": 0,
+                      "full_epochs": 0, "driver_evictions": 0}
+        self._spares = set()        # wids currently parked as hot spares
+        self._active_ranks = {}     # wid -> rank in the CURRENT epoch
+        self._rank_hosts = {}       # rank -> hostname in the CURRENT epoch
+        self._evict_handled = set()  # (victim wid, epoch) pushes consumed
+        self._driver_killed = set()  # wids WE killed (failure pre-recorded)
+        self._alive_seen = {}       # wid -> (last seq bytes, ts it changed)
+        try:
+            self._peer_timeout_ms = int(self.extra_env.get(
+                "HVD_PEER_TIMEOUT_MS",
+                os.environ.get("HVD_PEER_TIMEOUT_MS", "0")))
+        except ValueError:
+            self._peer_timeout_ms = 0
         # Per-job HMAC secret: the KV store binds 0.0.0.0, so without
         # signatures anyone on the network could PUT /ctl/epoch and resize
         # or kill the job (reference: runner/common/util/secret.py tokens on
@@ -126,14 +149,34 @@ class ElasticDriver:
         self._log(f"spawned {wid}")
         return w
 
-    def _blacklisted(self, host, now):
-        return self._blacklist_until.get(host, 0) > now
+    def _live_failures(self, host, now):
+        """Failure records still inside their window: transient ones
+        (driver evictions of wedged workers) decay after TRANSIENT_DECAY_S,
+        hard crashes after FAILURE_WINDOW_S."""
+        return [(t, tr) for (t, tr) in self._host_failures.get(host, [])
+                if now - t < (TRANSIENT_DECAY_S if tr else FAILURE_WINDOW_S)]
 
-    def _record_failure(self, host):
-        now = time.time()
-        lst = [t for t in self._host_failures.get(host, [])
-               if now - t < FAILURE_WINDOW_S]
-        lst.append(now)
+    def _blacklisted(self, host, now):
+        if self._blacklist_until.get(host, 0) <= now:
+            return False
+        # Decay: a blacklist earned ENTIRELY by transient evictions lifts
+        # early once those records age out — the stall that triggered them
+        # was a one-off (GC pause, transient partition), not a bad host.
+        # Any hard crash in the mix pins the full cooldown.
+        fails = self._host_failures.get(host, [])
+        live = self._live_failures(host, now)
+        if all(tr for _, tr in fails) and len(live) < FAILURES_TO_BLACKLIST:
+            self._blacklist_until.pop(host, None)
+            self._host_failures[host] = live
+            self._log(f"blacklist on {host} decayed (transient failures "
+                      f"aged out)")
+            return False
+        return True
+
+    def _record_failure(self, host, transient=False, now=None):
+        now = time.time() if now is None else now
+        lst = self._live_failures(host, now)
+        lst.append((now, transient))
         self._host_failures[host] = lst
         if len(lst) >= FAILURES_TO_BLACKLIST:
             lo, hi = self.cooldown_range
@@ -169,13 +212,35 @@ class ElasticDriver:
             active += keep
             extra = extra[len(keep):]
 
-        # host-major assignment over the active workers
-        by_host = {}
-        for w in active:
-            by_host.setdefault(w.hostname, []).append(w)
-        hosts = [HostInfo(h, len(ws)) for h, ws in by_host.items()]
+        # Hot spares: park up to hot_spares of the excess — rendezvoused,
+        # heartbeating, rankless — instead of telling them to exit.
+        spares = extra[:self.hot_spares]
+        extra = extra[self.hot_spares:]
+
+        promoted = [w for w in active if w.id in self._spares]
+        prev = self._active_ranks
+        ordered = self._incremental_order(active, prev)
+        if ordered is not None:
+            self.stats["incremental_epochs"] += 1
+        else:
+            # Full re-rank: host-major over the active workers.
+            by_host = {}
+            for w in active:
+                by_host.setdefault(w.hostname, []).append(w)
+            ordered = [w for ws in by_host.values() for w in ws]
+            if prev:
+                self.stats["full_epochs"] += 1
+        self.stats["promotions"] += len(promoted)
+        # HostInfo from the contiguous hostname runs of `ordered` (for the
+        # full path this equals the by_host grouping; the incremental path
+        # guaranteed contiguity before returning an order).
+        hosts = []
+        for w in ordered:
+            if hosts and hosts[-1].hostname == w.hostname:
+                hosts[-1] = HostInfo(w.hostname, hosts[-1].slots + 1)
+            else:
+                hosts.append(HostInfo(w.hostname, 1))
         slots = get_host_assignments(hosts, len(active))
-        ordered = [w for h, ws in by_host.items() for w in ws]
 
         rdv_routable = None
         if all(is_local(w.hostname) for w in active):
@@ -212,15 +277,103 @@ class ElasticDriver:
                 a["rdv"] = rdv_routable
             self.rdv.put(f"/assign-{self.epoch}/{w.id}",
                          json.dumps(a).encode())
+        for w in spares:
+            self.rdv.put(f"/assign-{self.epoch}/{w.id}",
+                         json.dumps({"spare": True}).encode())
         for w in extra:
             self._excluded.add(w.id)
             self.rdv.put(f"/assign-{self.epoch}/{w.id}", b"exit")
+        self._spares = {w.id for w in spares}
+        self._active_ranks = {w.id: s.rank for w, s in zip(ordered, slots)}
+        self._rank_hosts = {s.rank: w.hostname
+                            for w, s in zip(ordered, slots)}
         self.rdv.put("/ctl/epoch", str(self.epoch).encode())
+        self._publish_stats()
         # Reset requests for epochs before this one are resolved by it.
         self._reset_handled = {(w, e) for (w, e) in self._reset_handled
                                if e >= self.epoch}
         self._log(f"epoch {self.epoch}: {len(active)} active "
-                  f"({[w.id for w in active]}), ctrl={ctrl}")
+                  f"({[w.id for w in active]}), {len(spares)} spare"
+                  f"{' (' + str(len(promoted)) + ' promoted)' if promoted else ''}, "
+                  f"ctrl={ctrl}")
+
+    def _incremental_order(self, active, prev):
+        """Order `active` so the host-major rank assignment hands every
+        survivor its previous rank; newcomers (promoted spares / fresh
+        spawns) slot into the freed ranks, preferring the evicted
+        occupant's host. None when impossible — the size changed, a
+        survivor was not in the previous epoch, or the resulting hostname
+        sequence is not host-contiguous (ranks must stay host-major for
+        local_rank/cross_rank to mean anything)."""
+        if not prev or len(active) != len(prev):
+            return None
+        survivors = [w for w in active if w.id in prev]
+        fresh = sorted((w for w in active if w.id not in prev),
+                       key=lambda w: (w.hostname, w.slot))
+        if not survivors:
+            return None  # nothing incremental about a full re-rank
+        order = [None] * len(active)
+        for w in survivors:
+            order[prev[w.id]] = w
+        for i in (i for i, w in enumerate(order) if w is None):
+            want = self._rank_hosts.get(i)
+            pick = next((w for w in fresh if w.hostname == want),
+                        fresh[0] if fresh else None)
+            if pick is None:
+                return None
+            fresh.remove(pick)
+            order[i] = pick
+        # Host-major validity: each hostname must form ONE contiguous run.
+        seen, last = set(), None
+        for w in order:
+            if w.hostname != last:
+                if w.hostname in seen:
+                    return None
+                seen.add(w.hostname)
+                last = w.hostname
+        return order
+
+    def _publish_stats(self):
+        """Publish the driver-side elastic counters to the KV store;
+        workers fold them into hvd.elastic_stats()."""
+        self.rdv.put("/ctl/elastic_stats", json.dumps(self.stats).encode())
+
+    def _kill_worker(self, w, transient):
+        """SIGKILL a wedged/partitioned worker and pre-record its failure
+        (the reap loop skips _driver_killed to avoid double-counting)."""
+        self.stats["driver_evictions"] += 1
+        self._driver_killed.add(w.id)
+        try:
+            w.proc.kill()
+        except Exception:
+            pass
+        self._record_failure(w.hostname, transient=transient)
+        self._publish_stats()
+
+    def _check_liveness(self, now):
+        """Scan the workers' KV alive-sequences. A value that has not
+        CHANGED (driver-clock comparison only — no cross-host clocks) for
+        longer than the stale window means the process is wedged
+        (SIGSTOP) or partitioned from the KV store; kill it so the epoch
+        can be repaired. Returns True when membership changed."""
+        stale_s = max(5.0, self._peer_timeout_ms / 1000.0 * 10)
+        dirty = False
+        for path, val in self.rdv.scan("/ctl/alive/").items():
+            wid = path.rsplit("/", 1)[-1]
+            prev = self._alive_seen.get(wid)
+            if prev is None or prev[0] != val:
+                self._alive_seen[wid] = (val, now)
+                continue
+            w = self.workers.get(wid)
+            if w is None or not w.alive or wid in self._driver_killed:
+                continue
+            if now - prev[1] > stale_s:
+                self._log(f"{wid} liveness stale {now - prev[1]:.1f}s "
+                          f"(> {stale_s:.1f}s); killing (wedged or "
+                          f"partitioned)")
+                self._kill_worker(w, transient=True)
+                dirty = True
+        return dirty
 
     def _serve_jax_coordination(self, np_):
         """Host this epoch's jax.distributed coordination service in the
@@ -296,6 +449,40 @@ class ElasticDriver:
                     self._log(f"reset requested by {wid} (epoch {req_epoch})")
                     membership_dirty = True
 
+            if not self._success_seen:
+                # Worker-pushed evictions: a surviving peer caught
+                # RankEvictedError naming a wedged rank. SIGKILL the victim
+                # (a SIGSTOP'd process never exits on its own; SIGTERM
+                # stays pending while it is stopped) and let the respawn /
+                # spare-promotion path repair the epoch.
+                for path, val in self.rdv.scan("/ctl/evict/").items():
+                    self.rdv.delete(path)  # consume: keep the KV bounded
+                    try:
+                        req = json.loads(val.decode())
+                        rank, ep = int(req["rank"]), int(req["epoch"])
+                    except (ValueError, KeyError, TypeError):
+                        continue
+                    if ep != self.epoch:
+                        continue  # stale: that epoch's mesh is gone
+                    vid = next((w for w, r in self._active_ranks.items()
+                                if r == rank), None)
+                    if vid is None or (vid, ep) in self._evict_handled:
+                        continue
+                    self._evict_handled.add((vid, ep))
+                    w = self.workers.get(vid)
+                    if w is not None and w.alive:
+                        self._log(f"evicting {vid} (rank {rank}, epoch "
+                                  f"{ep}): named by a surviving peer")
+                        self._kill_worker(w, transient=True)
+                        membership_dirty = True
+
+                # Liveness backstop: a wedge that strikes MID-COLLECTIVE
+                # never misses a control-plane heartbeat (the coordinator
+                # is not gathering), but the worker's KV alive-sequence
+                # stops advancing — kill it here.
+                if self._peer_timeout_ms > 0:
+                    membership_dirty |= self._check_liveness(now)
+
             # reap exits
             for w in list(self.workers.values()):
                 if w.exit_code is None:
@@ -321,7 +508,10 @@ class ElasticDriver:
                                       f"during wind-down (ignored)")
                         else:
                             self._log(f"{w.id} FAILED rc={code}")
-                            self._record_failure(w.hostname)
+                            if w.id not in self._driver_killed:
+                                # Driver-initiated kills already recorded
+                                # a transient failure at kill time.
+                                self._record_failure(w.hostname)
                             if self._success_seen:
                                 # An ESTABLISHED peer failing after a
                                 # finisher: its collective work completed
@@ -363,7 +553,10 @@ class ElasticDriver:
                 for w in alive:
                     have[w.hostname] = have.get(w.hostname, 0) + 1
                 total = sum(have.values())
-                cap = self.max_np or float("inf")
+                # Spawn budget covers the spare pool too, so a promotion
+                # is followed by a background respawn that refills it.
+                cap = (self.max_np + self.hot_spares) if self.max_np \
+                    else float("inf")
                 spawned = False
                 for host, slots in desired.items():
                     for slot in range(have.get(host, 0), slots):
@@ -446,6 +639,12 @@ def run_elastic(args):
             discovery = FixedHosts({"localhost": args.np or 1})
     min_np = args.min_np or args.np or 1
     max_np = args.max_np or 0
+    hot_spares = getattr(args, "hot_spares", 0) or 0
+    if hot_spares and not max_np:
+        # Spares only exist as workers beyond the active cap; an uncapped
+        # job would absorb them into the active set. Default the cap to
+        # the requested size.
+        max_np = args.np or min_np
     extra_env = args_to_env(args)
     if args.verbose:
         extra_env.setdefault("HVD_LOG_LEVEL", "debug")
@@ -453,7 +652,8 @@ def run_elastic(args):
                            extra_env=extra_env, verbose=args.verbose,
                            cooldown_range=tuple(
                                args.blacklist_cooldown_range)
-                           if args.blacklist_cooldown_range else None)
+                           if args.blacklist_cooldown_range else None,
+                           hot_spares=hot_spares)
     driver.ssh_port = args.ssh_port
     driver.remote_shell = getattr(args, "remote_shell", None)
     try:

@@ -40,6 +40,15 @@ def _worker_id():
     return os.environ["HVD_WORKER_ID"]
 
 
+def _liveness_enabled():
+    """KV liveness heartbeats ride the same knob as the control-plane
+    heartbeat (HVD_PEER_TIMEOUT_MS > 0): off means zero extra traffic."""
+    try:
+        return int(os.environ.get("HVD_PEER_TIMEOUT_MS", "0")) > 0
+    except ValueError:
+        return False
+
+
 def current_epoch():
     try:
         return int(http_server.read_kv(_rdv_addr(), "ctl", "epoch",
@@ -58,6 +67,48 @@ def fetch_assignment(epoch, timeout=600.0):
     if val == "exit":
         return "exit"
     return json.loads(val)
+
+
+def report_eviction(rank, epoch):
+    """Tell the driver a named rank was evicted from the control plane
+    (RankEvictedError reached this worker). The driver maps the rank back
+    to a worker id via its per-epoch rank map, kills the wedged process,
+    and records a transient failure — without this push it would wait for
+    the liveness backstop to notice. Best-effort: the epoch poll + stale
+    liveness remain the fallback."""
+    try:
+        http_server.put_kv(
+            _rdv_addr(), "ctl", f"evict/{_worker_id()}",
+            json.dumps({"rank": int(rank), "epoch": int(epoch)}).encode(),
+            secret_key=_rdv_secret())
+    except Exception:
+        pass
+
+
+_driver_stats_cache = {}
+_driver_stats_ts = 0.0
+_DRIVER_STATS_TTL_S = 2.0
+
+
+def fetch_driver_stats():
+    """Best-effort snapshot of the driver-side elastic counters
+    (promotions, incremental/full epochs, driver evictions) published at
+    `/ctl/elastic_stats`. Cached briefly so hvd.elastic_stats() stays
+    cheap enough to sample per step; {} when the driver has published
+    nothing (e.g. pre-eviction) or the KV store is unreachable."""
+    global _driver_stats_cache, _driver_stats_ts
+    now = time.monotonic()
+    if now - _driver_stats_ts < _DRIVER_STATS_TTL_S:
+        return dict(_driver_stats_cache)
+    try:
+        raw = http_server.read_kv(_rdv_addr(), "ctl", "elastic_stats",
+                                  secret_key=_rdv_secret())
+        _driver_stats_cache = {k: int(v)
+                               for k, v in json.loads(raw.decode()).items()}
+    except Exception:
+        _driver_stats_cache = dict(_driver_stats_cache)
+    _driver_stats_ts = now
+    return dict(_driver_stats_cache)
 
 
 def request_reset(epoch):
@@ -108,10 +159,15 @@ def rendezvous_init():
     then init the core. Called from hvd.init() when HVD_ELASTIC=1."""
     from ...basics import basics
 
+    # Start the poll thread before parking: a hot spare heartbeats from it
+    # while it waits, long before elastic.run() would have started it.
+    notification_manager.init()
     epoch = _wait_epoch_at_least(int(os.environ.get("HVD_SPAWN_EPOCH", 0)))
     a = fetch_assignment(epoch)
     if a == "exit":
         raise SystemExit(0)
+    if isinstance(a, dict) and a.get("spare"):
+        epoch, a = _park_as_spare(epoch)
     apply_assignment(a)
     notification_manager.set_epoch(epoch)
     _negotiate()
@@ -146,6 +202,8 @@ def rendezvous_reset():
     a = fetch_assignment(epoch)
     if a == "exit":
         raise SystemExit(0)
+    if isinstance(a, dict) and a.get("spare"):
+        epoch, a = _park_as_spare(epoch)
     apply_assignment(a)
     notification_manager.set_epoch(epoch)
     _negotiate()
@@ -178,6 +236,36 @@ def _wait_epoch_at_least(n, timeout=600.0):
     raise TimeoutError(f"no rendezvous epoch >= {n} within {timeout}s")
 
 
+def _park_as_spare(epoch):
+    """Hot-spare parking: this worker is rendezvoused with the driver but
+    holds no rank. Keep heartbeating (the notification poll thread does
+    that) and wait for a promotion — an epoch whose assignment table gives
+    this id a real rank. Parking is unbounded on purpose: a spare's whole
+    job is to wait. Returns (epoch, assignment) on promotion; raises
+    SystemExit when the driver retires the spare."""
+    notification_manager.set_epoch(epoch)
+    while True:
+        try:
+            epoch = _wait_epoch_at_least(epoch + 1)
+        except TimeoutError:
+            continue  # still parked; keep waiting
+        a = fetch_assignment(epoch)
+        if a == "exit":
+            raise SystemExit(0)
+        if isinstance(a, dict) and a.get("spare"):
+            notification_manager.set_epoch(epoch)
+            continue
+        try:  # promotion marker for merged traces (core side: TCP_EVICT)
+            from ...observability import spans as _spans
+
+            _spans.instant("ELASTIC_PROMOTE", epoch=epoch,
+                           rank=a.get("rank", -1) if isinstance(a, dict)
+                           else -1)
+        except Exception:
+            pass
+        return epoch, a
+
+
 class WorkerNotificationManager:
     """Polls the driver's epoch counter; a bump while training means the
     membership changed → notify registered States so the next commit()
@@ -208,8 +296,24 @@ class WorkerNotificationManager:
                 self._listeners.remove(state)
 
     def _poll(self):
+        liveness_on = _liveness_enabled()
+        seq = 0
         while True:
             time.sleep(POLL_INTERVAL_S)
+            if liveness_on:
+                # Driver-side wedge backstop: PUT a monotonically
+                # increasing sequence number; the driver tracks *when the
+                # value last changed* on its own clock (no cross-host
+                # clock comparison). A SIGSTOP'd worker stops bumping it
+                # even when the core's control plane is mid-collective and
+                # the coordinator cannot observe the wedge.
+                seq += 1
+                try:
+                    http_server.put_kv(
+                        _rdv_addr(), "ctl", f"alive/{_worker_id()}",
+                        str(seq).encode(), secret_key=_rdv_secret())
+                except Exception:
+                    pass
             try:
                 e = current_epoch()
             except Exception:

@@ -19,7 +19,10 @@ read-only health data and scrapers cannot sign requests, so it is served
 without the HMAC check.
 """
 
+import os
+import random
 import threading
+import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -29,6 +32,29 @@ from . import util
 SIG_HEADER = "X-Hvd-Sig"
 METRICS_PATH = "/metrics"
 _METRICS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# Transient-failure retry policy for the KV client: a driver mid-restart or
+# a loaded accept queue must not fail the worker on one ECONNREFUSED.
+# Bounded attempts with exponential backoff + full jitter; 404 (the
+# rendezvous barrier) and signature failures are NOT transient and are
+# never retried here. HVD_KV_RETRIES=0 restores single-shot behavior.
+_RETRY_BASE_S = 0.05
+_RETRY_CAP_S = 2.0
+
+_retry_lock = threading.Lock()
+_retry_count = 0
+
+
+def retry_count():
+    """Transient KV-client retries performed by this process (the
+    ``kv_retries`` field of ``hvd.elastic_stats()``)."""
+    return _retry_count
+
+
+def _note_retry():
+    global _retry_count
+    with _retry_lock:
+        _retry_count += 1
 
 
 def _serve_metrics(handler):
@@ -196,7 +222,7 @@ class MetricsServer:
             self._httpd = None
 
 
-def _request(method, url, payload=b"", secret_key=None, timeout=10.0):
+def _request_once(method, url, payload=b"", secret_key=None, timeout=10.0):
     req = urllib.request.Request(url, data=payload or None, method=method)
     if secret_key is not None:
         from urllib.parse import urlparse
@@ -206,6 +232,31 @@ def _request(method, url, payload=b"", secret_key=None, timeout=10.0):
                                  method.encode() + path.encode() + payload))
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         return resp.read()
+
+
+def _transient(exc):
+    """Connect/read failures worth retrying. HTTP status responses (404
+    rendezvous misses, 403 bad signature) reached the server — retrying
+    cannot change the outcome and 404 has its own poll loop in read_kv."""
+    if isinstance(exc, urllib.error.HTTPError):
+        return False
+    return isinstance(exc, (urllib.error.URLError, ConnectionError,
+                            TimeoutError, OSError))
+
+
+def _request(method, url, payload=b"", secret_key=None, timeout=10.0):
+    attempts = int(os.environ.get("HVD_KV_RETRIES", "5")) + 1
+    for attempt in range(attempts):
+        try:
+            return _request_once(method, url, payload, secret_key, timeout)
+        except Exception as e:
+            if attempt == attempts - 1 or not _transient(e):
+                raise
+            _note_retry()
+            # Full jitter keeps a herd of workers retrying a restarting
+            # driver from re-colliding in lockstep.
+            delay = min(_RETRY_CAP_S, _RETRY_BASE_S * (2 ** attempt))
+            time.sleep(random.uniform(0, delay))
 
 
 def put_kv(addr, scope, key, value: bytes, secret_key=None):

@@ -121,6 +121,16 @@ def parse_args(argv=None):
     p.add_argument("--blacklist-cooldown-range", nargs=2, type=float,
                    default=None, help="elastic host blacklist cooldown "
                    "min/max seconds")
+    p.add_argument("--hot-spares", dest="hot_spares", type=int,
+                   default=None,
+                   help="elastic: keep N pre-warmed rankless workers "
+                        "parked so an eviction is repaired by promotion "
+                        "instead of a cold spawn (docs/elastic.md)")
+    p.add_argument("--peer-timeout-ms", dest="peer_timeout_ms", type=int,
+                   default=None,
+                   help="control-plane liveness heartbeat deadline in ms "
+                        "(HVD_PEER_TIMEOUT_MS; 0 disables eviction — "
+                        "docs/elastic.md)")
     p.add_argument("--check-build", action="store_true",
                    help="print framework/native-layer availability and "
                         "exit (reference: horovodrun --check-build)")

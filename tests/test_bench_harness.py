@@ -43,6 +43,9 @@ def test_hung_config_is_killed_and_rest_still_measure():
     p, lines = _run_bench(
         {"_BENCH_TEST_HANG": "transformer",
          "BENCH_CAP_TRANSFORMER": "8",
+         # elastic sheds its optional fault-matrix jobs under a tight
+         # sub-budget; the headline recovery job alone proves the config.
+         "BENCH_CAP_ELASTIC": "75",
          # 540 + the bucket config's 90 s cap (the A/B itself is seconds
          # warm; the headroom is for a cold cache on a loaded box).
          "BENCH_DEADLINE": "630",

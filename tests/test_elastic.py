@@ -208,6 +208,71 @@ def test_elastic_kv_rejects_unsigned_requests():
         d.stop()
 
 
+def test_blacklist_transient_decay():
+    """A blacklist earned entirely by transient evictions (driver kills of
+    wedged workers) lifts early once those records age out of
+    TRANSIENT_DECAY_S; any hard crash in the mix pins the full cooldown."""
+    from horovod_tpu.runner.elastic import driver as drv
+    from horovod_tpu.runner.elastic.discovery import FixedHosts
+
+    d = drv.ElasticDriver(["true"], FixedHosts({}), 1, 1,
+                          cooldown_range=(30.0, 60.0))
+    try:
+        t0 = 1000.0
+        for i in range(drv.FAILURES_TO_BLACKLIST):
+            d._record_failure("hostA", transient=True, now=t0 + i)
+        assert d._blacklisted("hostA", t0 + 3)
+        # All-transient: lifts as soon as the records decay, well before
+        # the 30 s cooldown would expire.
+        assert not d._blacklisted("hostA", t0 + drv.TRANSIENT_DECAY_S + 3)
+
+        for i in range(drv.FAILURES_TO_BLACKLIST - 1):
+            d._record_failure("hostB", transient=True, now=t0 + i)
+        d._record_failure("hostB", transient=False, now=t0 + 2.0)
+        assert d._blacklisted("hostB", t0 + 3)
+        # The hard crash pins the cooldown past the transient decay point…
+        assert d._blacklisted("hostB", t0 + drv.TRANSIENT_DECAY_S + 3)
+        # …and only the cooldown itself lifts it.
+        assert not d._blacklisted("hostB", t0 + 2.0 + 30.0 + 1)
+    finally:
+        d.stop()
+
+
+def test_incremental_epoch_preserves_survivor_ranks():
+    """Eviction repair keeps survivor ranks: the newcomer slots into the
+    freed rank (incremental epoch) instead of forcing a full re-rank, and
+    a size change still falls back to None (full path)."""
+    from horovod_tpu.runner.elastic.discovery import FixedHosts
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+
+    class W:
+        def __init__(self, wid, host, slot):
+            self.id, self.hostname, self.slot = wid, host, slot
+
+    d = ElasticDriver(["true"], FixedHosts({}), 1, 4)
+    try:
+        a = W("a", "localhost", 0)
+        c = W("c", "localhost", 2)
+        s = W("spare", "localhost", 3)
+        prev = {"a": 0, "b": 1, "c": 2}
+        d._rank_hosts = {0: "localhost", 1: "localhost", 2: "localhost"}
+        order = d._incremental_order([a, s, c], prev)
+        assert order is not None
+        assert [w.id for w in order] == ["a", "spare", "c"]
+        # identity membership is also incremental (rank stability)
+        b = W("b", "localhost", 1)
+        assert [w.id for w in d._incremental_order([c, a, b], prev)] \
+            == ["a", "b", "c"]
+        # size change -> full re-rank
+        assert d._incremental_order([a, c], prev) is None
+        # all-fresh membership has nothing to preserve
+        assert d._incremental_order(
+            [W("x", "localhost", 0), W("y", "localhost", 1),
+             W("z", "localhost", 2)], prev) is None
+    finally:
+        d.stop()
+
+
 def test_elastic_scale_down(tmp_path):
     """Discovery removes a slot mid-run: the excess worker is told to exit
     via the KV directive, the rest re-rendezvous at size=2 and finish."""

@@ -223,6 +223,36 @@ def test_kv_store_roundtrip():
         srv.stop()
 
 
+def test_kv_client_retries_transient_only(monkeypatch):
+    """Bounded retry policy (docs/elastic.md): ECONNREFUSED against a dead
+    port is retried HVD_KV_RETRIES times (counted in retry_count(), the
+    kv_retries field of hvd.elastic_stats()); an HTTP status from a LIVE
+    server (404 missing key) reached the server and is never retried."""
+    import urllib.error
+
+    from horovod_tpu.runner.local import find_free_port
+
+    monkeypatch.setenv("HVD_KV_RETRIES", "2")
+    # Squash the backoff sleeps; the schedule itself is what we count.
+    monkeypatch.setattr(http_server.time, "sleep", lambda s: None)
+    before = http_server.retry_count()
+    dead = find_free_port()  # probed free, nothing listening
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        http_server.put_kv(f"127.0.0.1:{dead}", "scope", "k", b"v")
+    assert http_server.retry_count() - before == 2
+
+    srv = http_server.RendezvousServer()
+    port = srv.start()
+    try:
+        before = http_server.retry_count()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            http_server.read_kv(f"127.0.0.1:{port}", "scope", "nope")
+        assert ei.value.code == 404
+        assert http_server.retry_count() == before  # 404 is not transient
+    finally:
+        srv.stop()
+
+
 def test_kv_store_wait_rendezvous():
     import threading
     import time

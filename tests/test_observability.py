@@ -328,6 +328,61 @@ def test_stall_warning_increments_metric(metrics_on):
         insp.stop()
 
 
+def test_stall_configure_reloads_thresholds():
+    """configure() swaps thresholds at runtime: a tighter warning fires on
+    the next scan, and loosening the shutdown threshold clears a pending
+    (not-yet-raised) StallError decided under the old one."""
+    warns = []
+    insp = stall.StallInspector(warning_sec=100, shutdown_sec=-1,
+                                check_interval=100,
+                                on_warn=lambda n, dt: warns.append(n))
+    try:
+        insp.report_start("op.cfg")
+        later = time.monotonic() + 5.0
+        insp._scan(now=later)
+        assert warns == []  # 5s stall, 100s threshold
+        insp.configure(warning_sec=1.0)
+        insp._scan(now=later)
+        assert "op.cfg" in warns
+        # Tighten shutdown -> verdict; loosen -> pending error withdrawn.
+        insp.configure(shutdown_sec=1.0)
+        insp._scan(now=later)
+        assert insp.shutdown_fired
+        insp.configure(shutdown_sec=1000.0)
+        assert not insp.shutdown_fired
+        insp.check_shutdown()  # must not raise
+    finally:
+        insp.stop()
+
+
+def test_stall_mark_rank_evicted_clears_attributed_ops():
+    """Eviction hygiene: ops attributed to an evicted rank leave the stall
+    set, later reports for that rank are ignored, and a pending shutdown
+    verdict (the stall WAS the dead peer) is withdrawn."""
+    insp = stall.StallInspector(warning_sec=-1, shutdown_sec=1.0,
+                                check_interval=100)
+    try:
+        insp.report_start("send.2", rank=2)
+        insp.report_start("send.3", rank=3)
+        insp.report_start("local.op")
+        insp._scan(now=time.monotonic() + 5.0)
+        assert insp.shutdown_fired
+        insp.mark_rank_evicted(2)
+        assert insp.evicted_ranks() == {2}
+        assert [n for n, _ in insp.stalled()] \
+            and "send.2" not in dict(insp.stalled())
+        assert "send.3" in dict(insp.stalled())
+        # the eviction superseded the verdict
+        assert not insp.shutdown_fired
+        insp.check_shutdown()  # must not raise
+        insp.report_start("send2.2", rank=2)
+        assert "send2.2" not in dict(insp.stalled())
+        insp.reset()
+        assert insp.evicted_ranks() == set() and insp.stalled() == []
+    finally:
+        insp.stop()
+
+
 # ---------------------------------------------------------------------------
 # Spans + merge
 

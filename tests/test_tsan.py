@@ -84,6 +84,24 @@ def test_streamed_ring_reduce_under_tsan(tmp_path):
     assert_sanitizer_clean(p, 2, core_reports, tier="tsan")
 
 
+@pytest.mark.slow
+def test_eviction_under_load_under_tsan(tmp_path):
+    """The peer-liveness eviction path (ISSUE 10) under the sanitizer:
+    rank 1 wedges via the in-core blackhole hook while rank 0's
+    coordinator counts missed control-plane deadlines, escalates to
+    EvictRank, and aborts the in-flight collective — with frontend
+    threads on both ranks concurrently polling the heartbeat/eviction
+    counters via hvd.elastic_stats(). Generous deadline budget: under
+    TSAN a slow cycle must read as SLOW, not wedged."""
+    p, core_reports = _run_under_tsan(
+        tmp_path, "evict_worker.py", 2,
+        extra_env={"EVICT_SYNC": str(tmp_path / "evicted.sync"),
+                   "HVD_FAULT_INJECT": "1",
+                   "HVD_PEER_TIMEOUT_MS": "2000",
+                   "HVD_PEER_EVICT_MISSES": "3"})
+    assert_sanitizer_clean(p, 2, core_reports, tier="tsan")
+
+
 def test_bucketed_ring_under_tsan(tmp_path):
     """The ordered bucket assembler (ISSUE 8) under the sanitizer:
     frontend threads feed PushRequest while the background thread runs

@@ -1,0 +1,102 @@
+"""Chaos-injection elastic worker (docs/elastic.md methodology).
+
+Same elastic loop as elastic_train_worker.py, but the configured victim
+slot injects one of three fault types at a fixed iteration:
+
+- ``kill``      — SIGKILL self: clean death, sockets close, the
+                  coordinator evicts by name on the dead control socket.
+- ``stop``      — SIGSTOP self: the classic wedge. The process stays
+                  alive holding every socket open; detection must come
+                  from missed control-plane heartbeats
+                  (HVD_PEER_TIMEOUT_MS) or the driver's KV liveness
+                  backstop, which SIGKILLs the stopped process.
+- ``partition`` — arm the in-core fault hook (HVD_FAULT_INJECT=1 in the
+                  job env) and trigger ``blackhole``: every core TCP
+                  send/recv parks forever, simulating a network
+                  partition of the control+data planes while the Python
+                  side (KV heartbeats) stays reachable.
+
+Env knobs (set by the test):
+- TEST_ITERS / TEST_SLEEP / TEST_LOG: as elastic_train_worker.py
+- TEST_CHAOS_FAULT: kill | stop | partition (default: no fault)
+- TEST_CHAOS_SLOT:  slot index of the victim (default 1)
+- TEST_CHAOS_ITER:  iteration the fault fires at (default 3)
+- TEST_MARKER:      marker file recording the fault already fired
+
+On completion every survivor runs a post-recovery parity check — a
+fresh allreduce of ones must equal the final world size — and logs
+``final rank=R size=S iter=I parity=ok``.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic
+
+hvd.init()
+
+ITERS = int(os.environ.get("TEST_ITERS", "8"))
+SLEEP = float(os.environ.get("TEST_SLEEP", "0.1"))
+FAULT = os.environ.get("TEST_CHAOS_FAULT", "")
+SLOT = os.environ.get("TEST_CHAOS_SLOT", "1")
+FAULT_ITER = int(os.environ.get("TEST_CHAOS_ITER", "3"))
+MARKER = os.environ.get("TEST_MARKER", "")
+WID = os.environ.get("HVD_WORKER_ID", "?")
+
+
+def _is_victim(it):
+    if not FAULT or not MARKER or os.path.exists(MARKER):
+        return False
+    return it == FAULT_ITER and WID.startswith(f"localhost-{SLOT}-")
+
+
+def _inject():
+    with open(MARKER, "w") as f:
+        f.write(f"{FAULT} {WID}")
+    if FAULT == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif FAULT == "stop":
+        # The wedge: stopped, not dead. Sockets stay open; only a
+        # heartbeat deadline or the driver liveness backstop can tell
+        # this apart from a slow rank (and SIGKILL works on a stopped
+        # process where SIGTERM stays pending).
+        os.kill(os.getpid(), signal.SIGSTOP)
+    elif FAULT == "partition":
+        assert hvd.fault_trigger("blackhole"), \
+            "fault hook not armed (HVD_FAULT_INJECT missing from job env?)"
+        # The next collective parks forever inside the core; the driver
+        # must SIGKILL this process once a survivor names the rank.
+    else:
+        raise RuntimeError(f"unknown TEST_CHAOS_FAULT={FAULT!r}")
+
+
+state = elastic.ObjectState(iteration=0, total=np.zeros(4, np.float32))
+
+
+@elastic.run
+def train(state):
+    while state.iteration < ITERS:
+        if _is_victim(state.iteration):
+            _inject()
+        out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                            name=f"it.{state.iteration}")
+        state.total = state.total + out
+        state.iteration += 1
+        state.commit()
+        time.sleep(SLEEP)
+    return hvd.rank(), hvd.size()
+
+
+rank, size = train(state)
+# Post-recovery parity: the repaired mesh must still reduce correctly.
+check = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum, name="parity")
+parity = "ok" if np.allclose(check, float(size)) else f"BAD({check[0]})"
+if os.environ.get("TEST_LOG"):
+    with open(os.environ["TEST_LOG"], "a") as f:
+        f.write(f"final rank={rank} size={size} iter={state.iteration} "
+                f"parity={parity}\n")
+hvd.shutdown()

@@ -1,0 +1,88 @@
+"""Eviction-under-load worker for the sanitizer tiers (docs/elastic.md).
+
+2-rank static job exercising the peer-liveness/eviction machinery's
+concurrency surface: rank 1 arms the in-core blackhole fault hook
+mid-run (its background thread parks holding every socket open — the
+wedge), while rank 0 keeps issuing collectives and a frontend thread on
+BOTH ranks polls hvd.elastic_stats() — the frontend reads of the
+heartbeat-miss/eviction counters the coordinator thread is concurrently
+bumping are exactly what TSAN validates here.
+
+Rank 0 must observe the wedge as missed control-plane deadlines, evict
+rank 1 by name (RankEvictedError), and record the eviction in its
+counters. Rank 1's Python side stays live (only its core is parked); it
+waits for rank 0's sync file, prints PASS, and _exits. Both ranks PASS.
+
+Env: EVICT_SYNC (sync file path), HVD_FAULT_INJECT=1,
+HVD_PEER_TIMEOUT_MS / HVD_PEER_EVICT_MISSES set by the test.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+SYNC = os.environ["EVICT_SYNC"]
+
+hvd.init()
+rank = hvd.rank()
+assert hvd.size() == 2, hvd.size()
+
+stop = threading.Event()
+
+
+def _poll_stats():
+    # Frontend reads racing the coordinator's counter updates.
+    while not stop.is_set():
+        hvd.elastic_stats()
+        time.sleep(0.002)
+
+
+poller = threading.Thread(target=_poll_stats, daemon=True)
+poller.start()
+
+for it in range(10):
+    hvd.allreduce(np.ones(1024, np.float32), op=hvd.Sum, name=f"warm.{it}")
+
+if rank == 1:
+    assert hvd.fault_trigger("blackhole"), "fault hook not armed"
+    # The core is now parked; this thread is not. Wait for rank 0 to
+    # confirm the eviction, then vanish (os._exit: no core shutdown —
+    # the parked background thread would never join).
+    deadline = time.time() + 300
+    while not os.path.exists(SYNC):
+        if time.time() > deadline:
+            print("FAIL: rank 0 never confirmed eviction", flush=True)
+            os._exit(1)
+        time.sleep(0.1)
+    stop.set()
+    print("PASS", flush=True)
+    os._exit(0)
+
+# rank 0: keep the load up until the miss escalation names the wedge.
+err = None
+deadline = time.time() + 300
+it = 0
+try:
+    while time.time() < deadline:
+        hvd.allreduce(np.ones(1024, np.float32), op=hvd.Sum,
+                      name=f"post.{it}")
+        it += 1
+except hvd.RankEvictedError as e:
+    err = e
+assert err is not None, "no eviction within the deadline"
+assert err.rank == 1, err
+stats = hvd.elastic_stats()
+assert stats["evictions"] >= 1, stats
+assert stats["last_evicted_rank"] == 1, stats
+assert stats["heartbeat_misses"] >= 1, stats
+stop.set()
+with open(SYNC, "w") as f:
+    f.write("evicted")
+print("PASS", flush=True)
+sys.stdout.flush()
+os._exit(0)
