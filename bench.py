@@ -977,6 +977,160 @@ def _bucket_bench_worker():
     hvd.shutdown()
 
 
+def _bench_compress():
+    """Compressed-collective A/B through the C++ host plane (ISSUE 11
+    acceptance): the same steady-state f32 allreduce stream run under
+    {off, bf16, int8, topk} at BENCH_COMPRESS_RANKS loopback ranks.
+    Records per-mode per-op step time and bytes-on-wire (measured from
+    hvd.compress_stats() for the core codecs, ring arithmetic for the
+    cast modes), the wire-reduction ratios vs the uncompressed f32 ring
+    (int8 must clear 3.5x, topk at 1% must clear 10x), and the int8/topk
+    residual-norm trajectories (bounded = error feedback is live). Same
+    caveat as _bench_hostplane: loopback TCP is a scaling signal, not an
+    ICI claim."""
+    import tempfile
+
+    from horovod_tpu.runner.local import run_local
+
+    np_ = int(os.environ.get("BENCH_COMPRESS_RANKS", "4"))
+    frac = float(os.environ.get("BENCH_COMPRESS_TOPK_FRAC", "0.01"))
+    modes = (
+        ("off", {}),
+        ("bf16", {}),
+        ("int8", {"HVD_COMPRESS": "int8"}),
+        # topk needs ~1/frac steps before every coordinate has cycled
+        # through selection and the residual plateaus; run it long enough
+        # that the recorded trajectory shows the plateau, not the ramp.
+        ("topk", {"HVD_COMPRESS": "topk",
+                  "HVD_COMPRESS_TOPK_FRAC": str(frac),
+                  "_BENCH_COMPRESS_ITERS": str(max(32, int(1.5 / frac)))}),
+    )
+    runs = {}
+    for mode, mode_env in modes:
+        fd, out_path = tempfile.mkstemp(prefix="hvd_bench_compress_")
+        os.close(fd)
+        try:
+            env = {"PYTHONPATH":
+                   _repo_pythonpath(os.environ.get("PYTHONPATH")),
+                   "JAX_PLATFORMS": "cpu",
+                   "_BENCH_COMPRESS_WORKER": "1",
+                   "_BENCH_COMPRESS_MODE": mode,
+                   "_BENCH_COMPRESS_OUT": out_path}
+            env.update(mode_env)
+            codes = run_local(np_,
+                              [sys.executable, os.path.abspath(__file__)],
+                              env=env, timeout=90)
+            if codes != [0] * np_:
+                raise RuntimeError(f"compress[{mode}] ranks exited {codes}")
+            with open(out_path) as f:
+                runs[mode] = json.load(f)
+        finally:
+            try:
+                os.unlink(out_path)
+            except OSError:
+                pass
+    off = runs["off"]
+    per_mode = {}
+    for mode, _ in modes:
+        rec = runs[mode]
+        per_mode[mode] = {
+            "step_ms": rec["step_ms"],
+            "wire_bytes_per_op": rec["wire_bytes_per_op"],
+            "ratio_vs_f32": (round(off["wire_bytes_per_op"]
+                                   / rec["wire_bytes_per_op"], 2)
+                             if rec["wire_bytes_per_op"] else None),
+        }
+        if rec.get("residual_norms"):
+            per_mode[mode]["residual_norms"] = rec["residual_norms"]
+    int8_ratio = per_mode["int8"]["ratio_vs_f32"]
+    topk_ratio = per_mode["topk"]["ratio_vs_f32"]
+    d = {"metric": "compressed_allreduce_wire_reduction",
+         "value": int8_ratio,
+         "unit": "x (f32 ring wire bytes / int8 wire bytes, loopback)",
+         "n_ranks": np_, "payload_bytes": off["payload_bytes"],
+         "topk_frac": frac, "topk_ratio_vs_f32": topk_ratio,
+         "modes": per_mode,
+         "cpu_cores": len(os.sched_getaffinity(0)),
+         "vs_baseline": 1.0}
+    # Acceptance floors, measured not asserted-by-construction: int8's
+    # per-hop 4-byte scale must still clear 3.5x, topk(1%) clears 10x.
+    assert int8_ratio is not None and int8_ratio >= 3.5, per_mode["int8"]
+    assert topk_ratio is not None and topk_ratio >= 10.0, per_mode["topk"]
+    # The off run is the kill-switch proof: zero codec engagements.
+    assert off["engaged_ops"] == 0, off
+    # Error feedback is live: residual norms recorded and plateaued (the
+    # tail of the trajectory does not outgrow the first half — for topk
+    # that requires the >1/frac steps provisioned above).
+    for mode in ("int8", "topk"):
+        norms = runs[mode]["residual_norms"]
+        assert norms and norms[-1] <= 2.0 * max(norms[:len(norms) // 2]), \
+            (mode, norms)
+    return d
+
+
+def _compress_bench_worker():
+    """Rank body for _bench_compress (spawned with _BENCH_COMPRESS_WORKER
+    set). One named f32 gradient allreduced for `iters` steady-state
+    steps (response cache engaged) under the mode's codec; rank 0 writes
+    step-time + wire-byte + residual-trajectory JSON."""
+    import horovod_tpu as hvd
+    from horovod_tpu.compression import Compression
+
+    hvd.init()
+    r, s = hvd.rank(), hvd.size()
+    mode = os.environ["_BENCH_COMPRESS_MODE"]
+    n = int(os.environ.get("_BENCH_COMPRESS_FLOATS", str(256 * 1024)))
+    iters = int(os.environ.get("_BENCH_COMPRESS_ITERS", "16"))
+    rng = np.random.RandomState(42 + r)
+    x = rng.rand(n).astype(np.float32) * 2.0 - 1.0
+    comp = Compression.bf16 if mode == "bf16" else None
+    if comp is not None:
+        try:
+            comp.compress(x)
+        except ImportError:
+            comp = Compression.fp16  # same wire width, no ml_dtypes need
+
+    def one():
+        if comp is not None:
+            w, ctx = comp.compress(x)
+            return comp.decompress(
+                np.asarray(hvd.allreduce(w, op=hvd.Sum, name="grad")), ctx)
+        return hvd.allreduce(x, op=hvd.Sum, name="grad")
+
+    for _ in range(2):  # first sight + first cache hit
+        one()
+    hvd.barrier()
+    norms = []
+    every = max(1, iters // 16)  # <= 16 recorded points however long
+    t0 = time.perf_counter()
+    for i in range(iters):
+        one()
+        if mode in ("int8", "topk") and (i + 1) % every == 0:
+            norms.append(hvd.compress_stats()["residual_norm"])
+    dt = time.perf_counter() - t0
+    st = hvd.compress_stats()
+    engaged = st["int8_ops"] + st["topk_ops"]
+    if mode in ("int8", "topk"):
+        assert engaged >= iters, (mode, st)
+        wire_per_op = st["wire_bytes"] / engaged
+    else:
+        assert engaged == 0, (mode, st)
+        # Uncompressed/cast ring: 2*(s-1)/s of the wire payload per rank
+        # (reduce-scatter + allgather), at the wire dtype's width.
+        wire_nbytes = x.nbytes if comp is None else comp.compress(x)[0].nbytes
+        wire_per_op = 2.0 * (s - 1) / s * wire_nbytes
+    if r == 0:
+        with open(os.environ["_BENCH_COMPRESS_OUT"], "w") as f:
+            json.dump({"mode": mode, "payload_bytes": x.nbytes,
+                       "step_ms": round(dt / iters * 1e3, 3),
+                       "wire_bytes_per_op": round(wire_per_op, 1),
+                       "engaged_ops": engaged,
+                       "residual_norms": [round(v, 6) for v in norms],
+                       "iters": iters}, f)
+    hvd.barrier()
+    hvd.shutdown()
+
+
 def _bench_bridge():
     """16 MB bridged eager allreduce (ISSUE 4 tentpole): the dlpack /
     buffer-protocol zero-copy bridge vs a forced-copy A/B on a 2-rank
@@ -1397,6 +1551,7 @@ _CONFIG_FNS = {
     "longctx": _bench_longctx,
     "hostplane": _bench_hostplane,
     "bucket": _bench_bucket,
+    "compress": _bench_compress,
     "bridge": _bench_bridge,
     "reduce": _bench_reduce,
     "moe": _bench_moe,
@@ -1410,6 +1565,8 @@ _METRIC_NAMES = {
     "longctx": ("longctx_flash_train_throughput", "tokens/sec/chip"),
     "hostplane": ("allreduce_hostplane_bus_bandwidth", "GB/s"),
     "bucket": ("bucketed_vs_monolithic_step_time", "x speedup"),
+    "compress": ("compressed_allreduce_wire_reduction",
+                 "x (f32 ring wire bytes / int8 wire bytes)"),
     "bridge": ("bridge_eager_allreduce_16MB", "ms/op"),
     "reduce": ("reduce_kernel_vector_bandwidth", "GB/s"),
     "moe": ("moe_dispatch_throughput", "tokens/sec"),
@@ -1418,8 +1575,10 @@ _METRIC_NAMES = {
 
 # Per-config wall caps (seconds). Only bind when something hangs; healthy
 # runs finish far inside them (the full round-5 healthy run took ~8 min).
-# probe (75) + caps sum to 1425 <= the default BENCH_DEADLINE=1500, so
-# even an every-config-hangs run emits all lines inside the budget.
+# probe (75) + caps sum past the default BENCH_DEADLINE=1500 since the
+# compress config joined; an every-config-hangs run still emits a line
+# per config — the tail configs get explicit "deadline nearly exhausted"
+# error lines from the <45 s guard instead of measurements.
 _CONFIG_CAPS = {
     "resnet50": 195,
     "transformer": 165,
@@ -1431,6 +1590,8 @@ _CONFIG_CAPS = {
     "hostplane": 90,
     # Two pods (HVD_BUCKET on/off), 10 simulated-backward steps each.
     "bucket": 90,
+    # Four pods ({off, bf16, int8, topk}), 18 steady-state steps each.
+    "compress": 120,
     "bridge": 60,
     # In-process ctypes microbench; seconds on a healthy box.
     "reduce": 30,
@@ -1676,7 +1837,7 @@ def main():
 
     results = {}
     order = ["resnet50", "transformer", "allreduce", "longctx", "hostplane",
-             "bucket", "bridge", "reduce", "moe", "elastic"]
+             "bucket", "compress", "bridge", "reduce", "moe", "elastic"]
     for name in order:
         cap = _cap(name)
         left = remaining() - 15  # reserve for final assembly
@@ -1715,6 +1876,8 @@ if __name__ == "__main__":
         _hostplane_worker()
     elif os.environ.get("_BENCH_BUCKET_WORKER") == "1":
         _bucket_bench_worker()
+    elif os.environ.get("_BENCH_COMPRESS_WORKER") == "1":
+        _compress_bench_worker()
     elif os.environ.get("_BENCH_BRIDGE_WORKER") == "1":
         _bridge_worker()
     elif os.environ.get("_BENCH_ELASTIC_WORKER") == "1":

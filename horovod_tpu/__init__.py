@@ -151,6 +151,9 @@ shm_stats = _basics.shm_stats
 shm_state = _basics.shm_state
 bucket_stats = _basics.bucket_stats
 bucket_state = _basics.bucket_state
+compress_stats = _basics.compress_stats
+compress_state = _basics.compress_state
+set_compression = _basics.set_compression
 reduce_pool_stats = _basics.reduce_pool_stats
 hier_stats = _basics.hier_stats
 elastic_stats = _basics.elastic_stats
@@ -162,6 +165,34 @@ lockdep_selftest = _basics.lockdep_selftest
 peer_tx_bytes = _basics.peer_tx_bytes
 op_backends = _basics.op_backends
 backend_uses = _basics.backend_uses
+
+
+def compression_stats():
+    """One merged view of every compression surface: the core wire codecs
+    (int8 error-feedback ring / top-k allgather — compress_stats()) plus
+    the binding-level wire-cast counters (compression.record_wire_cast).
+    ``engagements`` totals every compressed op either layer performed and
+    ``bytes_saved`` / ``compression_ratio`` quantify the wire reduction;
+    all zeros proves the kill switch (compression off) left every byte
+    uncompressed."""
+    from . import compression as _compression
+
+    core = compress_stats()
+    casts = _compression.stats()
+    raw, wire = core["raw_bytes"], core["wire_bytes"]
+    return {
+        "int8_ops": core["int8_ops"],
+        "topk_ops": core["topk_ops"],
+        "raw_bytes": raw,
+        "wire_bytes": wire,
+        "bytes_saved": raw - wire,
+        "compression_ratio": (raw / wire) if wire > 0 else 0.0,
+        "residual_norm": core["residual_norm"],
+        "residual_buckets": core["residual_buckets"],
+        "wire_cast_engaged": casts["engaged"],
+        "wire_cast_fallback": casts["fallback"],
+        "engagements": core["int8_ops"] + core["topk_ops"] + casts["engaged"],
+    }
 
 
 def mpi_built():

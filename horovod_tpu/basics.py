@@ -184,6 +184,13 @@ _lib.hvd_bucket_stats.argtypes = [P_int64, P_int64, P_int64, P_int64,
                                   P_int64, P_int64]
 _lib.hvd_bucket_state.restype = c_int
 _lib.hvd_bucket_state.argtypes = [P_int64]
+_lib.hvd_compress_stats.restype = c_int
+_lib.hvd_compress_stats.argtypes = [P_int64, P_int64, P_int64, P_int64,
+                                    P_int64, P_int64]
+_lib.hvd_compress_state.restype = c_int
+_lib.hvd_compress_state.argtypes = [P_int64, ctypes.POINTER(c_double)]
+_lib.hvd_set_compress.restype = c_int
+_lib.hvd_set_compress.argtypes = [c_int, c_double]
 _lib.hvd_reduce_pool_stats.restype = c_int
 _lib.hvd_reduce_pool_stats.argtypes = [P_int64, P_int64, P_int64]
 _lib.hvd_reduce_bench.restype = c_double
@@ -461,6 +468,82 @@ class HorovodBasics:
         if rc < 0:
             raise ValueError("horovod_tpu has not been initialized")
         return bool(rc), nbytes.value
+
+    def compress_stats(self):
+        """Compressed-collective counters as a dict: ``int8_ops`` /
+        ``topk_ops`` allreduces executed by each lossy codec
+        (HVD_COMPRESS / set_compression / the autotune `compress` arm),
+        ``raw_bytes`` the per-rank payload an uncompressed f32 ring would
+        have moved for those ops vs ``wire_bytes`` actually sent (ratio =
+        raw/wire), ``residual_norm`` the L2 norm of the last op's
+        error-feedback residual, and ``residual_buckets`` tracked. All
+        zeros with compression off — the kill-switch proof the acceptance
+        tests pin."""
+        int8_ops = c_int64(0)
+        topk_ops = c_int64(0)
+        raw = c_int64(0)
+        wire = c_int64(0)
+        norm_micro = c_int64(0)
+        buckets = c_int64(0)
+        rc = _lib.hvd_compress_stats(
+            ctypes.byref(int8_ops), ctypes.byref(topk_ops),
+            ctypes.byref(raw), ctypes.byref(wire),
+            ctypes.byref(norm_micro), ctypes.byref(buckets))
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        return {
+            "int8_ops": int8_ops.value,
+            "topk_ops": topk_ops.value,
+            "raw_bytes": raw.value,
+            "wire_bytes": wire.value,
+            "residual_norm": norm_micro.value / 1e6,
+            "residual_buckets": buckets.value,
+        }
+
+    def compress_state(self):
+        """(live_codec, configured_codec, topk_frac): the codec Enqueue
+        stamps onto new allreduces right now ("int8" / "topk" / None — the
+        autotune `compress` arm may have toggled it off), the configured
+        codec (HVD_COMPRESS / set_compression), and the top-k keep
+        fraction."""
+        configured = c_int64(0)
+        frac = c_double(0.0)
+        rc = _lib.hvd_compress_state(ctypes.byref(configured),
+                                     ctypes.byref(frac))
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        names = {0: None, 1: "int8", 2: "topk"}
+        return names.get(rc), names.get(configured.value), frac.value
+
+    def set_compression(self, compression, topk_frac=None):
+        """Select the lossy wire codec at runtime. ``compression`` may be
+        None/"0" (off), "int8", "topk", or a Compression.int8 /
+        Compression.topk(frac) compressor (routed via
+        compression.core_codec). EVERY rank must make the same call for
+        the codec to engage — the coordinator falls back to uncompressed
+        on any disagreement, so a partial rollout is safe but inert."""
+        if compression is None or compression == 0 or compression == "0":
+            codec, frac = 0, 0.0
+        elif compression == "int8":
+            codec, frac = 1, 0.0
+        elif compression == "topk":
+            codec, frac = 2, 0.0
+        else:
+            from . import compression as _compression
+            codec, frac = _compression.core_codec(compression)
+            if codec == 0 and compression is not None:
+                raise ValueError(
+                    "no core wire codec for %r; use 'int8', 'topk', "
+                    "Compression.int8, or Compression.topk(frac)"
+                    % (compression,))
+        if topk_frac is not None:
+            frac = float(topk_frac)
+        rc = _lib.hvd_set_compress(codec, frac)
+        if rc == -1:
+            raise ValueError("horovod_tpu has not been initialized")
+        if rc < 0:
+            raise ValueError("invalid compression codec %r" % (compression,))
+        return rc
 
     def reduce_pool_stats(self):
         """(threads, jobs, spans): configured reduce-pool lanes

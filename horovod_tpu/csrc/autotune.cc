@@ -38,12 +38,14 @@ void ParameterManager::Configure(bool enabled, const std::string& log_path,
                                  int64_t max_samples, bool init_cache,
                                  bool init_hier, bool init_zerocopy,
                                  bool init_pipeline, bool init_shm,
-                                 bool init_bucket, bool can_toggle_cache,
+                                 bool init_bucket, bool init_compress,
+                                 bool can_toggle_cache,
                                  bool can_toggle_hier,
                                  bool can_toggle_zerocopy,
                                  bool can_toggle_pipeline,
                                  bool can_toggle_shm,
-                                 bool can_toggle_bucket) {
+                                 bool can_toggle_bucket,
+                                 bool can_toggle_compress) {
   enabled_ = enabled;
   if (!enabled_) return;
   cycles_per_sample_ = cycles_per_sample;
@@ -63,27 +65,33 @@ void ParameterManager::Configure(bool enabled, const std::string& log_path,
         for (int pl = 0; pl < (can_toggle_pipeline ? 2 : 1); pl++) {
           for (int sh = 0; sh < (can_toggle_shm ? 2 : 1); sh++) {
             for (int bk = 0; bk < (can_toggle_bucket ? 2 : 1); bk++) {
-              arm_cache_[n] = can_toggle_cache
-                                  ? (c == 0 ? init_cache : !init_cache)
-                                  : init_cache;
-              arm_hier_[n] = can_toggle_hier
-                                 ? (h == 0 ? init_hier : !init_hier)
-                                 : init_hier;
-              arm_zerocopy_[n] =
-                  can_toggle_zerocopy
-                      ? (z == 0 ? init_zerocopy : !init_zerocopy)
-                      : init_zerocopy;
-              arm_pipeline_[n] =
-                  can_toggle_pipeline
-                      ? (pl == 0 ? init_pipeline : !init_pipeline)
-                      : init_pipeline;
-              arm_shm_[n] = can_toggle_shm
-                                ? (sh == 0 ? init_shm : !init_shm)
-                                : init_shm;
-              arm_bucket_[n] = can_toggle_bucket
-                                   ? (bk == 0 ? init_bucket : !init_bucket)
-                                   : init_bucket;
-              n++;
+              for (int cp = 0; cp < (can_toggle_compress ? 2 : 1); cp++) {
+                arm_cache_[n] = can_toggle_cache
+                                    ? (c == 0 ? init_cache : !init_cache)
+                                    : init_cache;
+                arm_hier_[n] = can_toggle_hier
+                                   ? (h == 0 ? init_hier : !init_hier)
+                                   : init_hier;
+                arm_zerocopy_[n] =
+                    can_toggle_zerocopy
+                        ? (z == 0 ? init_zerocopy : !init_zerocopy)
+                        : init_zerocopy;
+                arm_pipeline_[n] =
+                    can_toggle_pipeline
+                        ? (pl == 0 ? init_pipeline : !init_pipeline)
+                        : init_pipeline;
+                arm_shm_[n] = can_toggle_shm
+                                  ? (sh == 0 ? init_shm : !init_shm)
+                                  : init_shm;
+                arm_bucket_[n] = can_toggle_bucket
+                                     ? (bk == 0 ? init_bucket : !init_bucket)
+                                     : init_bucket;
+                arm_compress_[n] =
+                    can_toggle_compress
+                        ? (cp == 0 ? init_compress : !init_compress)
+                        : init_compress;
+                n++;
+              }
             }
           }
         }
@@ -97,6 +105,7 @@ void ParameterManager::Configure(bool enabled, const std::string& log_path,
   cur_pipeline_ = init_pipeline;
   cur_shm_ = init_shm;
   cur_bucket_ = init_bucket;
+  cur_compress_ = init_compress;
   // With fewer than arms+warmup samples budgeted (or nothing to sweep),
   // skip the arm phase and tune numerics only under the initial config.
   if (arm_count_ < 2 || max_samples_ < arm_count_ + 3) arm_idx_ = arm_count_;
@@ -106,7 +115,7 @@ void ParameterManager::Configure(bool enabled, const std::string& log_path,
       fprintf(
           log_,
           "sample,fusion_kb,cycle_ms,cache,hier,zerocopy,pipeline,shm,"
-          "bucket,score_mbps\n");
+          "bucket,compress,score_mbps\n");
   }
   // First sample point = warmup[0]; adopted on the first Record proposal.
   memcpy(cur_x_, kWarmup[0], sizeof(cur_x_));
@@ -214,7 +223,7 @@ void ParameterManager::Propose(double out[2]) {
 bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
                               double* cycle_ms, int* cache_on, int* hier_on,
                               int* zerocopy_on, int* pipeline_on,
-                              int* shm_on, int* bucket_on) {
+                              int* shm_on, int* bucket_on, int* compress_on) {
   if (!active()) return false;
   if (bytes <= 0 && acc_cycles_ == 0) {
     // Idle before the window opens: keep re-stamping the start so a pause
@@ -234,6 +243,7 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
     *pipeline_on = cur_pipeline_ ? 1 : 0;
     *shm_on = cur_shm_ ? 1 : 0;
     *bucket_on = cur_bucket_ ? 1 : 0;
+    *compress_on = cur_compress_ ? 1 : 0;
     warmup_idx_ = 1;
     return true;
   }
@@ -252,10 +262,11 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
     int64_t f;
     double c;
     ToParams(cur_x_, &f, &c);
-    fprintf(log_, "%lld,%.1f,%.3f,%d,%d,%d,%d,%d,%d,%.3f\n",
+    fprintf(log_, "%lld,%.1f,%.3f,%d,%d,%d,%d,%d,%d,%d,%.3f\n",
             (long long)n_samples_, f / 1024.0, c, cur_cache_ ? 1 : 0,
             cur_hier_ ? 1 : 0, cur_zerocopy_ ? 1 : 0, cur_pipeline_ ? 1 : 0,
-            cur_shm_ ? 1 : 0, cur_bucket_ ? 1 : 0, score / 1e6);
+            cur_shm_ ? 1 : 0, cur_bucket_ ? 1 : 0, cur_compress_ ? 1 : 0,
+            score / 1e6);
     fflush(log_);
   }
   if (score > best_score_) {
@@ -280,6 +291,7 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
       cur_pipeline_ = arm_pipeline_[arm_idx_];
       cur_shm_ = arm_shm_[arm_idx_];
       cur_bucket_ = arm_bucket_[arm_idx_];
+      cur_compress_ = arm_compress_[arm_idx_];
     } else {
       best_arm_ = 0;
       for (int i = 1; i < arm_count_; i++)
@@ -290,6 +302,7 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
       cur_pipeline_ = arm_pipeline_[best_arm_];
       cur_shm_ = arm_shm_[best_arm_];
       cur_bucket_ = arm_bucket_[best_arm_];
+      cur_compress_ = arm_compress_[best_arm_];
       // Seed the GP with the winning arm's observation at warmup[0]: the
       // numeric phase continues from warmup[1] under the locked arm.
       xs_.push_back({cur_x_[0], cur_x_[1]});
@@ -303,6 +316,7 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
     *pipeline_on = cur_pipeline_ ? 1 : 0;
     *shm_on = cur_shm_ ? 1 : 0;
     *bucket_on = cur_bucket_ ? 1 : 0;
+    *compress_on = cur_compress_ ? 1 : 0;
     return true;
   }
 
@@ -320,11 +334,13 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
     *pipeline_on = cur_pipeline_ ? 1 : 0;
     *shm_on = cur_shm_ ? 1 : 0;
     *bucket_on = cur_bucket_ ? 1 : 0;
+    *compress_on = cur_compress_ ? 1 : 0;
     if (log_) {
-      fprintf(log_, "# final,%.1f,%.3f,%d,%d,%d,%d,%d,%d,%.3f\n",
+      fprintf(log_, "# final,%.1f,%.3f,%d,%d,%d,%d,%d,%d,%d,%.3f\n",
               best_fusion_ / 1024.0, best_cycle_ms_, cur_cache_ ? 1 : 0,
               cur_hier_ ? 1 : 0, cur_zerocopy_ ? 1 : 0, cur_pipeline_ ? 1 : 0,
-              cur_shm_ ? 1 : 0, cur_bucket_ ? 1 : 0, best_score_ / 1e6);
+              cur_shm_ ? 1 : 0, cur_bucket_ ? 1 : 0, cur_compress_ ? 1 : 0,
+              best_score_ / 1e6);
       fflush(log_);
     }
     return true;
@@ -337,6 +353,7 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
   *pipeline_on = cur_pipeline_ ? 1 : 0;
   *shm_on = cur_shm_ ? 1 : 0;
   *bucket_on = cur_bucket_ ? 1 : 0;
+  *compress_on = cur_compress_ ? 1 : 0;
   return true;
 }
 

@@ -197,6 +197,13 @@ struct Request {
   int32_t group_size = 0;    // number of tensors in the group
   double prescale = 1.0;
   double postscale = 1.0;
+  // Lossy wire codec this rank wants for the allreduce (0 = none, 1 = int8
+  // error-feedback ring, 2 = top-k sparsified exchange) and the top-k keep
+  // fraction. Negotiation is self-synchronizing: the coordinator only
+  // stamps a codec onto the Response when EVERY member requested the same
+  // one, so ranks mid-flip simply run one more uncompressed cycle.
+  uint8_t compress = 0;
+  double topk_frac = 0.0;
   std::vector<int64_t> shape;     // this rank's shape
   std::vector<int64_t> splits;    // alltoall send splits (rows per dest rank)
 
@@ -212,6 +219,8 @@ struct Request {
     w.i32(group_size);
     w.f64(prescale);
     w.f64(postscale);
+    w.u8(compress);
+    w.f64(topk_frac);
     w.i64vec(shape);
     w.i64vec(splits);
   }
@@ -228,6 +237,8 @@ struct Request {
     q.group_size = r.i32();
     q.prescale = r.f64();
     q.postscale = r.f64();
+    q.compress = r.u8();
+    q.topk_frac = r.f64();
     q.shape = r.i64vec();
     q.splits = r.i64vec();
     return q;
@@ -287,6 +298,12 @@ struct Response {
   // local decision (e.g. from its own Request) would desynchronize cache
   // bit positions between owners and joined ranks.
   uint8_t grouped = 0;
+  // Negotiated lossy wire codec (0 none, 1 int8 error-feedback ring, 2
+  // top-k sparsified exchange). Set only when every member's Request asked
+  // for the same codec + fraction; carried on the wire so all replicas
+  // pick the same execution backend for the same fused entry.
+  uint8_t compress = 0;
+  double topk_frac = 0.0;
 
   void serialize(Writer& w) const {
     w.u8((uint8_t)op_type);
@@ -298,6 +315,8 @@ struct Response {
     w.i32(process_set);
     w.f64(prescale);
     w.f64(postscale);
+    w.u8(compress);
+    w.f64(topk_frac);
     w.str(error);
     w.u32((uint32_t)per_rank_meta.size());
     for (auto& v : per_rank_meta) w.i64vec(v);
@@ -318,6 +337,8 @@ struct Response {
     s.process_set = r.i32();
     s.prescale = r.f64();
     s.postscale = r.f64();
+    s.compress = r.u8();
+    s.topk_frac = r.f64();
     s.error = r.str();
     uint32_t m = r.u32();
     s.per_rank_meta.resize(m);
@@ -359,6 +380,7 @@ struct ResponseList {
   int8_t tuned_pipeline = -1;  // ring-pipeline (streamed reduce) toggle
   int8_t tuned_shm = -1;       // intra-host shared-memory plane toggle
   int8_t tuned_bucket = -1;    // backprop-ordered gradient bucketing toggle
+  int8_t tuned_compress = -1;  // lossy compressed-collective codec toggle
   bool tuned_locked = false;  // coordinator's search finished
   // Rank the coordinator evicted this cycle (-1 = none). Survivors abort
   // in-flight work with a retriable RankEvictedError instead of hanging in
@@ -380,6 +402,7 @@ struct ResponseList {
     w.u8((uint8_t)(tuned_pipeline + 1));
     w.u8((uint8_t)(tuned_shm + 1));
     w.u8((uint8_t)(tuned_bucket + 1));
+    w.u8((uint8_t)(tuned_compress + 1));
     w.u8(tuned_locked ? 1 : 0);
     w.i32(evicted_rank);
   }
@@ -401,6 +424,7 @@ struct ResponseList {
     l.tuned_pipeline = (int8_t)r.u8() - 1;
     l.tuned_shm = (int8_t)r.u8() - 1;
     l.tuned_bucket = (int8_t)r.u8() - 1;
+    l.tuned_compress = (int8_t)r.u8() - 1;
     l.tuned_locked = r.u8() != 0;
     l.evicted_rank = r.i32();
     return l;

@@ -114,6 +114,20 @@ Response Coordinator::BuildResponse(const std::string& name,
   resp.prescale = first.prescale;
   resp.postscale = first.postscale;
   resp.grouped = first.group_id >= 0 ? 1 : 0;
+  // Lossy codec negotiation: compress only when EVERY member asked for the
+  // same codec + fraction. A mismatch is not an error — ranks caught
+  // mid-flip (autotune arm switch, runtime set_compression) just run this
+  // entry uncompressed and converge next cycle.
+  resp.compress = first.compress;
+  resp.topk_frac = first.topk_frac;
+  for (auto& kv : per_rank) {
+    const Request& q = kv.second;
+    if (q.compress != first.compress || q.topk_frac != first.topk_frac) {
+      resp.compress = 0;
+      resp.topk_frac = 0.0;
+      break;
+    }
+  }
 
   auto error = [&](const std::string& msg) {
     resp.error = msg;
@@ -257,7 +271,8 @@ void FuseResponses(std::vector<Response>& ready, int64_t threshold,
       if (n.op_type != OpType::kAllreduce || !n.error.empty() ||
           n.dtype != r.dtype || n.red_op != r.red_op ||
           n.process_set != r.process_set || n.prescale != r.prescale ||
-          n.postscale != r.postscale)
+          n.postscale != r.postscale || n.compress != r.compress ||
+          n.topk_frac != r.topk_frac)
         break;
       int64_t nbytes = NumElements(n.shapes[0]) * esz;
       if (bytes + nbytes > threshold) break;

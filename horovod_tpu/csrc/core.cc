@@ -12,7 +12,9 @@
 // this core carries the out-of-graph path, gradient negotiation for the
 // eager/hook APIs, and all coordination subsystems (fusion, timeline, stall
 // inspection, process sets, elastic error propagation).
+#include <algorithm>
 #include <atomic>
+#include <cmath>
 #include <condition_variable>
 #include <cstdlib>
 #include <cstring>
@@ -188,6 +190,36 @@ struct Global {
   // it, keeping steady-state cache behavior unchanged for unbucketed jobs.
   bool bucket_allowed = true;
 
+  // Compressed collectives (int8 error-feedback ring + top-k sparsified
+  // exchange; docs/perf_tuning.md "Compressed collectives").
+  // compress_cfg is the configured codec (HVD_COMPRESS / hvd_set_compress:
+  // 0 off, 1 int8, 2 topk); compress_live is the codec Enqueue stamps onto
+  // new allreduce requests RIGHT NOW — the autotune compress arm flips it
+  // between 0 and compress_cfg. Atomics because Enqueue stamps from
+  // frontend threads while the background thread adopts tuned_compress;
+  // relaxed is enough — the negotiation is self-synchronizing (the
+  // coordinator only compresses an entry when EVERY member stamped the
+  // same codec, so ranks caught mid-flip just run one uncompressed cycle).
+  std::atomic<int> compress_cfg{0};
+  std::atomic<int> compress_live{0};
+  std::atomic<bool> compress_allowed{false};
+  std::atomic<int64_t> topk_frac_micro{10000};  // 0.01 in 1e-6 units
+  // Per-bucket error-feedback residuals, keyed by (process set, fused name
+  // list, element count) — the bucket assembler gives gradients a stable
+  // identity, so the same key recurs every step. Background thread only.
+  std::map<std::string, std::vector<float>> compress_residuals;
+  // Counters, readable from user threads via hvd_compress_stats (relaxed:
+  // counts, not sync points). raw/wire bytes are the per-rank payload an
+  // uncompressed ring would have sent vs what the codec actually sent, so
+  // wire ratio = raw/wire. residual_norm is the L2 norm of the last op's
+  // residual in 1e-6 units (atomic-int encoding of a gauge).
+  std::atomic<int64_t> compress_int8_ops{0};
+  std::atomic<int64_t> compress_topk_ops{0};
+  std::atomic<int64_t> compress_raw_bytes{0};
+  std::atomic<int64_t> compress_wire_bytes{0};
+  std::atomic<int64_t> compress_residual_norm_micro{0};
+  std::atomic<int64_t> compress_residual_buckets{0};
+
   // Elastic churn: per-peer liveness on the control plane. peer_timeout_ms
   // (HVD_PEER_TIMEOUT_MS) bounds rank 0's per-cycle RequestList gather;
   // 0 (the default) keeps the legacy unbounded gather — byte-identical
@@ -315,6 +347,215 @@ void HierarchicalKernel(void* buf, int64_t n, const Response& resp,
 void RingKernel(void* buf, int64_t n, const Response& resp,
                 const std::vector<int32_t>& members) {
   g->data.RingAllreduce(buf, n, resp.dtype, RingOpOf(resp), members);
+}
+
+// ---------------------------------------------------------------------------
+// Compressed collectives (ROADMAP item 1). Both codecs reduce in f32 and
+// carry this rank's quantization / sparsification error in a per-bucket
+// residual added back into the next step's payload (EF-SGD style error
+// feedback: the error is deferred, never lost, so the multi-step sum
+// tracks the uncompressed reference). Both codecs produce bit-identical
+// outputs on every member — each final value is decoded from the same
+// wire bytes everywhere, the encoding rank included.
+
+std::string ResidualKey(const Response& resp, int64_t n) {
+  std::string k = std::to_string(resp.process_set);
+  for (auto& nm : resp.names) {
+    k += '|';
+    k += nm;
+  }
+  k += '#';
+  k += std::to_string(n);
+  return k;
+}
+
+std::vector<float>& ResidualFor(const Response& resp, int64_t n) {
+  auto& r = g->compress_residuals[ResidualKey(resp, n)];
+  // A changed element count under the same names means a different fusion
+  // geometry — stale feedback would be misaligned, so start fresh.
+  if ((int64_t)r.size() != n) r.assign((size_t)n, 0.0f);
+  g->compress_residual_buckets = (int64_t)g->compress_residuals.size();
+  return r;
+}
+
+void PublishResidualNorm(const std::vector<float>& r) {
+  double ss = 0.0;
+  for (float v : r) ss += (double)v * v;
+  g->compress_residual_norm_micro = (int64_t)llround(sqrt(ss) * 1e6);
+}
+
+// Symmetric per-chunk int8: scale = maxabs/127, round-to-nearest. Every
+// element's encode error is accumulated into `res` (the encoding rank's
+// residual) so it re-enters the sum next step.
+float QuantizeI8(const float* x, int64_t n, int8_t* q, float* res) {
+  float maxabs = 0.0f;
+  for (int64_t i = 0; i < n; i++) maxabs = std::max(maxabs, fabsf(x[i]));
+  float scale = maxabs > 0.0f ? maxabs / 127.0f : 1.0f;
+  float inv = 1.0f / scale;
+  for (int64_t i = 0; i < n; i++) {
+    long v = lrintf(x[i] * inv);
+    if (v > 127) v = 127;
+    if (v < -127) v = -127;
+    q[i] = (int8_t)v;
+    res[i] += x[i] - scale * (float)v;
+  }
+  return scale;
+}
+
+// int8 error-feedback ring: the two-phase ring allreduce with every hop's
+// payload quantized to int8 plus one f32 scale per chunk — ~1/4 the wire
+// bytes of the f32 ring. Reduction stays f32 (receivers dequantize and
+// accumulate at full precision), so only the wire is lossy, and each
+// lossy encode feeds its error back into the encoder's residual. In the
+// allgather phase the reduced chunk is quantized ONCE by its owner and
+// the encoded bytes circulate unmodified; every rank (owner included)
+// adopts the decode of those same bytes.
+void Int8RingKernel(void* buf, int64_t n, const Response& resp,
+                    const std::vector<int32_t>& members) {
+  int m = (int)members.size();
+  float* x = (float*)buf;
+  auto& res = ResidualFor(resp, n);
+  int64_t t0 = NowUs();
+  for (int64_t i = 0; i < n; i++) {
+    x[i] += res[i];
+    res[i] = 0.0f;
+  }
+  int64_t t1 = NowUs();
+
+  int my_idx = -1;
+  for (int i = 0; i < m; i++)
+    if (members[i] == g->rank) my_idx = i;
+  Socket& right = g->data.peer(members[(my_idx + 1) % m]);
+  Socket& left = g->data.peer(members[(my_idx - 1 + m) % m]);
+
+  std::vector<int64_t> off(m), cnt(m);
+  int64_t base = n / m, rem = n % m, o = 0;
+  for (int i = 0; i < m; i++) {
+    cnt[i] = base + (i < rem ? 1 : 0);
+    off[i] = o;
+    o += cnt[i];
+  }
+  int64_t maxc = base + (rem ? 1 : 0);
+  std::vector<uint8_t> sbuf(sizeof(float) + (size_t)maxc);
+  std::vector<uint8_t> rbuf(sizeof(float) + (size_t)maxc);
+  int64_t wire = 0, raw = 0;
+
+  // Phase 1 — reduce-scatter: send chunk (my-s), receive and f32-
+  // accumulate chunk (my-s-1). Each hop re-quantizes this rank's CURRENT
+  // partial sum for the outgoing chunk.
+  for (int s = 0; s < m - 1; s++) {
+    int sc = (my_idx - s + m) % m;
+    int rc = (my_idx - s - 1 + m) % m;
+    float scale = QuantizeI8(x + off[sc], cnt[sc], (int8_t*)(sbuf.data() + 4),
+                             res.data() + off[sc]);
+    memcpy(sbuf.data(), &scale, 4);
+    g->data.FullDuplex(right, sbuf.data(), 4 + (size_t)cnt[sc], left,
+                       rbuf.data(), 4 + (size_t)cnt[rc]);
+    float rs;
+    memcpy(&rs, rbuf.data(), 4);
+    const int8_t* q = (const int8_t*)(rbuf.data() + 4);
+    float* dst = x + off[rc];
+    for (int64_t i = 0; i < cnt[rc]; i++) dst[i] += rs * (float)q[i];
+    wire += 4 + cnt[sc];
+    raw += 4 * cnt[sc];
+  }
+
+  // Phase 2 — allgather of the reduced chunks. This rank owns chunk
+  // (my+1): quantize it once (error -> residual) and adopt the decode so
+  // the owner's output matches everyone else's bit-for-bit; received
+  // encodings are forwarded verbatim on the next hop.
+  int own = (my_idx + 1) % m;
+  {
+    float scale = QuantizeI8(x + off[own], cnt[own],
+                             (int8_t*)(sbuf.data() + 4),
+                             res.data() + off[own]);
+    memcpy(sbuf.data(), &scale, 4);
+    const int8_t* q = (const int8_t*)(sbuf.data() + 4);
+    float* dst = x + off[own];
+    for (int64_t i = 0; i < cnt[own]; i++) dst[i] = scale * (float)q[i];
+  }
+  for (int s = 0; s < m - 1; s++) {
+    int sc = (own - s + m) % m;
+    int rc = (own - s - 1 + m) % m;
+    g->data.FullDuplex(right, sbuf.data(), 4 + (size_t)cnt[sc], left,
+                       rbuf.data(), 4 + (size_t)cnt[rc]);
+    float rs;
+    memcpy(&rs, rbuf.data(), 4);
+    const int8_t* q = (const int8_t*)(rbuf.data() + 4);
+    float* dst = x + off[rc];
+    for (int64_t i = 0; i < cnt[rc]; i++) dst[i] = rs * (float)q[i];
+    wire += 4 + cnt[sc];
+    raw += 4 * cnt[sc];
+    sbuf.swap(rbuf);
+  }
+  int64_t t2 = NowUs();
+
+  // Counters before CompleteHandle (ExecAllreduce completes after the
+  // kernel returns), same rule as the zerocopy/staging counters.
+  PublishResidualNorm(res);
+  g->compress_int8_ops++;
+  g->compress_raw_bytes += raw;
+  g->compress_wire_bytes += wire;
+  g->timeline.Record(resp.names[0], "TCP_COMPRESS_QUANTIZE", t0, t1);
+  g->timeline.Record(resp.names[0], "TCP_COMPRESS_EXCHANGE", t1, t2);
+}
+
+// top-k sparsified exchange: each rank keeps its k largest-magnitude
+// elements (k = max(1, round(frac*n)), uniform across ranks because the
+// fraction rides the negotiated Response), ships them as (u32 index,
+// f32 value) pairs through the ring allgather, and every rank densifies
+// the m sparse contributions in member order — sent values are exact f32,
+// so outputs are bit-identical, and everything NOT sent becomes this
+// rank's residual.
+void TopKKernel(void* buf, int64_t n, const Response& resp,
+                const std::vector<int32_t>& members) {
+  int m = (int)members.size();
+  float* x = (float*)buf;
+  auto& res = ResidualFor(resp, n);
+  int64_t t0 = NowUs();
+  for (int64_t i = 0; i < n; i++) x[i] += res[i];
+  int64_t k = (int64_t)llround(resp.topk_frac * (double)n);
+  if (k < 1) k = 1;
+  if (k > n) k = n;
+  std::vector<int32_t> idx((size_t)n);
+  for (int64_t i = 0; i < n; i++) idx[(size_t)i] = (int32_t)i;
+  std::nth_element(
+      idx.begin(), idx.begin() + (k - 1), idx.end(),
+      [&](int32_t a, int32_t b) { return fabsf(x[a]) > fabsf(x[b]); });
+  std::vector<uint8_t> mine((size_t)(8 * k));
+  for (int64_t i = 0; i < n; i++) res[(size_t)i] = x[i];
+  for (int64_t j = 0; j < k; j++) {
+    uint32_t id = (uint32_t)idx[(size_t)j];
+    float v = x[id];
+    memcpy(mine.data() + 8 * j, &id, 4);
+    memcpy(mine.data() + 8 * j + 4, &v, 4);
+    res[id] = 0.0f;  // sent exactly -> no deferred error for this element
+  }
+  int64_t t1 = NowUs();
+  std::vector<uint8_t> all((size_t)(8 * k) * (size_t)m);
+  std::vector<int64_t> bpm(m, 8 * k);
+  g->data.RingAllgatherv(mine.data(), all.data(), bpm, members);
+  int64_t t2 = NowUs();
+  memset(x, 0, (size_t)n * sizeof(float));
+  for (int mi = 0; mi < m; mi++) {
+    const uint8_t* p = all.data() + (size_t)(8 * k) * mi;
+    for (int64_t j = 0; j < k; j++) {
+      uint32_t id;
+      float v;
+      memcpy(&id, p + 8 * j, 4);
+      memcpy(&v, p + 8 * j + 4, 4);
+      if (id < (uint32_t)n) x[id] += v;
+    }
+  }
+  int64_t t3 = NowUs();
+
+  PublishResidualNorm(res);
+  g->compress_topk_ops++;
+  g->compress_raw_bytes += 8 * n * (int64_t)(m - 1) / m;
+  g->compress_wire_bytes += 8 * k * (int64_t)(m - 1);
+  g->timeline.Record(resp.names[0], "TCP_COMPRESS_SELECT", t0, t1);
+  g->timeline.Record(resp.names[0], "TCP_COMPRESS_EXCHANGE", t1, t2);
+  g->timeline.Record(resp.names[0], "TCP_COMPRESS_DENSIFY", t2, t3);
 }
 
 // The scatter-gather path only applies to the plain ring (adasum and the
@@ -646,6 +887,34 @@ void RegisterBackends(OperationManager& om) {
          const std::vector<int32_t>& m) {
         ExecAllreduce(r, e, m, AdasumKernel, /*sg_ok=*/false);
       });
+  // Compressed codecs outrank the hierarchical/ring backends: a Response
+  // carries compress != 0 only when every member negotiated it, so the
+  // same replica picks the same codec everywhere. sg_ok=false — the wire
+  // format is not the user buffer, so scatter-gather cannot apply.
+  om.Register(
+      OpType::kAllreduce, "int8_ring_allreduce",
+      [](const Response& r, const std::vector<int32_t>& m) {
+        return r.compress == 1 && m.size() > 1 &&
+               r.dtype == DataType::kFloat32 &&
+               (r.red_op == ReduceOp::kSum ||
+                r.red_op == ReduceOp::kAverage);
+      },
+      [](const Response& r, std::vector<TensorTableEntry>& e,
+         const std::vector<int32_t>& m) {
+        ExecAllreduce(r, e, m, Int8RingKernel, /*sg_ok=*/false);
+      });
+  om.Register(
+      OpType::kAllreduce, "topk_allreduce",
+      [](const Response& r, const std::vector<int32_t>& m) {
+        return r.compress == 2 && r.topk_frac > 0.0 && m.size() > 1 &&
+               r.dtype == DataType::kFloat32 &&
+               (r.red_op == ReduceOp::kSum ||
+                r.red_op == ReduceOp::kAverage);
+      },
+      [](const Response& r, std::vector<TensorTableEntry>& e,
+         const std::vector<int32_t>& m) {
+        ExecAllreduce(r, e, m, TopKKernel, /*sg_ok=*/false);
+      });
   om.Register(
       OpType::kAllreduce, "hierarchical_allreduce",
       [](const Response&, const std::vector<int32_t>& m) {
@@ -865,10 +1134,11 @@ void AutotuneCycle(ResponseList& rl) {
   if (g->autotune.active()) {
     int64_t fusion;
     double cycle_ms;
-    int cache_on, hier_on, zerocopy_on, pipeline_on, shm_on, bucket_on;
+    int cache_on, hier_on, zerocopy_on, pipeline_on, shm_on, bucket_on,
+        compress_on;
     if (g->autotune.Record(PayloadBytes(rl), NowUs(), &fusion, &cycle_ms,
                            &cache_on, &hier_on, &zerocopy_on, &pipeline_on,
-                           &shm_on, &bucket_on)) {
+                           &shm_on, &bucket_on, &compress_on)) {
       rl.tuned_fusion = fusion;
       rl.tuned_cycle_ms = cycle_ms;
       rl.tuned_cache = (int8_t)cache_on;
@@ -877,6 +1147,7 @@ void AutotuneCycle(ResponseList& rl) {
       rl.tuned_pipeline = (int8_t)pipeline_on;
       rl.tuned_shm = (int8_t)shm_on;
       rl.tuned_bucket = (int8_t)bucket_on;
+      rl.tuned_compress = (int8_t)compress_on;
     }
   }
   rl.tuned_locked = !g->autotune.active();
@@ -916,6 +1187,12 @@ void ProcessResponseList(ResponseList& rl) {
   // pending_, so no request is stranded across the flip.
   if (rl.tuned_bucket >= 0 && g->bucket_allowed)
     g->queue.SetBucketEnabled(rl.tuned_bucket != 0, NowUs());
+  // The compress toggle only changes what Enqueue stamps onto FUTURE
+  // requests; in-flight negotiations self-resolve (the coordinator falls
+  // back to uncompressed on any disagreement), so adoption is stateless.
+  if (rl.tuned_compress >= 0 && g->compress_allowed.load())
+    g->compress_live.store(rl.tuned_compress != 0 ? g->compress_cfg.load()
+                                                  : 0);
   if (rl.tuned_locked && g->autotune.enabled()) g->autotune.SetDone();
   if (CacheOn()) {
     for (uint32_t b : rl.evict_bits) {
@@ -1450,6 +1727,20 @@ int Enqueue(OpType type, const char* name, const void* input, void* output,
   e.req.group_size = group_size;
   e.req.prescale = prescale;
   e.req.postscale = postscale;
+  // Stamp the live lossy codec onto eligible allreduces. Only f32
+  // Sum/Average engages (the codecs reduce in f32 and rely on the
+  // sum-linearity of error feedback); everything else stays byte-
+  // identical to the uncompressed path.
+  int live = g->compress_live.load(std::memory_order_relaxed);
+  if (live != 0 && type == OpType::kAllreduce &&
+      (DataType)dtype == DataType::kFloat32 &&
+      ((ReduceOp)red_op == ReduceOp::kSum ||
+       (ReduceOp)red_op == ReduceOp::kAverage)) {
+    e.req.compress = (uint8_t)live;
+    if (live == 2)
+      e.req.topk_frac =
+          (double)g->topk_frac_micro.load(std::memory_order_relaxed) / 1e6;
+  }
   if (shape && ndim > 0) e.req.shape.assign(shape, shape + ndim);
   if (splits && nsplits > 0) e.req.splits.assign(splits, splits + nsplits);
   e.input = input;
@@ -1543,6 +1834,28 @@ int hvd_init() {
                               EnvInt("HVD_BUCKET_FLUSH_MS", 250) * 1000);
     g->queue.SetBucketEnabled(
         g->bucket_allowed && EnvInt("HVD_BUCKET", -1) == 1, NowUs());
+    // Compressed collectives: HVD_COMPRESS selects the codec ("int8" |
+    // "topk"); unset or 0 is the kill switch — no codec is configured, no
+    // autotune arm exists, and the wire stays byte-identical to the
+    // uncompressed plane. A configured codec is live from the first step
+    // (set_compression() / the autotune compress arm can flip it later).
+    // HVD_COMPRESS_TOPK_FRAC sets the top-k keep fraction (default 1%).
+    {
+      std::string codec = EnvStr("HVD_COMPRESS", "");
+      if (codec == "int8")
+        g->compress_cfg = 1;
+      else if (codec == "topk")
+        g->compress_cfg = 2;
+      else if (!codec.empty() && codec != "0" && codec != "none")
+        LogF(LogLevel::kWarn,
+             "HVD_COMPRESS=%s unknown (want int8|topk|0); compression off",
+             codec.c_str());
+      g->compress_allowed = g->compress_cfg.load() != 0;
+      g->compress_live = g->compress_cfg.load();
+      double frac = EnvDouble("HVD_COMPRESS_TOPK_FRAC", 0.01);
+      if (frac > 0.0 && frac <= 1.0)
+        g->topk_frac_micro = (int64_t)llround(frac * 1e6);
+    }
     // Reduce worker pool: spans of large reductions fan out across
     // HVD_REDUCE_THREADS lanes (default min(4, cores-1); 1 = inline, the
     // pre-pool behavior and the only sane default on a 1-core box).
@@ -1586,6 +1899,7 @@ int hvd_init() {
         /*init_pipeline=*/g->ring_pipeline_cfg != 1,
         /*init_shm=*/g->data.shm_enabled(),
         /*init_bucket=*/g->queue.bucket_enabled(),
+        /*init_compress=*/g->compress_live.load() != 0,
         /*can_toggle_cache=*/g->cache.enabled(),
         // On a single host the hierarchical arm only pays off when the
         // local phase actually rides shm — without the plane it degrades
@@ -1602,7 +1916,12 @@ int hvd_init() {
         /*can_toggle_shm=*/g->shm_allowed && g->data.shm().active(),
         // Bucketing pays off only when a peer exists to overlap comms
         // against; HVD_BUCKET=0 is the operator opting out of the arm.
-        /*can_toggle_bucket=*/g->bucket_allowed && g->size > 1);
+        /*can_toggle_bucket=*/g->bucket_allowed && g->size > 1,
+        // The compress arm exists only when a codec is configured
+        // (HVD_COMPRESS=int8|topk) and a peer exists to move bytes to;
+        // unset/0 keeps the arm out of the sweep AND the wire
+        // byte-identical.
+        /*can_toggle_compress=*/g->compress_allowed.load() && g->size > 1);
     double data_tmo = EnvDouble("HVD_DATA_TIMEOUT_SECONDS", -1.0);
     if (data_tmo <= 0) {
       data_tmo = 300.0;
@@ -2071,6 +2390,62 @@ int hvd_bucket_state(int64_t* bucket_bytes) {
   if (!g || !g->initialized) return -1;
   if (bucket_bytes) *bucket_bytes = g->queue.bucket_bytes();
   return g->bucket_allowed && g->queue.bucket_enabled() ? 1 : 0;
+}
+
+// Compressed-collective observability (docs/perf_tuning.md): ops per
+// codec, the per-rank payload bytes an uncompressed ring would have sent
+// vs what the codec actually sent (ratio = raw/wire), the last op's
+// residual L2 norm in 1e-6 units, and how many residual buckets are
+// tracked. All zeros with compression off — the kill-switch proof.
+int hvd_compress_stats(int64_t* int8_ops, int64_t* topk_ops,
+                       int64_t* raw_bytes, int64_t* wire_bytes,
+                       int64_t* residual_norm_micro,
+                       int64_t* residual_buckets) {
+  if (!g || !g->initialized) return -1;
+  if (int8_ops)
+    *int8_ops = g->compress_int8_ops.load(std::memory_order_relaxed);
+  if (topk_ops)
+    *topk_ops = g->compress_topk_ops.load(std::memory_order_relaxed);
+  if (raw_bytes)
+    *raw_bytes = g->compress_raw_bytes.load(std::memory_order_relaxed);
+  if (wire_bytes)
+    *wire_bytes = g->compress_wire_bytes.load(std::memory_order_relaxed);
+  if (residual_norm_micro)
+    *residual_norm_micro =
+        g->compress_residual_norm_micro.load(std::memory_order_relaxed);
+  if (residual_buckets)
+    *residual_buckets =
+        g->compress_residual_buckets.load(std::memory_order_relaxed);
+  return 0;
+}
+
+// Current codec state: returns -1 uninitialized, else the LIVE codec (0
+// off, 1 int8, 2 topk — the autotune compress arm may differ from the
+// configured codec); *configured gets the HVD_COMPRESS/set_compression
+// codec and *topk_frac the negotiated keep fraction.
+int hvd_compress_state(int64_t* configured, double* topk_frac) {
+  if (!g || !g->initialized) return -1;
+  if (configured) *configured = g->compress_cfg.load();
+  if (topk_frac)
+    *topk_frac = (double)g->topk_frac_micro.load() / 1e6;
+  return g->compress_live.load();
+}
+
+// Runtime codec selection (Compression.int8 / Compression.topk(frac) in
+// the bindings route here). Process-local: EVERY rank must call it with
+// the same arguments for compression to engage — the coordinator falls
+// back to uncompressed on any disagreement, so a partial rollout is safe
+// but inert. codec: 0 off, 1 int8, 2 topk. topk_frac <= 0 keeps the
+// current fraction.
+int hvd_set_compress(int codec, double topk_frac) {
+  if (!g || !g->initialized) return -1;
+  if (codec < 0 || codec > 2) return -2;
+  if (topk_frac > 0.0 && topk_frac <= 1.0)
+    g->topk_frac_micro = (int64_t)llround(topk_frac * 1e6);
+  g->compress_cfg = codec;
+  g->compress_allowed = codec != 0;
+  g->compress_live = codec;
+  return 0;
 }
 
 // Elastic-churn observability: control-plane heartbeat deadline misses

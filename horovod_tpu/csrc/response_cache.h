@@ -134,10 +134,14 @@ class ResponseCache {
   }
 
   static bool SigMatch(const Request& a, const Request& b) {
+    // compress/topk_frac are part of the signature: a runtime codec flip
+    // (set_compression) must invalidate entries cached under the old
+    // codec, or steady-state hits would keep replaying it forever.
     return a.op_type == b.op_type && a.dtype == b.dtype &&
            a.red_op == b.red_op && a.root == b.root &&
            a.process_set == b.process_set && a.prescale == b.prescale &&
-           a.postscale == b.postscale && a.shape == b.shape &&
+           a.postscale == b.postscale && a.compress == b.compress &&
+           a.topk_frac == b.topk_frac && a.shape == b.shape &&
            a.splits == b.splits;
   }
 
@@ -172,6 +176,8 @@ inline Response SubResponse(const Response& r, size_t i) {
   s.prescale = r.prescale;
   s.postscale = r.postscale;
   s.grouped = r.grouped;
+  s.compress = r.compress;
+  s.topk_frac = r.topk_frac;
   if (i < r.shapes.size()) s.shapes = {r.shapes[i]};
   if (i < r.per_rank_meta.size()) s.per_rank_meta = {r.per_rank_meta[i]};
   return s;

@@ -290,6 +290,14 @@ def hvd_allreduce_pytree(tree, op=Average, name=None, process_set=0,
     gradient compression hooks)."""
     name = name or _core._auto_name("jax.grouped", None)
     leaves, treedef = jax.tree.flatten(tree)
+    if compression is not None:
+        # This path runs the compressor's own compress/decompress on the
+        # host — never a bare wire cast — so it counts as a fallback in
+        # hvd.compression_stats() (the bucketed train-step path is the one
+        # that casts).
+        from .. import compression as _compression_mod
+
+        _compression_mod.record_wire_cast(False)
 
     def cb(*arrs):
         arrs = list(arrs)  # leaves bridge zero-copy inside collective_ops

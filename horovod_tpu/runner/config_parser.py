@@ -44,6 +44,8 @@ ARG_TO_ENV = {
     "bucket_bytes": ("HVD_BUCKET_BYTES", lambda v: str(int(v))),
     "bucket_flush_ms": ("HVD_BUCKET_FLUSH_MS", lambda v: str(int(v))),
     "reduce_threads": ("HVD_REDUCE_THREADS", lambda v: str(int(v))),
+    "compression": ("HVD_COMPRESS", str),
+    "topk_frac": ("HVD_COMPRESS_TOPK_FRAC", lambda v: str(float(v))),
     "timeline_filename": ("HVD_TIMELINE", str),
     "timeline_mark_cycles": ("HVD_TIMELINE_MARK_CYCLES",
                              lambda v: "1" if v else "0"),
@@ -74,6 +76,8 @@ _FILE_SECTIONS = {
                "bucket-bytes": "bucket_bytes",
                "bucket-flush-ms": "bucket_flush_ms",
                "reduce-threads": "reduce_threads",
+               "compression": "compression",
+               "topk-frac": "topk_frac",
                "start-timeout": "start_timeout",
                "log-level": "log_level",
                "peer-timeout-ms": "peer_timeout_ms"},

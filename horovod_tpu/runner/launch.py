@@ -85,6 +85,21 @@ def parse_args(argv=None):
                    help="ms an incomplete gradient bucket may hold its "
                         "members before flushing ungrouped "
                         "(HVD_BUCKET_FLUSH_MS, default 250)")
+    p.add_argument("--compression", dest="compression",
+                   choices=["int8", "topk", "0"], default=None,
+                   help="lossy wire codec for f32 Sum/Average allreduces "
+                        "(HVD_COMPRESS): int8 = error-feedback quantized "
+                        "ring (~4x fewer wire bytes), topk = top-k "
+                        "sparsified allgather (see --topk-frac), 0 = off "
+                        "(the default; kill switch — wire byte-identical "
+                        "to builds without the codecs). Setting a codec "
+                        "also enables the autotune `compress` arm")
+    p.add_argument("--topk-frac", dest="topk_frac", type=float,
+                   default=None,
+                   help="fraction of elements top-k compression keeps, in "
+                        "(0, 1] (HVD_COMPRESS_TOPK_FRAC, default 0.01): "
+                        "wire bytes scale with k = max(1, round(frac*n)) "
+                        "per rank; only meaningful with --compression topk")
     p.add_argument("--reduce-threads", dest="reduce_threads", type=int,
                    default=None,
                    help="reduce worker-pool lanes (HVD_REDUCE_THREADS): 1 "

@@ -14,7 +14,8 @@ hvd.init()
 r, s = hvd.rank(), hvd.size()
 
 assert hvd.op_backends(0) == [
-    "adasum_allreduce", "hierarchical_allreduce", "ring_allreduce"]
+    "adasum_allreduce", "int8_ring_allreduce", "topk_allreduce",
+    "hierarchical_allreduce", "ring_allreduce"]
 assert hvd.op_backends(1) == ["ring_allgatherv"]
 assert hvd.op_backends(2) == ["binomial_broadcast"]
 assert hvd.op_backends(3) == ["pairwise_alltoallv"]
