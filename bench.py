@@ -673,25 +673,40 @@ def _bench_hostplane():
     memory system among all ranks, so this is a scaling *signal*, not an
     ICI-peak claim.
 
-    Runs the pod three times (ISSUE 5 + ISSUE 7 acceptance A/Bs):
-    streamed ring reduce-scatter over pure TCP (HVD_SHM=0, pipeline
-    auto), forced-serial pure TCP (=1), and the shared-memory
+    Runs the pod six times (ISSUE 5 + ISSUE 7 + ISSUE 12 acceptance
+    A/Bs): streamed ring reduce-scatter over pure TCP (HVD_SHM=0,
+    pipeline auto), forced-serial pure TCP (=1), the shared-memory
     hierarchical compose (HVD_SHM=1 + HVD_HIERARCHICAL_ALLREDUCE=1 —
-    intra-host pointer handoff through /dev/shm slots). On a 1-core box
+    intra-host pointer handoff through /dev/shm slots), and the wire
+    3-way (HVD_SHM=0 + HVD_WIRE forced to basic / zerocopy / uring over
+    64 MB tensors so the chained-wave path engages) measuring
+    syscalls/op per tier around the timed loop. On a 1-core box
     pipelined vs serial are expected to tie (the overlap has no second
     core to hide work on); shm must still win — it removes the two
     socket copies per exchange, not just overlaps them. The headline
-    value is the shm figure; the record carries both speedups plus the
-    shm counter proofs (bytes moved > 0, staged copies == 0)."""
+    value is the shm figure; the record carries both speedups, the shm
+    counter proofs (bytes moved > 0, staged copies == 0), per-tier
+    {bus bw, syscalls/op, cpu affinity}, and wire_syscall_reduction /
+    wire_bw_ratio — the ISSUE 12 acceptance pair (>= 5x fewer
+    syscalls/op on the batched tier, no bus-bandwidth regression)."""
     import tempfile
 
     from horovod_tpu.runner.local import run_local
 
     np_ = int(os.environ.get("BENCH_HOSTPLANE_RANKS", "8"))
+    # 16 Mi floats = 64 MB for the wire A/B: 8 MB ring chunks keep the
+    # streamed path (and so the uring chained wave) engaged; 5 timed
+    # iters keep the three extra pods inside the sub-deadline.
+    wire_floats = os.environ.get("BENCH_WIRE_FLOATS", str(16 * 1024 * 1024))
+    wire_env = {"HVD_SHM": "0", "_BENCH_HOSTPLANE_FLOATS": wire_floats,
+                "_BENCH_HOSTPLANE_ITERS": "5"}
     modes = (
         ("pipelined", {"HVD_RING_PIPELINE": "0", "HVD_SHM": "0"}),
         ("serial", {"HVD_RING_PIPELINE": "1", "HVD_SHM": "0"}),
         ("shm", {"HVD_SHM": "1", "HVD_HIERARCHICAL_ALLREDUCE": "1"}),
+        ("wire_basic", dict(wire_env, HVD_WIRE="basic")),
+        ("wire_zerocopy", dict(wire_env, HVD_WIRE="zerocopy")),
+        ("wire_uring", dict(wire_env, HVD_WIRE="uring")),
     )
     runs = {}
     for mode, mode_env in modes:
@@ -707,7 +722,7 @@ def _bench_hostplane():
             env.update(mode_env)
             codes = run_local(np_,
                               [sys.executable, os.path.abspath(__file__)],
-                              env=env, timeout=90)
+                              env=env, timeout=150)
             if codes != [0] * np_:
                 raise RuntimeError(f"hostplane ranks exited {codes}")
             with open(out_path) as f:
@@ -730,6 +745,26 @@ def _bench_hostplane():
     # plane with zero staging copies; the TCP runs never touched it.
     assert d.get("shm_bytes", 0) > 0 and d.get("shm_staged") == 0, d
     assert flat.get("shm_bytes", 0) == 0, flat
+    # ISSUE 12: the wire 3-way A/B. Tiers are runtime-probed — on a
+    # kernel without io_uring the "uring" pod degrades to a lower live
+    # tier, in which case the reduction is reported as None, not a lie.
+    d["wire"] = {m[len("wire_"):]: {
+        "tier": runs[m].get("wire_tier"),
+        "bus_gbps": runs[m]["value"],
+        "syscalls_per_op": runs[m].get("wire_syscalls_per_op"),
+        "cpu_affinity": runs[m].get("reduce_affinity"),
+    } for m in ("wire_basic", "wire_zerocopy", "wire_uring")}
+    wb, wu = d["wire"]["basic"], d["wire"]["uring"]
+    batched_live = wu["tier"] == "uring" and wu["syscalls_per_op"]
+    d["wire_syscall_reduction"] = (
+        round(wb["syscalls_per_op"] / wu["syscalls_per_op"], 2)
+        if batched_live else None)
+    d["wire_bw_ratio"] = (round(wu["bus_gbps"] / wb["bus_gbps"], 3)
+                          if batched_live and wb["bus_gbps"] > 0 else None)
+    # The kill switch leaves the legacy baseline's per-op syscall count
+    # alone: a basic-tier exchange is still poll + sendmsg + recv shaped,
+    # never fewer than 3 syscalls per duplex op.
+    assert wb["tier"] == "basic" and wb["syscalls_per_op"] >= 3, wb
     return d
 
 
@@ -755,6 +790,7 @@ def _hostplane_worker():
     hvd.barrier()
     iters = int(os.environ.get("_BENCH_HOSTPLANE_ITERS", "10"))
     steps0, _, serial0, us0 = hvd.pipeline_stats()
+    wire_before = hvd.wire_stats()
     t0 = time.perf_counter()
     for _ in range(iters):
         hvd.allreduce(x, op=hvd.Sum, name="hostplane.bw")
@@ -762,6 +798,10 @@ def _hostplane_worker():
     steps1, _, serial1, us1 = hvd.pipeline_stats()
     shm_ops, shm_bytes, _, shm_staged = hvd.shm_stats()
     pool_threads, pool_jobs, _ = hvd.reduce_pool_stats()
+    wire_live = hvd.wire_state()[0]
+    wire_after = hvd.wire_stats()
+    wire_ops = wire_after["ops"] - wire_before["ops"]
+    wire_sys = wire_after["syscalls"] - wire_before["syscalls"]
     if r == 0:
         alg = x.nbytes * iters / dt / 1e9
         bus = alg * 2.0 * (s - 1) / s
@@ -788,6 +828,11 @@ def _hostplane_worker():
                        "overlap_ms": round((us1 - us0) / 1e3, 1),
                        "shm_ops": shm_ops, "shm_bytes": shm_bytes,
                        "shm_staged": shm_staged,
+                       "wire_tier": wire_live,
+                       "wire_ops": wire_ops,
+                       "wire_syscalls": wire_sys,
+                       "wire_syscalls_per_op":
+                           round(wire_sys / max(1, wire_ops), 2),
                        "vs_baseline": 1.0}, f)
     hvd.barrier()
     hvd.shutdown()
@@ -1587,7 +1632,7 @@ _CONFIG_CAPS = {
     "allreduce": 165,
     "longctx": 135,
     # Two pods now (pipelined-vs-serial A/B), each well under 45 s.
-    "hostplane": 90,
+    "hostplane": 240,
     # Two pods (HVD_BUCKET on/off), 10 simulated-backward steps each.
     "bucket": 90,
     # Four pods ({off, bf16, int8, topk}), 18 steady-state steps each.

@@ -154,6 +154,8 @@ bucket_state = _basics.bucket_state
 compress_stats = _basics.compress_stats
 compress_state = _basics.compress_state
 set_compression = _basics.set_compression
+wire_stats = _basics.wire_stats
+wire_state = _basics.wire_state
 reduce_pool_stats = _basics.reduce_pool_stats
 hier_stats = _basics.hier_stats
 elastic_stats = _basics.elastic_stats

@@ -207,6 +207,11 @@ _lib.hvd_lockdep_report.restype = c_int
 _lib.hvd_lockdep_report.argtypes = [ctypes.c_char_p, c_int]
 _lib.hvd_lockdep_selftest.restype = c_int64
 _lib.hvd_lockdep_selftest.argtypes = []
+_lib.hvd_wire_stats.restype = c_int
+_lib.hvd_wire_stats.argtypes = [P_int64, P_int64, P_int64, P_int64, P_int64,
+                                P_int64, P_int64, P_int64, P_int64, P_int64]
+_lib.hvd_wire_state.restype = c_int
+_lib.hvd_wire_state.argtypes = [P_int64, P_int64, P_int64, P_int64]
 
 
 def last_error():
@@ -544,6 +549,48 @@ class HorovodBasics:
         if rc < 0:
             raise ValueError("invalid compression codec %r" % (compression,))
         return rc
+
+    def wire_stats(self):
+        """Cross-host wire-plane counters as a dict: ``ops`` full-duplex
+        exchanges completed, ``syscalls`` blocking syscalls the data plane
+        issued for them (poll + sendmsg + readv rounds on the basic tier;
+        one io_uring_enter per batch on the uring tier — ``syscalls/ops``
+        is the batching proof the acceptance tests pin), the io_uring batch
+        anatomy (``uring_submits`` / ``uring_sqes`` / ``uring_cqes`` /
+        ``uring_us``), and the MSG_ZEROCOPY tier's ``zc_sends`` /
+        ``zc_completions`` / ``zc_copied`` (completions where the kernel
+        fell back to copying) / ``zc_us``. The uring/zc counters stay 0 on
+        the basic tier — the kill-switch proof."""
+        vals = [c_int64(0) for _ in range(10)]
+        rc = _lib.hvd_wire_stats(*[ctypes.byref(v) for v in vals])
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        keys = ("ops", "syscalls", "uring_submits", "uring_sqes",
+                "uring_cqes", "uring_us", "zc_sends", "zc_completions",
+                "zc_copied", "zc_us")
+        return dict(zip(keys, (v.value for v in vals)))
+
+    def wire_state(self):
+        """(live_tier, probed_tier, agreed_tier, probe_failures,
+        pinned_lanes): the wire tier the data plane is on right now
+        ("basic" / "zerocopy" / "uring" — the autotune `wire` arm may
+        force basic below the mesh agreement), this rank's local probe
+        result, the mesh-agreed tier (the minimum across ranks), probe
+        rungs that had to degrade (exercised by HVD_WIRE_PROBE_FAIL), and
+        reduce-pool lanes NUMA-pinned under HVD_NUMA."""
+        probed = c_int64(0)
+        agreed = c_int64(0)
+        failures = c_int64(0)
+        pinned = c_int64(0)
+        rc = _lib.hvd_wire_state(
+            ctypes.byref(probed), ctypes.byref(agreed),
+            ctypes.byref(failures), ctypes.byref(pinned))
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        names = {0: "basic", 1: "zerocopy", 2: "uring"}
+        return (names.get(rc, "basic"), names.get(probed.value, "basic"),
+                names.get(agreed.value, "basic"), failures.value,
+                pinned.value)
 
     def reduce_pool_stats(self):
         """(threads, jobs, spans): configured reduce-pool lanes

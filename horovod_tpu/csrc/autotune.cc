@@ -39,14 +39,17 @@ void ParameterManager::Configure(bool enabled, const std::string& log_path,
                                  bool init_hier, bool init_zerocopy,
                                  bool init_pipeline, bool init_shm,
                                  bool init_bucket, bool init_compress,
-                                 bool can_toggle_cache,
+                                 bool init_wire, bool can_toggle_cache,
                                  bool can_toggle_hier,
                                  bool can_toggle_zerocopy,
                                  bool can_toggle_pipeline,
                                  bool can_toggle_shm,
                                  bool can_toggle_bucket,
-                                 bool can_toggle_compress) {
+                                 bool can_toggle_compress,
+                                 bool can_toggle_wire,
+                                 const std::string& affinity) {
   enabled_ = enabled;
+  affinity_ = affinity.empty() ? "?" : affinity;
   if (!enabled_) return;
   cycles_per_sample_ = cycles_per_sample;
   max_samples_ = max_samples;
@@ -55,9 +58,9 @@ void ParameterManager::Configure(bool enabled, const std::string& log_path,
   // Arm order: the job's initial configuration first (the baseline every
   // later score competes against), then the other combinations — but only
   // over dims that can actually take effect (a capacity-0 cache, a
-  // non-uniform topology, HVD_ZEROCOPY=0, or a single-member ring makes
-  // that toggle a no-op; sweeping it would burn windows measuring a config
-  // that never engaged).
+  // non-uniform topology, HVD_ZEROCOPY=0, a single-member ring, or a wire
+  // probe that landed on basic makes that toggle a no-op; sweeping it
+  // would burn windows measuring a config that never engaged).
   int n = 0;
   for (int c = 0; c < (can_toggle_cache ? 2 : 1); c++) {
     for (int h = 0; h < (can_toggle_hier ? 2 : 1); h++) {
@@ -66,31 +69,37 @@ void ParameterManager::Configure(bool enabled, const std::string& log_path,
           for (int sh = 0; sh < (can_toggle_shm ? 2 : 1); sh++) {
             for (int bk = 0; bk < (can_toggle_bucket ? 2 : 1); bk++) {
               for (int cp = 0; cp < (can_toggle_compress ? 2 : 1); cp++) {
-                arm_cache_[n] = can_toggle_cache
-                                    ? (c == 0 ? init_cache : !init_cache)
-                                    : init_cache;
-                arm_hier_[n] = can_toggle_hier
-                                   ? (h == 0 ? init_hier : !init_hier)
-                                   : init_hier;
-                arm_zerocopy_[n] =
-                    can_toggle_zerocopy
-                        ? (z == 0 ? init_zerocopy : !init_zerocopy)
-                        : init_zerocopy;
-                arm_pipeline_[n] =
-                    can_toggle_pipeline
-                        ? (pl == 0 ? init_pipeline : !init_pipeline)
-                        : init_pipeline;
-                arm_shm_[n] = can_toggle_shm
-                                  ? (sh == 0 ? init_shm : !init_shm)
-                                  : init_shm;
-                arm_bucket_[n] = can_toggle_bucket
-                                     ? (bk == 0 ? init_bucket : !init_bucket)
-                                     : init_bucket;
-                arm_compress_[n] =
-                    can_toggle_compress
-                        ? (cp == 0 ? init_compress : !init_compress)
-                        : init_compress;
-                n++;
+                for (int w = 0; w < (can_toggle_wire ? 2 : 1); w++) {
+                  arm_cache_[n] = can_toggle_cache
+                                      ? (c == 0 ? init_cache : !init_cache)
+                                      : init_cache;
+                  arm_hier_[n] = can_toggle_hier
+                                     ? (h == 0 ? init_hier : !init_hier)
+                                     : init_hier;
+                  arm_zerocopy_[n] =
+                      can_toggle_zerocopy
+                          ? (z == 0 ? init_zerocopy : !init_zerocopy)
+                          : init_zerocopy;
+                  arm_pipeline_[n] =
+                      can_toggle_pipeline
+                          ? (pl == 0 ? init_pipeline : !init_pipeline)
+                          : init_pipeline;
+                  arm_shm_[n] = can_toggle_shm
+                                    ? (sh == 0 ? init_shm : !init_shm)
+                                    : init_shm;
+                  arm_bucket_[n] =
+                      can_toggle_bucket
+                          ? (bk == 0 ? init_bucket : !init_bucket)
+                          : init_bucket;
+                  arm_compress_[n] =
+                      can_toggle_compress
+                          ? (cp == 0 ? init_compress : !init_compress)
+                          : init_compress;
+                  arm_wire_[n] = can_toggle_wire
+                                     ? (w == 0 ? init_wire : !init_wire)
+                                     : init_wire;
+                  n++;
+                }
               }
             }
           }
@@ -106,6 +115,7 @@ void ParameterManager::Configure(bool enabled, const std::string& log_path,
   cur_shm_ = init_shm;
   cur_bucket_ = init_bucket;
   cur_compress_ = init_compress;
+  cur_wire_ = init_wire;
   // With fewer than arms+warmup samples budgeted (or nothing to sweep),
   // skip the arm phase and tune numerics only under the initial config.
   if (arm_count_ < 2 || max_samples_ < arm_count_ + 3) arm_idx_ = arm_count_;
@@ -115,7 +125,7 @@ void ParameterManager::Configure(bool enabled, const std::string& log_path,
       fprintf(
           log_,
           "sample,fusion_kb,cycle_ms,cache,hier,zerocopy,pipeline,shm,"
-          "bucket,compress,score_mbps\n");
+          "bucket,compress,wire,affinity,score_mbps\n");
   }
   // First sample point = warmup[0]; adopted on the first Record proposal.
   memcpy(cur_x_, kWarmup[0], sizeof(cur_x_));
@@ -223,7 +233,8 @@ void ParameterManager::Propose(double out[2]) {
 bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
                               double* cycle_ms, int* cache_on, int* hier_on,
                               int* zerocopy_on, int* pipeline_on,
-                              int* shm_on, int* bucket_on, int* compress_on) {
+                              int* shm_on, int* bucket_on, int* compress_on,
+                              int* wire_on) {
   if (!active()) return false;
   if (bytes <= 0 && acc_cycles_ == 0) {
     // Idle before the window opens: keep re-stamping the start so a pause
@@ -244,6 +255,7 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
     *shm_on = cur_shm_ ? 1 : 0;
     *bucket_on = cur_bucket_ ? 1 : 0;
     *compress_on = cur_compress_ ? 1 : 0;
+    *wire_on = cur_wire_ ? 1 : 0;
     warmup_idx_ = 1;
     return true;
   }
@@ -262,11 +274,11 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
     int64_t f;
     double c;
     ToParams(cur_x_, &f, &c);
-    fprintf(log_, "%lld,%.1f,%.3f,%d,%d,%d,%d,%d,%d,%d,%.3f\n",
+    fprintf(log_, "%lld,%.1f,%.3f,%d,%d,%d,%d,%d,%d,%d,%d,%s,%.3f\n",
             (long long)n_samples_, f / 1024.0, c, cur_cache_ ? 1 : 0,
             cur_hier_ ? 1 : 0, cur_zerocopy_ ? 1 : 0, cur_pipeline_ ? 1 : 0,
             cur_shm_ ? 1 : 0, cur_bucket_ ? 1 : 0, cur_compress_ ? 1 : 0,
-            score / 1e6);
+            cur_wire_ ? 1 : 0, affinity_.c_str(), score / 1e6);
     fflush(log_);
   }
   if (score > best_score_) {
@@ -292,6 +304,7 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
       cur_shm_ = arm_shm_[arm_idx_];
       cur_bucket_ = arm_bucket_[arm_idx_];
       cur_compress_ = arm_compress_[arm_idx_];
+      cur_wire_ = arm_wire_[arm_idx_];
     } else {
       best_arm_ = 0;
       for (int i = 1; i < arm_count_; i++)
@@ -303,6 +316,7 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
       cur_shm_ = arm_shm_[best_arm_];
       cur_bucket_ = arm_bucket_[best_arm_];
       cur_compress_ = arm_compress_[best_arm_];
+      cur_wire_ = arm_wire_[best_arm_];
       // Seed the GP with the winning arm's observation at warmup[0]: the
       // numeric phase continues from warmup[1] under the locked arm.
       xs_.push_back({cur_x_[0], cur_x_[1]});
@@ -317,6 +331,7 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
     *shm_on = cur_shm_ ? 1 : 0;
     *bucket_on = cur_bucket_ ? 1 : 0;
     *compress_on = cur_compress_ ? 1 : 0;
+    *wire_on = cur_wire_ ? 1 : 0;
     return true;
   }
 
@@ -335,12 +350,13 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
     *shm_on = cur_shm_ ? 1 : 0;
     *bucket_on = cur_bucket_ ? 1 : 0;
     *compress_on = cur_compress_ ? 1 : 0;
+    *wire_on = cur_wire_ ? 1 : 0;
     if (log_) {
-      fprintf(log_, "# final,%.1f,%.3f,%d,%d,%d,%d,%d,%d,%d,%.3f\n",
+      fprintf(log_, "# final,%.1f,%.3f,%d,%d,%d,%d,%d,%d,%d,%d,%s,%.3f\n",
               best_fusion_ / 1024.0, best_cycle_ms_, cur_cache_ ? 1 : 0,
               cur_hier_ ? 1 : 0, cur_zerocopy_ ? 1 : 0, cur_pipeline_ ? 1 : 0,
               cur_shm_ ? 1 : 0, cur_bucket_ ? 1 : 0, cur_compress_ ? 1 : 0,
-              best_score_ / 1e6);
+              cur_wire_ ? 1 : 0, affinity_.c_str(), best_score_ / 1e6);
       fflush(log_);
     }
     return true;
@@ -354,6 +370,7 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
   *shm_on = cur_shm_ ? 1 : 0;
   *bucket_on = cur_bucket_ ? 1 : 0;
   *compress_on = cur_compress_ ? 1 : 0;
+  *wire_on = cur_wire_ ? 1 : 0;
   return true;
 }
 

@@ -29,15 +29,19 @@ class ParameterManager {
     if (log_) fclose(log_);
   }
 
+  // `affinity` is the process CPU-affinity string recorded verbatim in
+  // every CSV row (comma-free; see numa::AffinityString) so tuning runs
+  // are attributable to their placement.
   void Configure(bool enabled, const std::string& log_path,
                  int64_t init_fusion, double init_cycle_ms,
                  int64_t cycles_per_sample, int64_t max_samples,
                  bool init_cache, bool init_hier, bool init_zerocopy,
                  bool init_pipeline, bool init_shm, bool init_bucket,
-                 bool init_compress, bool can_toggle_cache,
+                 bool init_compress, bool init_wire, bool can_toggle_cache,
                  bool can_toggle_hier, bool can_toggle_zerocopy,
                  bool can_toggle_pipeline, bool can_toggle_shm,
-                 bool can_toggle_bucket, bool can_toggle_compress);
+                 bool can_toggle_bucket, bool can_toggle_compress,
+                 bool can_toggle_wire, const std::string& affinity);
   bool active() const { return enabled_ && !done_; }
   bool enabled() const { return enabled_; }
   // Non-coordinator ranks mirror the coordinator's search-finished state
@@ -52,13 +56,13 @@ class ParameterManager {
   // categorical layers before numeric tuning): first the categorical
   // arms (response cache x hierarchical allreduce x zero-copy
   // scatter-gather x ring pipeline x shm host plane x gradient
-  // bucketing x compressed collectives) are each scored for one window
-  // at the initial numeric point; the winning arm is locked, then the
-  // (fusion, cycle) warmup grid + GP search runs under it.
+  // bucketing x compressed collectives x wire tier) are each scored for
+  // one window at the initial numeric point; the winning arm is locked,
+  // then the (fusion, cycle) warmup grid + GP search runs under it.
   bool Record(int64_t bytes, int64_t now_us, int64_t* fusion,
               double* cycle_ms, int* cache_on, int* hier_on,
               int* zerocopy_on, int* pipeline_on, int* shm_on,
-              int* bucket_on, int* compress_on);
+              int* bucket_on, int* compress_on, int* wire_on);
 
   int64_t best_fusion() const { return best_fusion_; }
   double best_cycle_ms() const { return best_cycle_ms_; }
@@ -86,10 +90,12 @@ class ParameterManager {
   int64_t n_samples_ = 0;  // arm + numeric windows scored so far
 
   // Categorical phase: (cache, hier, zerocopy, pipeline, shm, bucket,
-  // compress) arms over the TOGGLEABLE dims only, initial-config arm first
-  // so the baseline is always measured. Filled in Configure; arm_count_ is
-  // a power of two in 1..128.
-  static constexpr int kMaxArms = 128;
+  // compress, wire) arms over the TOGGLEABLE dims only, initial-config arm
+  // first so the baseline is always measured. Filled in Configure;
+  // arm_count_ is a power of two in 1..256. The wire dim only exists where
+  // the tier probe succeeded (can_toggle_wire), so no arm ever asks for an
+  // unsupported kernel feature.
+  static constexpr int kMaxArms = 256;
   bool arm_cache_[kMaxArms];
   bool arm_hier_[kMaxArms];
   bool arm_zerocopy_[kMaxArms];
@@ -97,13 +103,15 @@ class ParameterManager {
   bool arm_shm_[kMaxArms];
   bool arm_bucket_[kMaxArms];
   bool arm_compress_[kMaxArms];
+  bool arm_wire_[kMaxArms];
   double arm_score_[kMaxArms] = {};
   int arm_count_ = 1;
   int arm_idx_ = 0;        // next arm to measure; == arm_count_ -> locked
   int best_arm_ = 0;
   bool cur_cache_ = true, cur_hier_ = false, cur_zerocopy_ = true,
        cur_pipeline_ = true, cur_shm_ = true, cur_bucket_ = false,
-       cur_compress_ = false;
+       cur_compress_ = false, cur_wire_ = false;
+  std::string affinity_ = "?";
 
   // Current sample accumulation.
   double cur_x_[2] = {0.5, 0.5};

@@ -1,7 +1,9 @@
 #include "collectives.h"
 
+#include <errno.h>
 #include <fcntl.h>
 #include <limits.h>
+#include <netinet/in.h>
 #include <poll.h>
 #include <sys/socket.h>
 #include <sys/uio.h>
@@ -11,9 +13,47 @@
 #include <algorithm>
 #include <chrono>
 #include <cstdlib>
+#include <deque>
 #include <stdexcept>
 
+#include "debug_lock.h"
 #include "reduce.h"
+
+// MSG_ZEROCOPY plumbing (zerocopy tier). The flag and the error-queue
+// notification layout are stable kernel ABI, so spell out fallbacks for
+// toolchains whose userspace headers predate them.
+#if defined(__has_include)
+#if __has_include(<linux/errqueue.h>)
+#include <linux/errqueue.h>
+#define HVD_HAVE_ERRQUEUE 1
+#endif
+#endif
+#ifndef HVD_HAVE_ERRQUEUE
+struct sock_extended_err {
+  uint32_t ee_errno;
+  uint8_t ee_origin;
+  uint8_t ee_type;
+  uint8_t ee_code;
+  uint8_t ee_pad;
+  uint32_t ee_info;
+  uint32_t ee_data;
+};
+#endif
+#ifndef MSG_ZEROCOPY
+#define MSG_ZEROCOPY 0x4000000
+#endif
+#ifndef SO_EE_ORIGIN_ZEROCOPY
+#define SO_EE_ORIGIN_ZEROCOPY 5
+#endif
+#ifndef SO_EE_CODE_ZEROCOPY_COPIED
+#define SO_EE_CODE_ZEROCOPY_COPIED 1
+#endif
+#ifndef SOL_IP
+#define SOL_IP 0
+#endif
+#ifndef IP_RECVERR
+#define IP_RECVERR 11
+#endif
 
 namespace hvd {
 
@@ -117,11 +157,354 @@ void ForEachSpan(const std::vector<Segment>& in,
 
 }  // namespace
 
+// --- wire tier plumbing ------------------------------------------------------
+
+void DataPlane::set_wire_tier(int tier) {
+  if (tier == wire::kUring) {
+    // 64 SQ entries is far beyond the engine's 2 in-flight ops; sized for
+    // headroom, not throughput. A setup failure here (fd exhaustion after a
+    // successful probe) degrades rather than fails.
+    if (!uring_.valid() && !uring_.Init(64)) tier = wire::kZeroCopy;
+  }
+  if (tier != wire::kUring && uring_.valid()) uring_.Close();
+  if (tier == wire::kZeroCopy)
+    for (auto& s : peers_)
+      if (s.valid()) s.EnableZeroCopy();
+  wire_tier_ = tier;
+  if (tier == wire::kUring && !scratch_.empty())
+    uring_.RegisterScratch(scratch_.data(), scratch_.size());
+}
+
+uint8_t* DataPlane::Scratch(size_t n) {
+  if (scratch_.size() < n) {
+    scratch_.resize(n);
+    // Growth moves the allocation, invalidating the fixed-buffer
+    // registration; re-register so receives keep riding READ_FIXED.
+    if (uring_.valid())
+      uring_.RegisterScratch(scratch_.data(), scratch_.size());
+  }
+  return scratch_.data();
+}
+
+ssize_t DataPlane::WireSend(Socket& to, const void* p, size_t n,
+                            int* zc_pending) {
+  bool zc = wire_tier_ == wire::kZeroCopy && to.zerocopy() &&
+            (int64_t)n >= zc_threshold_;
+  stat_wire_syscalls++;
+  ssize_t k =
+      ::send(to.fd(), p, n, zc ? MSG_NOSIGNAL | MSG_ZEROCOPY : MSG_NOSIGNAL);
+  if (k < 0 && zc && errno == ENOBUFS) {
+    // Pinned-page budget (net.core.optmem_max) exhausted: reap outstanding
+    // completions and retry plain — correctness never depends on zerocopy
+    // engaging.
+    ReapZeroCopy(to, zc_pending);
+    stat_wire_syscalls++;
+    k = ::send(to.fd(), p, n, MSG_NOSIGNAL);
+    zc = false;
+  }
+  if (k > 0 && zc) {
+    (*zc_pending)++;
+    stat_zc_sends++;
+  }
+  return k;
+}
+
+ssize_t DataPlane::WireSendMsg(Socket& to, msghdr* mh, size_t left,
+                               int* zc_pending) {
+  bool zc = wire_tier_ == wire::kZeroCopy && to.zerocopy() &&
+            (int64_t)left >= zc_threshold_;
+  stat_wire_syscalls++;
+  ssize_t k =
+      ::sendmsg(to.fd(), mh, zc ? MSG_NOSIGNAL | MSG_ZEROCOPY : MSG_NOSIGNAL);
+  if (k < 0 && zc && errno == ENOBUFS) {
+    ReapZeroCopy(to, zc_pending);
+    stat_wire_syscalls++;
+    k = ::sendmsg(to.fd(), mh, MSG_NOSIGNAL);
+    zc = false;
+  }
+  if (k > 0 && zc) {
+    (*zc_pending)++;
+    stat_zc_sends++;
+  }
+  return k;
+}
+
+// Drain whatever completion notifications are queued right now (never
+// blocks). Returns the number reaped; 0 when the queue is empty or holds
+// only non-zerocopy errors (the caller's normal error paths surface those).
+int DataPlane::TryReapZeroCopy(Socket& to, int* zc_pending) {
+  int reaped = 0;
+  while (*zc_pending > 0) {
+    uint8_t ctrl[512];
+    msghdr mh = {};
+    mh.msg_control = ctrl;
+    mh.msg_controllen = sizeof(ctrl);
+    stat_wire_syscalls++;
+    ssize_t k = ::recvmsg(to.fd(), &mh, MSG_ERRQUEUE | MSG_DONTWAIT);
+    if (k < 0) break;  // EAGAIN (drained) or a real error — caller's problem
+    for (cmsghdr* c = CMSG_FIRSTHDR(&mh); c; c = CMSG_NXTHDR(&mh, c)) {
+      if (!(c->cmsg_level == SOL_IP && c->cmsg_type == IP_RECVERR)) continue;
+      sock_extended_err ee;
+      memcpy(&ee, CMSG_DATA(c), sizeof(ee));
+      if (ee.ee_errno != 0 || ee.ee_origin != SO_EE_ORIGIN_ZEROCOPY) continue;
+      // One notification covers the send range [ee_info, ee_data].
+      int done = (int)(ee.ee_data - ee.ee_info) + 1;
+      *zc_pending -= done;
+      if (*zc_pending < 0) *zc_pending = 0;
+      reaped += done;
+      stat_zc_completions += done;
+      if (ee.ee_code & SO_EE_CODE_ZEROCOPY_COPIED) stat_zc_copied += done;
+    }
+  }
+  return reaped;
+}
+
+void DataPlane::ReapZeroCopy(Socket& to, int* zc_pending) {
+  if (*zc_pending <= 0) return;
+  int64_t t0 = MonoUs();
+  while (*zc_pending > 0) {
+    if (TryReapZeroCopy(to, zc_pending) > 0) continue;
+    if (*zc_pending <= 0) break;
+    // Error-queue readiness reports as POLLERR even with no events
+    // requested, so an empty events mask waits for exactly that.
+    pollfd pfd{to.fd(), 0, 0};
+    fault::Check("poll");
+    lockdep::OnBlockingSyscall("poll");
+    stat_wire_syscalls++;
+    int rc = ::poll(&pfd, 1, poll_timeout_ms_);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      throw std::runtime_error("zerocopy completion poll failed");
+    }
+    if (rc == 0)
+      throw std::runtime_error(
+          "zerocopy completion timeout (" +
+          std::to_string(poll_timeout_ms_ / 1000) +
+          "s waiting on the error queue; HVD_DATA_TIMEOUT_SECONDS to tune)");
+  }
+  stat_zc_us += MonoUs() - t0;
+}
+
+void DataPlane::UringDuplex(
+    Socket& to, std::vector<iovec>& sv, Socket& from, std::vector<iovec>& rv,
+    size_t rblock, const std::function<void(size_t, size_t)>& on_block) {
+  int64_t t0 = MonoUs();
+  size_t si = 0, ri = 0;
+  while (si < sv.size() && sv[si].iov_len == 0) si++;
+  while (ri < rv.size() && rv[ri].iov_len == 0) ri++;
+  size_t sleft = IovBytes(sv, si);
+  size_t rleft = IovBytes(rv, ri);
+  const size_t rtotal = rleft;
+  size_t recvd = 0, delivered = 0;
+  // Sockets stay BLOCKING on this tier: io_uring attempts each op
+  // non-blocking internally and poll-arms the retry itself, so the
+  // O_NONBLOCK juggling of the classic loops is unnecessary.
+  bool send_inflight = false;
+  int recv_inflight = 0;
+  msghdr smh = {}, rmh = {};
+  constexpr uint64_t kSend = 1, kRecv = 2;
+  // Chained-wave bookkeeping: the address/length each receive SQE was
+  // armed with, FIFO — IOSQE_IO_LINK executes the chain sequentially, so
+  // completions arrive in push order. `shift` accumulates the deficit of
+  // rare short WAITALL completions (signal hit mid-receive): successors
+  // were armed at precomputed offsets, so their landed bytes memmove back
+  // by the running deficit to stay stream-contiguous.
+  std::deque<std::pair<uint8_t*, size_t>> armed;
+  size_t shift = 0;
+  // Streamed receives into one contiguous region arm a whole WAVE of
+  // block-bounded MSG_WAITALL recvs as one linked chain: a single submit
+  // replaces the entire per-chunk poll/readv cycle, completions are
+  // reaped from the CQ ring in user space (no syscall), and the kernel
+  // keeps draining the socket behind the on_block reduction.
+  const bool chain_mode = rblock > 0 && rv.size() - ri == 1;
+  while (sleft > 0 || rleft > 0 || send_inflight || recv_inflight > 0) {
+    bool pushed_now = false;
+    if (sleft > 0 && !send_inflight) {
+      // One SENDMSG SQE covers the whole remaining iovec run: the kernel
+      // executes it like a blocking sendmsg (retrying partial progress off
+      // write-readiness), so a multi-MB chunk is one submission, not a
+      // poll-loop of MTU-sized slices.
+      smh = msghdr{};
+      smh.msg_iov = &sv[si];
+      smh.msg_iovlen = std::min(sv.size() - si, (size_t)IOV_MAX);
+      // Large sends go IOSQE_ASYNC: a blocking kernel-side sendmsg walks
+      // the socket buffer itself and posts ONE completion, where the
+      // inline attempt would hand back partial progress per buffer-full
+      // and cost a resubmit enter each time. Small sends fit the first
+      // attempt anyway and skip the worker handoff.
+      if (!uring_.PushSendmsg(to.fd(), &smh, kSend,
+                              sleft > (size_t)256 * 1024))
+        throw std::runtime_error("io_uring submission queue overflow (send)");
+      send_inflight = true;
+      pushed_now = true;
+      stat_uring_sqes++;
+    }
+    if (rleft > 0 && recv_inflight == 0) {
+      if (chain_mode) {
+        // Size the wave first (bounded by free SQ slots, one reserved for
+        // a send resubmit) so every push below is guaranteed a slot and
+        // no trailing IOSQE_IO_LINK can dangle into a later submission.
+        unsigned room = uring_.SqRoom();
+        size_t wave = room > 1 ? room - 1 : 1;
+        std::vector<size_t> lens;
+        size_t off = 0;
+        while (off < rleft && lens.size() < wave) {
+          size_t want = std::min(rleft - off,
+                                 rblock - (recvd + off - delivered) % rblock);
+          want = std::min(want, (size_t)(1u << 30));
+          lens.push_back(want);
+          off += want;
+        }
+        uint8_t* base = (uint8_t*)rv[ri].iov_base;
+        shift = 0;
+        armed.clear();
+        off = 0;
+        for (size_t i = 0; i < lens.size(); i++) {
+          if (!uring_.PushRecv(from.fd(), base + off, (unsigned)lens[i],
+                               MSG_WAITALL, kRecv, i + 1 < lens.size()))
+            throw std::runtime_error(
+                "io_uring submission queue overflow (recv chain)");
+          armed.push_back({base + off, lens[i]});
+          recv_inflight++;
+          pushed_now = true;
+          stat_uring_sqes++;
+          off += lens[i];
+        }
+      } else {
+        bool pushed;
+        uint8_t* sb = (uint8_t*)uring_.scratch_base();
+        bool in_scratch = rv.size() - ri == 1 &&
+                          uring_.scratch_registered() &&
+                          (uint8_t*)rv[ri].iov_base >= sb &&
+                          (uint8_t*)rv[ri].iov_base + rv[ri].iov_len <=
+                              sb + uring_.scratch_len();
+        if (in_scratch) {
+          // Registered-buffer receive (no per-op page pinning). Completes
+          // with whatever is available, like recv(2) — fine for a serial
+          // chunk that is usually one socket-buffer burst anyway.
+          unsigned len =
+              (unsigned)std::min(rv[ri].iov_len, (size_t)(1u << 30));
+          pushed =
+              uring_.PushReadFixed(from.fd(), rv[ri].iov_base, len, kRecv);
+        } else if (rv.size() - ri > 1) {
+          // Segmented receive (allgather wiring output segments directly):
+          // MSG_WAITALL makes the kernel retry short receives, so the whole
+          // segmented chunk lands in one completion.
+          rmh = msghdr{};
+          rmh.msg_iov = &rv[ri];
+          rmh.msg_iovlen = std::min(rv.size() - ri, (size_t)IOV_MAX);
+          pushed = uring_.PushRecvmsg(from.fd(), &rmh, MSG_WAITALL, kRecv);
+        } else {
+          // Contiguous serial receive outside the scratch: the full chunk
+          // as one kernel-completed op.
+          unsigned len =
+              (unsigned)std::min(rv[ri].iov_len, (size_t)(1u << 30));
+          pushed = uring_.PushRecv(from.fd(), rv[ri].iov_base, len,
+                                   MSG_WAITALL, kRecv);
+        }
+        if (!pushed)
+          throw std::runtime_error(
+              "io_uring submission queue overflow (recv)");
+        recv_inflight = 1;
+        pushed_now = true;
+        stat_uring_sqes++;
+      }
+    }
+    // The tier's whole point: ONE syscall submits every SQE pushed above
+    // AND waits (bounded) for completions. The submit enter waits for just
+    // one CQE so early blocks reduce while the kernel drains the rest of
+    // the chain; a PURE wait (nothing newly pushed) asks for everything
+    // still in flight at once — safe only while every send completes full
+    // (MSG_WAITALL honored): a partial send's tail is resubmitted from
+    // HERE, and two ranks both sleeping past a partial-send CQE while
+    // their peers wait on the unsent tail is a mutual stall. The first
+    // short send therefore flips uring_full_sends_ off for good and every
+    // wait drops back to one-CQE wakeups.
+    unsigned want = 1;
+    if (!pushed_now && uring_full_sends_) {
+      size_t inflight = (size_t)recv_inflight + (send_inflight ? 1 : 0);
+      if (inflight > 1) want = (unsigned)inflight;
+    }
+    stat_uring_submits++;
+    stat_wire_syscalls++;
+    int rc = uring_.SubmitAndWait(want, poll_timeout_ms_);
+    if (rc < 0)
+      throw std::runtime_error(std::string("io_uring_enter failed: ") +
+                               strerror(-rc));
+    uint64_t ud = 0;
+    int32_t res = 0;
+    bool reaped = false;
+    while (uring_.PopCompletion(&ud, &res)) {
+      stat_uring_cqes++;
+      reaped = true;
+      if (ud == kSend) {
+        send_inflight = false;
+        if (res == -EINTR || res == -EAGAIN) {
+          uring_full_sends_ = false;  // kernel handed the op back unfinished
+          continue;                   // resubmit next round
+        }
+        if (res < 0) throw std::runtime_error("data-plane send failed");
+        if ((size_t)res < sleft) uring_full_sends_ = false;
+        IovAdvance(sv, &si, (size_t)res);
+        sleft -= (size_t)res;
+        to.note_tx((size_t)res);
+      } else {
+        recv_inflight--;
+        uint8_t* abuf = nullptr;
+        size_t alen = 0;
+        if (!armed.empty()) {
+          abuf = armed.front().first;
+          alen = armed.front().second;
+          armed.pop_front();
+        }
+        // A failed link predecessor cancels the rest of its chain; the
+        // outer loop re-arms a fresh wave from the true stream position
+        // once every cancelled CQE has drained.
+        if (res == -ECANCELED) continue;
+        if (res == -EINTR || res == -EAGAIN) continue;
+        if (res == 0) throw std::runtime_error("data-plane peer closed");
+        if (res < 0) throw std::runtime_error("data-plane recv failed");
+        if (abuf != nullptr) {
+          if (shift > 0) memmove(abuf - shift, abuf, (size_t)res);
+          if ((size_t)res < alen) shift += alen - (size_t)res;
+        }
+        IovAdvance(rv, &ri, (size_t)res);
+        rleft -= (size_t)res;
+        recvd += (size_t)res;
+        if (on_block && rblock > 0) {
+          size_t bound = recvd == rtotal
+                             ? rtotal
+                             : delivered + (recvd - delivered) / rblock * rblock;
+          if (bound > delivered) {
+            on_block(delivered, bound - delivered);
+            delivered = bound;
+          }
+        }
+      }
+    }
+    if (!reaped)
+      throw std::runtime_error(
+          "data-plane poll timeout (" +
+          std::to_string(poll_timeout_ms_ / 1000) +
+          "s with no completions; HVD_DATA_TIMEOUT_SECONDS to tune)");
+  }
+  stat_uring_us += MonoUs() - t0;
+  stat_wire_ops++;
+}
+
 void DataPlane::FullDuplex(Socket& to, const void* sbuf, size_t sn,
                            Socket& from, void* rbuf, size_t rn) {
+  if (UringReady()) {
+    std::vector<iovec> sv, rv;
+    if (sn) sv.push_back({(void*)sbuf, sn});
+    if (rn) rv.push_back({rbuf, rn});
+    UringDuplex(to, sv, from, rv, 0, {});
+    return;
+  }
   const uint8_t* sp = (const uint8_t*)sbuf;
   uint8_t* rp = (uint8_t*)rbuf;
   size_t sent = 0, recvd = 0;
+  int zc_pending = 0;
   bool same = to.fd() == from.fd();
   to.SetNonBlocking(true);
   if (!same) from.SetNonBlocking(true);
@@ -138,6 +521,9 @@ void DataPlane::FullDuplex(Socket& to, const void* sbuf, size_t sn,
         if (sent < sn) fds[nfds++] = {to.fd(), POLLOUT, 0};
         if (recvd < rn) fds[nfds++] = {from.fd(), POLLIN, 0};
       }
+      fault::Check("poll");
+      lockdep::OnBlockingSyscall("poll");
+      stat_wire_syscalls++;
       int rc = ::poll(fds, nfds, poll_timeout_ms_);
       if (rc < 0) {
         if (errno == EINTR) continue;
@@ -150,10 +536,16 @@ void DataPlane::FullDuplex(Socket& to, const void* sbuf, size_t sn,
             "s with no bytes moved; HVD_DATA_TIMEOUT_SECONDS to tune)");
       for (int i = 0; i < nfds; i++) {
         if ((fds[i].revents & (POLLERR | POLLHUP | POLLNVAL)) &&
-            !(fds[i].revents & (POLLIN | POLLOUT)))
+            !(fds[i].revents & (POLLIN | POLLOUT))) {
+          // On the zerocopy tier a bare POLLERR can simply mean completion
+          // notifications are queued; only a sterile error queue is fatal.
+          if (zc_pending > 0 && fds[i].fd == to.fd() &&
+              TryReapZeroCopy(to, &zc_pending) > 0)
+            continue;
           throw std::runtime_error("data-plane peer failed");
+        }
         if ((fds[i].revents & POLLOUT) && sent < sn) {
-          ssize_t k = ::send(to.fd(), sp + sent, sn - sent, MSG_NOSIGNAL);
+          ssize_t k = WireSend(to, sp + sent, sn - sent, &zc_pending);
           if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
             throw std::runtime_error("data-plane send failed");
           if (k > 0) {
@@ -162,6 +554,7 @@ void DataPlane::FullDuplex(Socket& to, const void* sbuf, size_t sn,
           }
         }
         if ((fds[i].revents & POLLIN) && recvd < rn) {
+          stat_wire_syscalls++;
           ssize_t k = ::recv(from.fd(), rp + recvd, rn - recvd, 0);
           if (k == 0) throw std::runtime_error("data-plane peer closed");
           if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
@@ -170,6 +563,7 @@ void DataPlane::FullDuplex(Socket& to, const void* sbuf, size_t sn,
         }
       }
     }
+    ReapZeroCopy(to, &zc_pending);
   } catch (...) {
     to.SetNonBlocking(false);
     if (!same) from.SetNonBlocking(false);
@@ -177,15 +571,21 @@ void DataPlane::FullDuplex(Socket& to, const void* sbuf, size_t sn,
   }
   to.SetNonBlocking(false);
   if (!same) from.SetNonBlocking(false);
+  stat_wire_ops++;
 }
 
 void DataPlane::FullDuplexV(Socket& to, std::vector<iovec>& sv, Socket& from,
                             std::vector<iovec>& rv) {
+  if (UringReady()) {
+    UringDuplex(to, sv, from, rv, 0, {});
+    return;
+  }
   size_t si = 0, ri = 0;
   while (si < sv.size() && sv[si].iov_len == 0) si++;
   while (ri < rv.size() && rv[ri].iov_len == 0) ri++;
   size_t sleft = IovBytes(sv, si);
   size_t rleft = IovBytes(rv, ri);
+  int zc_pending = 0;
   bool same = to.fd() == from.fd();
   to.SetNonBlocking(true);
   if (!same) from.SetNonBlocking(true);
@@ -202,6 +602,9 @@ void DataPlane::FullDuplexV(Socket& to, std::vector<iovec>& sv, Socket& from,
         if (sleft > 0) fds[nfds++] = {to.fd(), POLLOUT, 0};
         if (rleft > 0) fds[nfds++] = {from.fd(), POLLIN, 0};
       }
+      fault::Check("poll");
+      lockdep::OnBlockingSyscall("poll");
+      stat_wire_syscalls++;
       int rc = ::poll(fds, nfds, poll_timeout_ms_);
       if (rc < 0) {
         if (errno == EINTR) continue;
@@ -214,15 +617,19 @@ void DataPlane::FullDuplexV(Socket& to, std::vector<iovec>& sv, Socket& from,
             "s with no bytes moved; HVD_DATA_TIMEOUT_SECONDS to tune)");
       for (int i = 0; i < nfds; i++) {
         if ((fds[i].revents & (POLLERR | POLLHUP | POLLNVAL)) &&
-            !(fds[i].revents & (POLLIN | POLLOUT)))
+            !(fds[i].revents & (POLLIN | POLLOUT))) {
+          if (zc_pending > 0 && fds[i].fd == to.fd() &&
+              TryReapZeroCopy(to, &zc_pending) > 0)
+            continue;
           throw std::runtime_error("data-plane peer failed");
+        }
         if ((fds[i].revents & POLLOUT) && sleft > 0) {
           // sendmsg, not writev: MSG_NOSIGNAL keeps a dead peer an error
           // return instead of a SIGPIPE, matching the byte path.
           msghdr mh = {};
           mh.msg_iov = &sv[si];
           mh.msg_iovlen = std::min(sv.size() - si, (size_t)IOV_MAX);
-          ssize_t k = ::sendmsg(to.fd(), &mh, MSG_NOSIGNAL);
+          ssize_t k = WireSendMsg(to, &mh, sleft, &zc_pending);
           if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
               errno != EINTR)
             throw std::runtime_error("data-plane send failed");
@@ -233,6 +640,7 @@ void DataPlane::FullDuplexV(Socket& to, std::vector<iovec>& sv, Socket& from,
           }
         }
         if ((fds[i].revents & POLLIN) && rleft > 0) {
+          stat_wire_syscalls++;
           ssize_t k = ::readv(from.fd(), &rv[ri],
                               (int)std::min(rv.size() - ri, (size_t)IOV_MAX));
           if (k == 0) throw std::runtime_error("data-plane peer closed");
@@ -246,6 +654,7 @@ void DataPlane::FullDuplexV(Socket& to, std::vector<iovec>& sv, Socket& from,
         }
       }
     }
+    ReapZeroCopy(to, &zc_pending);
   } catch (...) {
     to.SetNonBlocking(false);
     if (!same) from.SetNonBlocking(false);
@@ -253,6 +662,7 @@ void DataPlane::FullDuplexV(Socket& to, std::vector<iovec>& sv, Socket& from,
   }
   to.SetNonBlocking(false);
   if (!same) from.SetNonBlocking(false);
+  stat_wire_ops++;
 }
 
 // Sub-block size for streaming a chunk_bytes receive. Auto depth (pipeline_
@@ -277,9 +687,17 @@ void DataPlane::FullDuplexStream(
     Socket& to, const void* sbuf, size_t sn, Socket& from, void* rbuf,
     size_t rn, size_t rblock,
     const std::function<void(size_t, size_t)>& on_block) {
+  if (UringReady()) {
+    std::vector<iovec> sv, rv;
+    if (sn) sv.push_back({(void*)sbuf, sn});
+    if (rn) rv.push_back({rbuf, rn});
+    UringDuplex(to, sv, from, rv, rblock, on_block);
+    return;
+  }
   const uint8_t* sp = (const uint8_t*)sbuf;
   uint8_t* rp = (uint8_t*)rbuf;
   size_t sent = 0, recvd = 0, delivered = 0;
+  int zc_pending = 0;
   bool same = to.fd() == from.fd();
   to.SetNonBlocking(true);
   if (!same) from.SetNonBlocking(true);
@@ -296,6 +714,9 @@ void DataPlane::FullDuplexStream(
         if (sent < sn) fds[nfds++] = {to.fd(), POLLOUT, 0};
         if (recvd < rn) fds[nfds++] = {from.fd(), POLLIN, 0};
       }
+      fault::Check("poll");
+      lockdep::OnBlockingSyscall("poll");
+      stat_wire_syscalls++;
       int rc = ::poll(fds, nfds, poll_timeout_ms_);
       if (rc < 0) {
         if (errno == EINTR) continue;
@@ -308,10 +729,14 @@ void DataPlane::FullDuplexStream(
             "s with no bytes moved; HVD_DATA_TIMEOUT_SECONDS to tune)");
       for (int i = 0; i < nfds; i++) {
         if ((fds[i].revents & (POLLERR | POLLHUP | POLLNVAL)) &&
-            !(fds[i].revents & (POLLIN | POLLOUT)))
+            !(fds[i].revents & (POLLIN | POLLOUT))) {
+          if (zc_pending > 0 && fds[i].fd == to.fd() &&
+              TryReapZeroCopy(to, &zc_pending) > 0)
+            continue;
           throw std::runtime_error("data-plane peer failed");
+        }
         if ((fds[i].revents & POLLOUT) && sent < sn) {
-          ssize_t k = ::send(to.fd(), sp + sent, sn - sent, MSG_NOSIGNAL);
+          ssize_t k = WireSend(to, sp + sent, sn - sent, &zc_pending);
           if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
             throw std::runtime_error("data-plane send failed");
           if (k > 0) {
@@ -320,6 +745,7 @@ void DataPlane::FullDuplexStream(
           }
         }
         if ((fds[i].revents & POLLIN) && recvd < rn) {
+          stat_wire_syscalls++;
           ssize_t k = ::recv(from.fd(), rp + recvd, rn - recvd, 0);
           if (k == 0) throw std::runtime_error("data-plane peer closed");
           if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
@@ -338,6 +764,7 @@ void DataPlane::FullDuplexStream(
         }
       }
     }
+    ReapZeroCopy(to, &zc_pending);
   } catch (...) {
     to.SetNonBlocking(false);
     if (!same) from.SetNonBlocking(false);
@@ -345,16 +772,24 @@ void DataPlane::FullDuplexStream(
   }
   to.SetNonBlocking(false);
   if (!same) from.SetNonBlocking(false);
+  stat_wire_ops++;
 }
 
 void DataPlane::FullDuplexVStream(
     Socket& to, std::vector<iovec>& sv, Socket& from, void* rbuf, size_t rn,
     size_t rblock, const std::function<void(size_t, size_t)>& on_block) {
+  if (UringReady()) {
+    std::vector<iovec> rv;
+    if (rn) rv.push_back({rbuf, rn});
+    UringDuplex(to, sv, from, rv, rblock, on_block);
+    return;
+  }
   size_t si = 0;
   while (si < sv.size() && sv[si].iov_len == 0) si++;
   size_t sleft = IovBytes(sv, si);
   uint8_t* rp = (uint8_t*)rbuf;
   size_t recvd = 0, delivered = 0;
+  int zc_pending = 0;
   bool same = to.fd() == from.fd();
   to.SetNonBlocking(true);
   if (!same) from.SetNonBlocking(true);
@@ -371,6 +806,9 @@ void DataPlane::FullDuplexVStream(
         if (sleft > 0) fds[nfds++] = {to.fd(), POLLOUT, 0};
         if (recvd < rn) fds[nfds++] = {from.fd(), POLLIN, 0};
       }
+      fault::Check("poll");
+      lockdep::OnBlockingSyscall("poll");
+      stat_wire_syscalls++;
       int rc = ::poll(fds, nfds, poll_timeout_ms_);
       if (rc < 0) {
         if (errno == EINTR) continue;
@@ -383,13 +821,17 @@ void DataPlane::FullDuplexVStream(
             "s with no bytes moved; HVD_DATA_TIMEOUT_SECONDS to tune)");
       for (int i = 0; i < nfds; i++) {
         if ((fds[i].revents & (POLLERR | POLLHUP | POLLNVAL)) &&
-            !(fds[i].revents & (POLLIN | POLLOUT)))
+            !(fds[i].revents & (POLLIN | POLLOUT))) {
+          if (zc_pending > 0 && fds[i].fd == to.fd() &&
+              TryReapZeroCopy(to, &zc_pending) > 0)
+            continue;
           throw std::runtime_error("data-plane peer failed");
+        }
         if ((fds[i].revents & POLLOUT) && sleft > 0) {
           msghdr mh = {};
           mh.msg_iov = &sv[si];
           mh.msg_iovlen = std::min(sv.size() - si, (size_t)IOV_MAX);
-          ssize_t k = ::sendmsg(to.fd(), &mh, MSG_NOSIGNAL);
+          ssize_t k = WireSendMsg(to, &mh, sleft, &zc_pending);
           if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
               errno != EINTR)
             throw std::runtime_error("data-plane send failed");
@@ -400,6 +842,7 @@ void DataPlane::FullDuplexVStream(
           }
         }
         if ((fds[i].revents & POLLIN) && recvd < rn) {
+          stat_wire_syscalls++;
           ssize_t k = ::recv(from.fd(), rp + recvd, rn - recvd, 0);
           if (k == 0) throw std::runtime_error("data-plane peer closed");
           if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
@@ -415,6 +858,7 @@ void DataPlane::FullDuplexVStream(
         }
       }
     }
+    ReapZeroCopy(to, &zc_pending);
   } catch (...) {
     to.SetNonBlocking(false);
     if (!same) from.SetNonBlocking(false);
@@ -422,6 +866,7 @@ void DataPlane::FullDuplexVStream(
   }
   to.SetNonBlocking(false);
   if (!same) from.SetNonBlocking(false);
+  stat_wire_ops++;
 }
 
 void DataPlane::RingAllreduce(void* buf, int64_t nelem, DataType dtype,
@@ -471,7 +916,9 @@ void DataPlane::RingAllreduce(void* buf, int64_t nelem, DataType dtype,
   }
 
   int64_t max_len = *std::max_element(lens.begin(), lens.end());
-  std::vector<uint8_t> tmp((size_t)max_len * esz);
+  // Persistent scratch, registered with the uring as a fixed buffer — on
+  // the batched tier each receive into it is an IORING_OP_READ_FIXED.
+  uint8_t* tmp = Scratch((size_t)max_len * esz);
 
   // Phase 1: reduce-scatter. After m-1 steps, member i owns the complete
   // reduction of chunk (i+1) mod m. When the pipeline is on, each received
@@ -483,17 +930,17 @@ void DataPlane::RingAllreduce(void* buf, int64_t nelem, DataType dtype,
     size_t rbytes = (size_t)lens[rc] * esz;
     size_t block = StreamBlockBytes(rbytes, esz);
     if (block == 0) {
-      FullDuplex(next, p + off[sc] * esz, (size_t)lens[sc] * esz, prev,
-                 tmp.data(), rbytes);
-      PoolAccumulate(p + off[rc] * esz, tmp.data(), lens[rc], dtype, op);
+      FullDuplex(next, p + off[sc] * esz, (size_t)lens[sc] * esz, prev, tmp,
+                 rbytes);
+      PoolAccumulate(p + off[rc] * esz, tmp, lens[rc], dtype, op);
       stat_serial_steps++;
     } else {
       uint8_t* dst = p + off[rc] * esz;
       FullDuplexStream(next, p + off[sc] * esz, (size_t)lens[sc] * esz, prev,
-                       tmp.data(), rbytes, block,
+                       tmp, rbytes, block,
                        [&](size_t boff, size_t blen) {
                          int64_t t0 = MonoUs();
-                         PoolAccumulate(dst + boff, tmp.data() + boff,
+                         PoolAccumulate(dst + boff, tmp + boff,
                                         (int64_t)(blen / esz), dtype, op);
                          stat_overlap_us += MonoUs() - t0;
                          stat_stream_blocks++;
@@ -530,7 +977,7 @@ void DataPlane::RingAllreduceSG(const std::vector<Segment>& in,
   auto lens = SplitChunks(nelem, m);
   auto off = Offsets(lens);
   int64_t max_len = *std::max_element(lens.begin(), lens.end());
-  std::vector<uint8_t> tmp((size_t)max_len * esz);
+  uint8_t* tmp = Scratch((size_t)max_len * esz);
   std::vector<iovec> sv, rv;
 
   // Phase 1: reduce-scatter. Each chunk is RS-touched exactly once per
@@ -548,9 +995,9 @@ void DataPlane::RingAllreduceSG(const std::vector<Segment>& in,
     size_t rbytes = (size_t)lens[rc] * esz;
     size_t block = StreamBlockBytes(rbytes, esz);
     if (block == 0) {
-      rv.push_back({tmp.data(), rbytes});
+      rv.push_back({tmp, rbytes});
       FullDuplexV(next, sv, prev, rv);
-      const uint8_t* t = tmp.data();
+      const uint8_t* t = tmp;
       ForEachSpan(in, out, off[rc], lens[rc], esz,
                   [&](uint8_t* o, const uint8_t* a, int64_t n) {
                     PoolAccumulateTo(o, a, t, n, dtype, op);
@@ -562,10 +1009,10 @@ void DataPlane::RingAllreduceSG(const std::vector<Segment>& in,
       // the streamed variant reduces each completed sub-block through the
       // same three-address first-touch spans, shifted by the block offset.
       FullDuplexVStream(
-          next, sv, prev, tmp.data(), rbytes, block,
+          next, sv, prev, tmp, rbytes, block,
           [&](size_t boff, size_t blen) {
             int64_t t0 = MonoUs();
-            const uint8_t* t = tmp.data() + boff;
+            const uint8_t* t = tmp + boff;
             ForEachSpan(in, out, off[rc] + (int64_t)(boff / esz),
                         (int64_t)(blen / esz), esz,
                         [&](uint8_t* o, const uint8_t* a, int64_t n) {
@@ -769,15 +1216,15 @@ void DataPlane::RingReduceScatter(void* work, void* out,
   Socket& next = peer(members[(my + 1) % m]);
   Socket& prev = peer(members[(my - 1 + m) % m]);
   int64_t max_len = *std::max_element(chunk_elems.begin(), chunk_elems.end());
-  std::vector<uint8_t> tmp((size_t)max_len * esz);
+  uint8_t* tmp = Scratch((size_t)max_len * esz);
   // Shifted reduce-scatter so member i finishes owning chunk i: at step s,
   // send chunk (i - s - 1) and reduce into chunk (i - s - 2).
   for (int s = 0; s < m - 1; s++) {
     int sc = ((my - s - 1) % m + m) % m;
     int rc = ((my - s - 2) % m + m) % m;
     FullDuplex(next, p + off[sc] * esz, (size_t)chunk_elems[sc] * esz, prev,
-               tmp.data(), (size_t)chunk_elems[rc] * esz);
-    PoolAccumulate(p + off[rc] * esz, tmp.data(), chunk_elems[rc], dtype, op);
+               tmp, (size_t)chunk_elems[rc] * esz);
+    PoolAccumulate(p + off[rc] * esz, tmp, chunk_elems[rc], dtype, op);
   }
   if (chunk_elems[my] > 0)
     memcpy(out, p + off[my] * esz, (size_t)chunk_elems[my] * esz);

@@ -18,6 +18,7 @@
 #include "common.h"
 #include "shm.h"
 #include "tcp.h"
+#include "wire.h"
 
 namespace hvd {
 
@@ -71,6 +72,38 @@ class DataPlane {
     shm_threshold_ = bytes < 0 ? 0 : bytes;
   }
   int64_t shm_threshold() const { return shm_threshold_; }
+
+  // Cross-host wire tier (wire.h): agreed at mesh establishment — every
+  // rank probes, the results ride the hello frame, and the coordinator
+  // broadcasts the minimum so the whole job lands on one tier. Called with
+  // collectives quiescent (background thread, right after Init): brings up
+  // or tears down the io_uring ring and arms SO_ZEROCOPY on the peer
+  // sockets. Degrades (uring -> zerocopy -> basic) instead of failing.
+  void set_wire_tier(int tier);
+  int wire_tier() const { return wire_tier_; }
+  // Minimum send-run bytes for MSG_ZEROCOPY to engage on the zerocopy tier
+  // (HVD_WIRE_ZC_THRESHOLD; page pinning below ~16 KiB costs more than the
+  // copy it saves).
+  void set_zc_threshold(int64_t bytes) {
+    zc_threshold_ = bytes < 0 ? 0 : bytes;
+  }
+  int64_t zc_threshold() const { return zc_threshold_; }
+
+  // Wire proof counters (background-thread-only, like the pipeline stats
+  // below; core.cc's WireScope snapshots deltas into Global's atomics
+  // BEFORE CompleteHandle). stat_wire_syscalls counts every syscall the
+  // duplex engines issue on ANY tier, so syscalls/op is comparable across
+  // tiers — the basic tier is the legacy baseline and must stay exactly it.
+  int64_t stat_wire_ops = 0;        // full-duplex exchanges completed
+  int64_t stat_wire_syscalls = 0;   // wait/tx/rx syscalls inside exchanges
+  int64_t stat_uring_submits = 0;   // io_uring_enter round-trips
+  int64_t stat_uring_sqes = 0;      // SQEs submitted
+  int64_t stat_uring_cqes = 0;      // completions reaped
+  int64_t stat_uring_us = 0;        // µs inside batched exchanges
+  int64_t stat_zc_sends = 0;        // MSG_ZEROCOPY sendmsgs issued
+  int64_t stat_zc_completions = 0;  // error-queue notifications reaped
+  int64_t stat_zc_copied = 0;       // completions the kernel fell back to copy
+  int64_t stat_zc_us = 0;           // µs reaping the error queue
 
   // Pipeline proof counters. Background-thread-only writes (plain int64s,
   // not atomics); core.cc snapshots deltas into Global's atomic counters
@@ -179,6 +212,36 @@ class DataPlane {
   // pipeline_; 0 means run the serial path (depth 1 or chunk too small).
   size_t StreamBlockBytes(size_t chunk_bytes, size_t esz) const;
 
+  // --- wire tier internals -------------------------------------------------
+  // Batched-submission duplex engine behind all four FullDuplex* entry
+  // points on the uring tier: one io_uring_enter both submits the
+  // send/recv SQEs and waits for completions, replacing the per-round
+  // poll+sendmsg+readv triple. rblock/on_block carry the streaming
+  // contract (only used when rv is one contiguous buffer).
+  void UringDuplex(Socket& to, std::vector<iovec>& sv, Socket& from,
+                   std::vector<iovec>& rv, size_t rblock,
+                   const std::function<void(size_t, size_t)>& on_block);
+  bool UringReady() const {
+    return wire_tier_ == wire::kUring && uring_.valid();
+  }
+  // Send helpers shared by the basic and zerocopy tiers: count the syscall,
+  // and on the zerocopy tier flag large runs MSG_ZEROCOPY (tracking the
+  // outstanding completion count in *zc_pending).
+  ssize_t WireSend(Socket& to, const void* p, size_t n, int* zc_pending);
+  ssize_t WireSendMsg(Socket& to, msghdr* mh, size_t left, int* zc_pending);
+  // Drain the error queue until every outstanding MSG_ZEROCOPY send has
+  // posted its completion — the kernel holds the pages pinned until then,
+  // so returning earlier would let callers overwrite in-flight data.
+  // TryReapZeroCopy is the non-blocking single pass it is built on (also
+  // used when the duplex poll sees a bare POLLERR, which on this tier can
+  // just mean "notifications pending").
+  void ReapZeroCopy(Socket& to, int* zc_pending);
+  int TryReapZeroCopy(Socket& to, int* zc_pending);
+  // Persistent receive scratch shared by the ring collectives; registered
+  // with the uring as fixed-buffer slot 0 so receives into it ride
+  // IORING_OP_READ_FIXED.
+  uint8_t* Scratch(size_t n);
+
   // Shm routing decision for a `bytes`-byte collective over `members`.
   // ShmRouted is the pure predicate; UseShm additionally counts a
   // covered-but-declined routing as a fallback (stat_shm_fallback).
@@ -196,11 +259,20 @@ class DataPlane {
 
   int rank_ = 0;
   int size_ = 1;
+  // True while every uring send CQE has carried its full length
+  // (MSG_WAITALL honored, 5.19+). Lets UringDuplex wait for ALL in-flight
+  // completions in one enter; the first short send flips it off for the
+  // rest of the job and the engine reverts to waking per-CQE.
+  bool uring_full_sends_ = true;
   int poll_timeout_ms_ = 300000;
   int pipeline_ = 0;
   ShmPlane shm_;
   bool shm_enabled_ = false;
   int64_t shm_threshold_ = 0;
+  int wire_tier_ = wire::kBasic;
+  int64_t zc_threshold_ = 16384;
+  wire::Uring uring_;
+  std::vector<uint8_t> scratch_;
   std::vector<Socket> peers_;
 };
 

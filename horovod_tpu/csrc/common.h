@@ -381,6 +381,10 @@ struct ResponseList {
   int8_t tuned_shm = -1;       // intra-host shared-memory plane toggle
   int8_t tuned_bucket = -1;    // backprop-ordered gradient bucketing toggle
   int8_t tuned_compress = -1;  // lossy compressed-collective codec toggle
+  // Wire-tier arm (1 = mesh-agreed batched/zerocopy tier, 0 = basic): the
+  // autotuner only explores it where the tier probe succeeded, so "off"
+  // means the legacy sendmsg path, never an unsupported tier.
+  int8_t tuned_wire = -1;
   bool tuned_locked = false;  // coordinator's search finished
   // Rank the coordinator evicted this cycle (-1 = none). Survivors abort
   // in-flight work with a retriable RankEvictedError instead of hanging in
@@ -403,6 +407,7 @@ struct ResponseList {
     w.u8((uint8_t)(tuned_shm + 1));
     w.u8((uint8_t)(tuned_bucket + 1));
     w.u8((uint8_t)(tuned_compress + 1));
+    w.u8((uint8_t)(tuned_wire + 1));
     w.u8(tuned_locked ? 1 : 0);
     w.i32(evicted_rank);
   }
@@ -425,6 +430,7 @@ struct ResponseList {
     l.tuned_shm = (int8_t)r.u8() - 1;
     l.tuned_bucket = (int8_t)r.u8() - 1;
     l.tuned_compress = (int8_t)r.u8() - 1;
+    l.tuned_wire = (int8_t)r.u8() - 1;
     l.tuned_locked = r.u8() != 0;
     l.evicted_rank = r.i32();
     return l;

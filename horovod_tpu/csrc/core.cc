@@ -38,6 +38,7 @@
 #include "auth.h"
 #include "tcp.h"
 #include "tensor_queue.h"
+#include "wire.h"
 #include "timeline.h"
 #include "reduce.h"
 
@@ -180,6 +181,35 @@ struct Global {
   // process-global (reduce.h GlobalReducePool) so the microbench can use
   // it without a job up.
   int reduce_threads = 1;
+
+  // Syscall-minimal wire plane (wire.h; docs/perf_tuning.md "Wire plane").
+  // wire_want is the HVD_WIRE request (auto = uring, the best tier),
+  // wire_probed this rank's local probe result, wire_tier the MESH-AGREED
+  // tier (rank 0 takes the minimum across ranks' probes and broadcasts it
+  // in the address-table frame, so one old kernel degrades the whole job
+  // coherently). wire_on is the autotune wire arm's live toggle: off
+  // forces the basic tier without renegotiating the mesh. numa_pin gates
+  // ReducePool lane pinning and shm segment mbind (HVD_NUMA: 0 off,
+  // 1 force, unset = only on multi-node boxes). Counters snapshot
+  // DataPlane's background-thread-only stats (PipelineScope, under the
+  // counters-before-CompleteHandle rule), readable from user threads via
+  // hvd_wire_stats.
+  int wire_want = wire::kUring;
+  int wire_probed = wire::kBasic;
+  int wire_tier = wire::kBasic;
+  bool wire_on = true;
+  bool numa_pin = false;
+  int64_t wire_probe_failures = 0;
+  std::atomic<int64_t> wire_ops_total{0};
+  std::atomic<int64_t> wire_syscalls_total{0};
+  std::atomic<int64_t> uring_submits_total{0};
+  std::atomic<int64_t> uring_sqes_total{0};
+  std::atomic<int64_t> uring_cqes_total{0};
+  std::atomic<int64_t> uring_us_total{0};
+  std::atomic<int64_t> zc_sends_total{0};
+  std::atomic<int64_t> zc_completions_total{0};
+  std::atomic<int64_t> zc_copied_total{0};
+  std::atomic<int64_t> zc_us_total{0};
 
   // Backprop-ordered gradient bucketing (tensor_queue.h BucketAssembler).
   // bucket_allowed is the HVD_BUCKET master switch (0 kills the assembler
@@ -582,6 +612,8 @@ bool UseZeroCopy(bool sg_ok, int64_t bytes, const Response& resp, int m) {
 struct PipelineScope {
   int64_t steps0, blocks0, serial0, us0;
   int64_t shm_ops0, shm_bytes0, shm_staged0, shm_fb0, shm_us0;
+  int64_t w_ops0, w_sys0, u_sub0, u_sqe0, u_cqe0, u_us0;
+  int64_t zc_send0, zc_comp0, zc_cop0, zc_us0;
   PipelineScope()
       : steps0(g->data.stat_stream_steps),
         blocks0(g->data.stat_stream_blocks),
@@ -591,9 +623,24 @@ struct PipelineScope {
         shm_bytes0(g->data.shm().stat_tx_bytes),
         shm_staged0(g->data.shm().stat_staged_copies),
         shm_fb0(g->data.stat_shm_fallback),
-        shm_us0(g->data.stat_shm_us) {}
+        shm_us0(g->data.stat_shm_us),
+        w_ops0(g->data.stat_wire_ops),
+        w_sys0(g->data.stat_wire_syscalls),
+        u_sub0(g->data.stat_uring_submits),
+        u_sqe0(g->data.stat_uring_sqes),
+        u_cqe0(g->data.stat_uring_cqes),
+        u_us0(g->data.stat_uring_us),
+        zc_send0(g->data.stat_zc_sends),
+        zc_comp0(g->data.stat_zc_completions),
+        zc_cop0(g->data.stat_zc_copied),
+        zc_us0(g->data.stat_zc_us) {}
   int64_t overlap_us() const { return g->data.stat_overlap_us - us0; }
   int64_t shm_us() const { return g->data.stat_shm_us - shm_us0; }
+  // Sizes for the wire-plane timeline sub-spans: µs this op spent inside
+  // batched io_uring submit/wait rounds (TCP_URING_BATCH) and reaping
+  // MSG_ZEROCOPY error-queue notifications (TCP_ZC_REAP).
+  int64_t uring_us() const { return g->data.stat_uring_us - u_us0; }
+  int64_t zc_us() const { return g->data.stat_zc_us - zc_us0; }
   void Publish() const {
     g->pipeline_stream_steps += g->data.stat_stream_steps - steps0;
     g->pipeline_stream_blocks += g->data.stat_stream_blocks - blocks0;
@@ -604,6 +651,16 @@ struct PipelineScope {
     g->shm_staged_total += g->data.shm().stat_staged_copies - shm_staged0;
     g->shm_fallback_total += g->data.stat_shm_fallback - shm_fb0;
     g->shm_us_total += shm_us();
+    g->wire_ops_total += g->data.stat_wire_ops - w_ops0;
+    g->wire_syscalls_total += g->data.stat_wire_syscalls - w_sys0;
+    g->uring_submits_total += g->data.stat_uring_submits - u_sub0;
+    g->uring_sqes_total += g->data.stat_uring_sqes - u_sqe0;
+    g->uring_cqes_total += g->data.stat_uring_cqes - u_cqe0;
+    g->uring_us_total += uring_us();
+    g->zc_sends_total += g->data.stat_zc_sends - zc_send0;
+    g->zc_completions_total += g->data.stat_zc_completions - zc_comp0;
+    g->zc_copied_total += g->data.stat_zc_copied - zc_cop0;
+    g->zc_us_total += zc_us();
   }
 };
 
@@ -632,6 +689,11 @@ void ExecAllreduce(const Response& resp,
       if (ps.overlap_us() > 0)
         g->timeline.Record(e.req.name, "TCP_REDUCE_OVERLAP", t0,
                            t0 + ps.overlap_us());
+      if (ps.uring_us() > 0)
+        g->timeline.Record(e.req.name, "TCP_URING_BATCH", t0,
+                           t0 + ps.uring_us());
+      if (ps.zc_us() > 0)
+        g->timeline.Record(e.req.name, "TCP_ZC_REAP", t0, t0 + ps.zc_us());
       if (post != 1.0) ScaleBuffer(e.output, n, resp.dtype, post);
       ps.Publish();
       g->zerocopy_ops_total++;
@@ -655,6 +717,11 @@ void ExecAllreduce(const Response& resp,
     if (ps.shm_us() > 0)
       g->timeline.Record(e.req.name, "TCP_SHM_EXCHANGE", t0,
                          t0 + ps.shm_us());
+    if (ps.uring_us() > 0)
+      g->timeline.Record(e.req.name, "TCP_URING_BATCH", t0,
+                         t0 + ps.uring_us());
+    if (ps.zc_us() > 0)
+      g->timeline.Record(e.req.name, "TCP_ZC_REAP", t0, t0 + ps.zc_us());
     if (post != 1.0) ScaleBuffer(e.output, n, resp.dtype, post);
     ps.Publish();
     CompleteHandle(e.handle, Status::Ok());
@@ -701,6 +768,11 @@ void ExecAllreduce(const Response& resp,
       if (ps.overlap_us() > 0)
         g->timeline.Record(e.req.name, "TCP_REDUCE_OVERLAP", t0,
                            t0 + ps.overlap_us());
+      if (ps.uring_us() > 0)
+        g->timeline.Record(e.req.name, "TCP_URING_BATCH", t0,
+                           t0 + ps.uring_us());
+      if (ps.zc_us() > 0)
+        g->timeline.Record(e.req.name, "TCP_ZC_REAP", t0, t0 + ps.zc_us());
       CompleteHandle(e.handle, Status::Ok());
     }
     return;
@@ -744,6 +816,11 @@ void ExecAllreduce(const Response& resp,
       if (ps.shm_us() > 0)
         g->timeline.Record(e.req.name, "TCP_SHM_EXCHANGE", t1,
                            t1 + ps.shm_us());
+      if (ps.uring_us() > 0)
+        g->timeline.Record(e.req.name, "TCP_URING_BATCH", t1,
+                           t1 + ps.uring_us());
+      if (ps.zc_us() > 0)
+        g->timeline.Record(e.req.name, "TCP_ZC_REAP", t1, t1 + ps.zc_us());
       g->timeline.Record(e.req.name, "MEMCPY_OUT_FUSION_BUFFER", t2, NowUs());
     }
     off += n;
@@ -1135,10 +1212,10 @@ void AutotuneCycle(ResponseList& rl) {
     int64_t fusion;
     double cycle_ms;
     int cache_on, hier_on, zerocopy_on, pipeline_on, shm_on, bucket_on,
-        compress_on;
+        compress_on, wire_on;
     if (g->autotune.Record(PayloadBytes(rl), NowUs(), &fusion, &cycle_ms,
                            &cache_on, &hier_on, &zerocopy_on, &pipeline_on,
-                           &shm_on, &bucket_on, &compress_on)) {
+                           &shm_on, &bucket_on, &compress_on, &wire_on)) {
       rl.tuned_fusion = fusion;
       rl.tuned_cycle_ms = cycle_ms;
       rl.tuned_cache = (int8_t)cache_on;
@@ -1148,6 +1225,7 @@ void AutotuneCycle(ResponseList& rl) {
       rl.tuned_shm = (int8_t)shm_on;
       rl.tuned_bucket = (int8_t)bucket_on;
       rl.tuned_compress = (int8_t)compress_on;
+      rl.tuned_wire = (int8_t)wire_on;
     }
   }
   rl.tuned_locked = !g->autotune.active();
@@ -1193,6 +1271,15 @@ void ProcessResponseList(ResponseList& rl) {
   if (rl.tuned_compress >= 0 && g->compress_allowed.load())
     g->compress_live.store(rl.tuned_compress != 0 ? g->compress_cfg.load()
                                                   : 0);
+  // The wire arm flips between the mesh-agreed tier and basic. Stateless:
+  // the uring ring stays set up across flips (only the dispatch branch
+  // changes) and zerocopy is a per-send decision, so adoption is up front
+  // and identical on every rank. The arm only exists where the probe
+  // succeeded, so "on" never asks for an unsupported tier.
+  if (rl.tuned_wire >= 0 && g->wire_tier > wire::kBasic) {
+    g->wire_on = rl.tuned_wire != 0;
+    g->data.set_wire_tier(g->wire_on ? g->wire_tier : wire::kBasic);
+  }
   if (rl.tuned_locked && g->autotune.enabled()) g->autotune.SetDone();
   if (CacheOn()) {
     for (uint32_t b : rl.evict_bits) {
@@ -1493,6 +1580,11 @@ void EstablishMesh() {
     ports[0] = g->data_listener.port();
     bool hier_ok = topo_ok(0, g->local_rank, g->local_size, g->cross_rank,
                            g->cross_size, g->topo_explicit);
+    // Mesh wire-tier agreement: every hello advertises the worker's local
+    // probe result and rank 0 takes the MINIMUM (tier order = capability
+    // order, wire.h), so one kernel without io_uring degrades the whole
+    // job coherently instead of split-braining the data plane.
+    int wire_min = g->wire_probed;
     // Accept until every worker rank has a live, authenticated hello.
     // Unauthenticated peers, garbage frames, and half-open connections
     // from a dying epoch are dropped without aborting init; a worker
@@ -1521,8 +1613,10 @@ void EstablishMesh() {
         int dport = rd.i32();
         int lr = rd.i32(), ls = rd.i32(), cr = rd.i32(), cs = rd.i32();
         int ex = rd.i32();
+        int wp = rd.i32();
         if (r <= 0 || r >= g->size) continue;  // not a worker hello
         if (!topo_ok(r, lr, ls, cr, cs, ex != 0)) hier_ok = false;
+        if (wp < wire_min) wire_min = wp;
         hosts[r] = PeerAddr(s);
         ports[r] = dport;
         s.SetRecvTimeout(0);  // registered: back to blocking control IO
@@ -1552,6 +1646,8 @@ void EstablishMesh() {
     // (the same hit bit expanding to different tensors on different ranks).
     w.i64(g->cache.capacity());
     w.u8(g->hier_ok ? 1 : 0);
+    g->wire_tier = wire_min < 0 ? wire::kBasic : wire_min;
+    w.u8((uint8_t)g->wire_tier);
     for (int r = 1; r < g->size; r++) g->workers[r].SendFrame(w.buf);
   } else {
     // Worker rendezvous with in-library retry: the connect can land on
@@ -1576,6 +1672,7 @@ void EstablishMesh() {
         w.i32(g->cross_rank);
         w.i32(g->cross_size);
         w.i32(g->topo_explicit ? 1 : 0);
+        w.i32(g->wire_probed);
         c.SendFrame(w.buf);
         auto frame = c.RecvFrame();
         Reader rd(frame.data(), frame.size());
@@ -1592,6 +1689,7 @@ void EstablishMesh() {
           g->cache.Configure(cap);
         }
         g->hier_ok = rd.u8() != 0;
+        g->wire_tier = rd.u8();
         c.SetRecvTimeout(0);  // rendezvous done: blocking control IO
         g->to_coordinator = std::move(c);
         break;
@@ -1670,6 +1768,15 @@ void EstablishMesh() {
   if (accept_err) std::rethrow_exception(accept_err);
   g->data.Init(g->rank, g->size, std::move(peers));
 
+  // Adopt the mesh-agreed wire tier now that the peer sockets exist (the
+  // zerocopy tier flips SO_ZEROCOPY on each of them; the uring tier brings
+  // up the ring and registers the receive scratch).
+  if (g->wire_tier < g->wire_probed)
+    LogF(LogLevel::kInfo,
+         "wire tier degraded to %s by mesh agreement (this rank probed %s)",
+         wire::TierName(g->wire_tier), wire::TierName(g->wire_probed));
+  g->data.set_wire_tier(g->wire_tier);
+
   // Intra-host shm plane: each rank of a same-host block (the validated
   // host-major slice [host*L, (host+1)*L), or the whole job when it is a
   // single host) maps its peers' ring segments. Requires the
@@ -1691,6 +1798,10 @@ void EstablishMesh() {
       std::string tag = "hvd-shm:" + ctrl;
       key = Sha256((const uint8_t*)tag.data(), tag.size());
     }
+    // NUMA-pin the segment to this rank's lane node (same round-robin as
+    // the reduce pool, so a lane reduces out of node-local slots).
+    if (g->numa_pin)
+      g->data.shm().set_numa_node(g->local_rank % numa::NodeCount());
     if (!g->data.shm().Init(g->rank, host_ranks, key, ctrl,
                             g->shm_slot_bytes, g->shm_nslots,
                             std::max(remaining(), 5.0)))
@@ -1864,7 +1975,34 @@ int hvd_init() {
     if (def_lanes > 4) def_lanes = 4;
     int64_t lanes = EnvInt("HVD_REDUCE_THREADS", def_lanes);
     g->reduce_threads = (int)(lanes < 1 ? 1 : lanes);
-    GlobalReducePool().Configure(g->reduce_threads);
+    // Wire plane: HVD_WIRE forces a tier ("uring" | "zerocopy" | "basic");
+    // "auto" (the default) asks for the best one and lets the runtime
+    // probe degrade. The probe runs here so the result can ride this
+    // rank's mesh hello; HVD_WIRE_PROBE_FAIL is a bitmask test hook that
+    // makes named rungs pretend to fail (1<<2 uring, 1<<1 zerocopy).
+    // HVD_WIRE_ZC_THRESHOLD (bytes) sets where zerocopy-tier sends start
+    // carrying MSG_ZEROCOPY (page pinning beats copying only for large
+    // buffers). HVD_NUMA pins reduce lanes + shm segments to nodes:
+    // 0 off, 1 force, unset = only on multi-node boxes.
+    {
+      std::string want = EnvStr("HVD_WIRE", "auto");
+      int tier = wire::TierFromName(want.c_str());
+      if (tier < 0 && want != "auto" && !want.empty())
+        LogF(LogLevel::kWarn,
+             "HVD_WIRE=%s unknown (want auto|uring|zerocopy|basic); "
+             "using auto",
+             want.c_str());
+      g->wire_want = tier < 0 ? wire::kUring : tier;
+      g->data.set_zc_threshold(EnvInt("HVD_WIRE_ZC_THRESHOLD", 16384));
+      g->wire_probed =
+          wire::Probe(g->wire_want, (int)EnvInt("HVD_WIRE_PROBE_FAIL", 0),
+                      &g->wire_probe_failures);
+      g->wire_tier = g->wire_probed;  // refined to the mesh MIN in
+                                      // EstablishMesh when size > 1
+      int64_t numa_env = EnvInt("HVD_NUMA", -1);
+      g->numa_pin = numa_env < 0 ? numa::NodeCount() > 1 : numa_env != 0;
+    }
+    GlobalReducePool().Configure(g->reduce_threads, g->numa_pin);
     // Reduce-kernel tier: HVD_REDUCE_VECTOR=0 pins the scalar baseline
     // (the bench's A/B switch); default is the vectorized tier.
     ReduceVectorFlag().store(EnvInt("HVD_REDUCE_VECTOR", 1) != 0,
@@ -1900,6 +2038,7 @@ int hvd_init() {
         /*init_shm=*/g->data.shm_enabled(),
         /*init_bucket=*/g->queue.bucket_enabled(),
         /*init_compress=*/g->compress_live.load() != 0,
+        /*init_wire=*/g->wire_tier > wire::kBasic,
         /*can_toggle_cache=*/g->cache.enabled(),
         // On a single host the hierarchical arm only pays off when the
         // local phase actually rides shm — without the plane it degrades
@@ -1921,7 +2060,12 @@ int hvd_init() {
         // (HVD_COMPRESS=int8|topk) and a peer exists to move bytes to;
         // unset/0 keeps the arm out of the sweep AND the wire
         // byte-identical.
-        /*can_toggle_compress=*/g->compress_allowed.load() && g->size > 1);
+        /*can_toggle_compress=*/g->compress_allowed.load() && g->size > 1,
+        // The wire arm exists only where the mesh agreed on a tier above
+        // basic — on kernels where the probe failed (or HVD_WIRE=basic)
+        // both arm settings would measure the identical sendmsg path.
+        /*can_toggle_wire=*/g->wire_tier > wire::kBasic && g->size > 1,
+        /*affinity=*/numa::AffinityString());
     double data_tmo = EnvDouble("HVD_DATA_TIMEOUT_SECONDS", -1.0);
     if (data_tmo <= 0) {
       data_tmo = 300.0;
@@ -2632,6 +2776,57 @@ int64_t hvd_lockdep_selftest() {
     std::lock_guard<DebugMutex> la(a);
   }
   return lockdep::State::Get().cycles.load(std::memory_order_relaxed);
+}
+
+// Wire-plane observability (docs/perf_tuning.md "Syscall-minimal wire
+// plane"): full-duplex exchanges completed, total blocking syscalls the
+// data plane issued for them (poll + sendmsg + readv rounds on the basic
+// tier; one io_uring_enter per batch on the uring tier — syscalls/ops is
+// THE tentpole metric), io_uring batch anatomy (submits, SQEs, CQEs, µs
+// inside batched exchanges), and MSG_ZEROCOPY send/reap counts (copied =
+// completions where the kernel fell back to copying). All uring/zc
+// counters stay 0 on the basic tier — the kill-switch proof.
+int hvd_wire_stats(int64_t* ops, int64_t* syscalls, int64_t* uring_submits,
+                   int64_t* uring_sqes, int64_t* uring_cqes,
+                   int64_t* uring_us, int64_t* zc_sends,
+                   int64_t* zc_completions, int64_t* zc_copied,
+                   int64_t* zc_us) {
+  if (!g || !g->initialized) return -1;
+  if (ops) *ops = g->wire_ops_total.load(std::memory_order_relaxed);
+  if (syscalls)
+    *syscalls = g->wire_syscalls_total.load(std::memory_order_relaxed);
+  if (uring_submits)
+    *uring_submits = g->uring_submits_total.load(std::memory_order_relaxed);
+  if (uring_sqes)
+    *uring_sqes = g->uring_sqes_total.load(std::memory_order_relaxed);
+  if (uring_cqes)
+    *uring_cqes = g->uring_cqes_total.load(std::memory_order_relaxed);
+  if (uring_us) *uring_us = g->uring_us_total.load(std::memory_order_relaxed);
+  if (zc_sends) *zc_sends = g->zc_sends_total.load(std::memory_order_relaxed);
+  if (zc_completions)
+    *zc_completions = g->zc_completions_total.load(std::memory_order_relaxed);
+  if (zc_copied)
+    *zc_copied = g->zc_copied_total.load(std::memory_order_relaxed);
+  if (zc_us) *zc_us = g->zc_us_total.load(std::memory_order_relaxed);
+  return 0;
+}
+
+// Current wire-plane state: returns -1 uninitialized, else the LIVE tier
+// (0 basic, 1 zerocopy, 2 uring — the autotune wire arm may force basic
+// below the mesh agreement). *probed gets this rank's local probe result,
+// *agreed the mesh-agreed tier, *probe_failures the probe rungs that had
+// to degrade (the HVD_WIRE_PROBE_FAIL fallback tests read it), and
+// *pinned_lanes how many reduce lanes were NUMA-pinned (HVD_NUMA).
+int hvd_wire_state(int64_t* probed, int64_t* agreed, int64_t* probe_failures,
+                   int64_t* pinned_lanes) {
+  if (!g || !g->initialized) return -1;
+  if (probed) *probed = g->wire_probed;
+  if (agreed) *agreed = g->wire_tier;
+  if (probe_failures) *probe_failures = g->wire_probe_failures;
+  if (pinned_lanes)
+    *pinned_lanes =
+        GlobalReducePool().pinned_lanes.load(std::memory_order_relaxed);
+  return g->data.wire_tier();
 }
 
 int hvd_mpi_threads_supported() { return 0; }

@@ -28,6 +28,7 @@
 
 #include "common.h"
 #include "debug_lock.h"
+#include "wire.h"  // numa:: lane placement
 
 namespace hvd {
 
@@ -651,8 +652,12 @@ class ReducePool {
 
   ~ReducePool() { Configure(0); }
 
-  // (Re)size to `threads` total lanes; <= 1 runs everything inline.
-  void Configure(int threads) {
+  // (Re)size to `threads` total lanes; <= 1 runs everything inline. With
+  // `numa_pin` (HVD_NUMA), worker lane i is pinned to the CPUs of NUMA
+  // node i % nodes, so the accumulate spans a lane touches stay on the
+  // memory its lane is nearest to. Best-effort: a rejected affinity call
+  // leaves the lane floating.
+  void Configure(int threads, bool numa_pin = false) {
     {
       std::unique_lock<DebugMutex> lk(mu_);
       stop_ = true;
@@ -660,14 +665,20 @@ class ReducePool {
     }
     for (auto& t : workers_) t.join();
     workers_.clear();
+    pinned_lanes.store(0, std::memory_order_relaxed);
     {
       std::unique_lock<DebugMutex> lk(mu_);
       stop_ = false;
       queue_.clear();
       threads_.store(threads < 1 ? 1 : threads, std::memory_order_relaxed);
     }
+    int nodes = numa_pin ? numa::NodeCount() : 1;
     for (int i = 0; i < threads_.load(std::memory_order_relaxed) - 1; i++)
-      workers_.emplace_back([this] { WorkerLoop(); });
+      workers_.emplace_back([this, i, numa_pin, nodes] {
+        if (numa_pin && numa::PinThisThread(numa::NodeCpus(i % nodes)))
+          pinned_lanes.fetch_add(1, std::memory_order_relaxed);
+        WorkerLoop();
+      });
   }
 
   int threads() const { return threads_.load(std::memory_order_relaxed); }
@@ -706,9 +717,11 @@ class ReducePool {
   }
 
   // Proof counters (hvd_reduce_pool_stats): pooled dispatches and the
-  // spans that actually ran on worker threads.
+  // spans that actually ran on worker threads. pinned_lanes counts the
+  // workers whose NUMA affinity call succeeded (hvd_wire_state).
   std::atomic<int64_t> jobs{0};
   std::atomic<int64_t> spans{0};
+  std::atomic<int64_t> pinned_lanes{0};
 
  private:
   struct Item {

@@ -18,6 +18,7 @@
 #include "auth.h"
 #include "debug_lock.h"
 #include "logging.h"
+#include "wire.h"  // numa::BindMemory
 
 namespace hvd {
 
@@ -196,6 +197,9 @@ bool ShmPlane::Init(int rank, const std::vector<int>& host_ranks,
     return false;
   }
   segments_[my_index_] = Segment{base, seg_len};
+  // Bind our outbox to this rank's NUMA node (HVD_NUMA) before first touch,
+  // so the pages the local peers read land next to the writer. Best-effort.
+  if (numa_node_ >= 0) numa::BindMemory(base, seg_len, numa_node_);
   Header* h = new (base) Header();
   h->magic = kMagic;
   h->version = kVersion;

@@ -57,6 +57,11 @@ class ShmPlane {
             const std::vector<uint8_t>& key, const std::string& job_tag,
             int64_t slot_bytes, int nslots, double timeout_s);
 
+  // NUMA node to mbind this rank's own segment to (HVD_NUMA); -1 leaves
+  // placement to first-touch. Set before Init; best-effort.
+  void set_numa_node(int node) { numa_node_ = node; }
+  int numa_node() const { return numa_node_; }
+
   // Unmap everything (and defensively unlink our own name). Idempotent.
   void Shutdown();
 
@@ -104,6 +109,7 @@ class ShmPlane {
   std::string my_name_;            // our /dev/shm name (for defensive unlink)
   int64_t slot_bytes_ = 0;
   int nslots_ = 0;
+  int numa_node_ = -1;
 };
 
 }  // namespace hvd

@@ -3,6 +3,7 @@
 #include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
+#include <limits.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <poll.h>
@@ -17,6 +18,12 @@
 #include <thread>
 
 #include "debug_lock.h"
+
+// Kernels since 4.14 accept SO_ZEROCOPY even when an older libc's headers
+// don't spell it; the constant is stable Linux ABI.
+#if defined(__linux__) && !defined(SO_ZEROCOPY)
+#define SO_ZEROCOPY 60
+#endif
 
 namespace hvd {
 
@@ -76,9 +83,11 @@ Socket& Socket::operator=(Socket&& o) noexcept {
   if (this != &o) {
     Close();
     fd_ = o.fd_;
+    zerocopy_ = o.zerocopy_;
     tx_.store(o.tx_.load(std::memory_order_relaxed),
               std::memory_order_relaxed);
     o.fd_ = -1;
+    o.zerocopy_ = false;
   }
   return *this;
 }
@@ -103,6 +112,17 @@ void Socket::SetNonBlocking(bool on) {
     fcntl(fd_, F_SETFL, flags | O_NONBLOCK);
   else
     fcntl(fd_, F_SETFL, flags & ~O_NONBLOCK);
+}
+
+bool Socket::EnableZeroCopy() {
+#ifdef SO_ZEROCOPY
+  int one = 1;
+  zerocopy_ =
+      setsockopt(fd_, SOL_SOCKET, SO_ZEROCOPY, &one, sizeof(one)) == 0;
+#else
+  zerocopy_ = false;
+#endif
+  return zerocopy_;
 }
 
 void Socket::SendAll(const void* buf, size_t n) {
@@ -139,9 +159,33 @@ void Socket::RecvAll(void* buf, size_t n) {
 }
 
 void Socket::SendFrame(const std::vector<uint8_t>& payload) {
+  // Length prefix + payload coalesced into ONE sendmsg — the two-call form
+  // paid two syscalls per negotiation frame, every cycle. A short send
+  // (signal race or a full socket buffer) finishes through SendAll.
   uint32_t len = (uint32_t)payload.size();
-  SendAll(&len, 4);
-  if (len) SendAll(payload.data(), len);
+  iovec iov[2] = {{&len, 4}, {(void*)(len ? payload.data() : nullptr), len}};
+  msghdr mh = {};
+  mh.msg_iov = iov;
+  mh.msg_iovlen = len ? 2 : 1;
+  size_t sent = 0;
+  while (true) {
+    fault::Check("send");
+    lockdep::OnBlockingSyscall("send");
+    ssize_t k = ::sendmsg(fd_, &mh, MSG_NOSIGNAL);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      throw_errno("sendmsg");
+    }
+    tx_ += (uint64_t)k;
+    sent = (size_t)k;
+    break;
+  }
+  if (sent < 4) {
+    SendAll((const uint8_t*)&len + sent, 4 - sent);
+    sent = 4;
+  }
+  if (sent - 4 < len)
+    SendAll(payload.data() + (sent - 4), len - (sent - 4));
 }
 
 void Socket::CheckFrameLen(uint32_t len) {
@@ -386,8 +430,15 @@ bool Listener::AcceptTimeout(double sec, Socket* out) {
   pollfd p{};
   p.fd = fd_;
   p.events = POLLIN;
+  // Clamp the ms conversion: a large timeout (e.g. an hour-scale start
+  // window) overflows `(int)(sec * 1000)` into UB / a negative value that
+  // poll(2) reads as "block forever"; a negative input must mean "expired",
+  // not "infinite".
+  double ms = sec * 1000.0;
+  int timeout_ms = ms <= 0 ? 0 : (ms >= (double)INT_MAX ? INT_MAX : (int)ms);
+  fault::Check("poll");
   lockdep::OnBlockingSyscall("poll");
-  int rc = ::poll(&p, 1, (int)(sec * 1000));
+  int rc = ::poll(&p, 1, timeout_ms);
   if (rc == 0) return false;
   if (rc < 0) {
     if (errno == EINTR) return false;
@@ -399,6 +450,7 @@ bool Listener::AcceptTimeout(double sec, Socket* out) {
 
 Socket Listener::Accept() {
   while (true) {
+    fault::Check("accept");
     lockdep::OnBlockingSyscall("accept");
     int fd = ::accept(fd_, nullptr, nullptr);
     if (fd < 0) {
@@ -435,6 +487,7 @@ Socket ConnectRetry(const std::string& host, int port, double timeout_sec) {
     int rc = getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res);
     if (rc == 0) {
       int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      fault::Check("connect");
       lockdep::OnBlockingSyscall("connect");
       if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
         freeaddrinfo(res);

@@ -21,8 +21,10 @@ class Socket {
   Socket(const Socket&) = delete;
   Socket& operator=(const Socket&) = delete;
   Socket(Socket&& o) noexcept
-      : fd_(o.fd_), tx_(o.tx_.load(std::memory_order_relaxed)) {
+      : fd_(o.fd_), zerocopy_(o.zerocopy_),
+        tx_(o.tx_.load(std::memory_order_relaxed)) {
     o.fd_ = -1;
+    o.zerocopy_ = false;
   }
   Socket& operator=(Socket&& o) noexcept;
   ~Socket() { Close(); }
@@ -62,6 +64,13 @@ class Socket {
   // blocking mode on the way out.
   void SetNonBlocking(bool on);
 
+  // Arm SO_ZEROCOPY (wire.h kZeroCopy tier): subsequent sends may carry
+  // MSG_ZEROCOPY and the kernel posts completion notifications on the
+  // error queue. Returns false (and leaves the socket plain) on kernels
+  // without the option; callers then stay on the basic tier.
+  bool EnableZeroCopy();
+  bool zerocopy() const { return zerocopy_; }
+
   // Wire-byte accounting (payload sent on this socket). Written by the
   // background IO thread, read by user threads (hvd_peer_tx_bytes) — so
   // atomic, relaxed: a count, not a synchronization point. Lets tests and
@@ -72,6 +81,7 @@ class Socket {
 
  private:
   int fd_;
+  bool zerocopy_ = false;
   std::atomic<uint64_t> tx_{0};
 };
 

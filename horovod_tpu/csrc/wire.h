@@ -1,0 +1,165 @@
+// wire.h — syscall-minimal cross-host wire plane: tier probe, a raw-syscall
+// io_uring wrapper, and NUMA placement helpers.
+//
+// The data plane's hot path (collectives.cc FullDuplex*) historically paid
+// three syscalls per readiness round (poll + sendmsg + readv). This module
+// supplies the two cheaper tiers it can ride instead:
+//
+//   kUring    — batched submission: one io_uring_enter both submits the
+//               send/recv SQEs over the segmented-iovec ring AND waits for
+//               completions, with the persistent receive scratch registered
+//               as a fixed buffer (IORING_OP_READ_FIXED).
+//   kZeroCopy — the classic poll loop, but large sends carry MSG_ZEROCOPY
+//               and completions are reaped from the socket error queue, so
+//               the kernel pins user pages instead of copying them.
+//   kBasic    — today's poll/sendmsg/readv path, unchanged.
+//
+// Tiers are probed at runtime (Probe) during mesh establishment and the
+// result rides the hello frame so every rank lands on the same tier; a
+// kernel without io_uring (or a seccomp policy denying it) degrades
+// gracefully: uring -> zerocopy -> basic. No liburing: the ring is driven
+// through raw io_uring_setup/enter/register syscalls, and the whole module
+// compiles to stubs (Probe == kBasic) on toolchains without
+// <linux/io_uring.h>.
+//
+// No getenv here (hvdlint raw-getenv): HVD_WIRE / HVD_WIRE_ZC_THRESHOLD /
+// HVD_NUMA are parsed in core.cc and passed down.
+#pragma once
+
+#include <sys/socket.h>
+#include <sys/uio.h>
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvd {
+namespace wire {
+
+// Tier order doubles as capability order: the mesh agreement takes the
+// MINIMUM across ranks, so one old kernel degrades the whole job coherently.
+enum Tier { kBasic = 0, kZeroCopy = 1, kUring = 2 };
+
+const char* TierName(int tier);           // "basic" / "zerocopy" / "uring"
+int TierFromName(const char* name);       // -1 for "auto"/unknown
+
+// Probe the best supported tier <= `want` on this kernel. `deny_mask` is a
+// bit-per-tier test hook ((1 << kUring) pretends io_uring returned ENOSYS)
+// so the fallback ladder is exercisable on kernels that support everything;
+// it rides HVD_WIRE_PROBE_FAIL. `probe_failures` (optional) counts the
+// rungs that had to degrade.
+int Probe(int want, int deny_mask, int64_t* probe_failures);
+
+// --- raw-syscall io_uring --------------------------------------------------
+
+// Minimal single-issuer ring: one background thread submits and reaps, which
+// is exactly the data plane's threading model. Supports the four SQE shapes
+// the duplex engine needs (SENDMSG, RECV, RECVMSG, READ_FIXED) plus one
+// registered buffer slot for the persistent receive scratch.
+class Uring {
+ public:
+  Uring() = default;
+  ~Uring() { Close(); }
+  Uring(const Uring&) = delete;
+  Uring& operator=(const Uring&) = delete;
+
+  // False when the kernel lacks io_uring or the features the engine needs
+  // (EXT_ARG bounded waits); the caller then stays on a lower tier.
+  bool Init(unsigned entries);
+  void Close();
+  bool valid() const { return fd_ >= 0; }
+
+  // Register `buf` as fixed-buffer slot 0 (replacing any previous
+  // registration). Best-effort: on failure the engine falls back to READV.
+  bool RegisterScratch(void* buf, size_t len);
+  bool scratch_registered() const { return scratch_registered_; }
+  void* scratch_base() const { return scratch_base_; }
+  size_t scratch_len() const { return scratch_len_; }
+
+  // SQE pushers; false when the submission queue is full (submit first).
+  // `flags` on the receive shapes are MSG_* recv flags — MSG_WAITALL makes
+  // the kernel retry short receives internally so a whole chunk lands in
+  // one completion. `link` sets IOSQE_IO_LINK: the next pushed SQE starts
+  // only after this one succeeds — the ordering guarantee that lets the
+  // duplex engine arm a whole chain of sequential receives in ONE submit.
+  // `async` sets IOSQE_ASYNC: skip the inline nonblocking attempt and run
+  // the op blocking on a kernel worker — a multi-MB send then completes as
+  // ONE CQE instead of a partial-progress resubmit cycle.
+  bool PushSendmsg(int fd, const msghdr* mh, uint64_t user_data,
+                   bool async = false);
+  bool PushRecv(int fd, void* buf, unsigned len, int flags,
+                uint64_t user_data, bool link = false);
+  bool PushRecvmsg(int fd, msghdr* mh, int flags, uint64_t user_data);
+  bool PushReadFixed(int fd, void* buf, unsigned len, uint64_t user_data);
+
+  // Submit every pushed SQE and wait up to timeout_ms for >= wait_nr
+  // completions — ONE syscall for the whole batch (IORING_ENTER_GETEVENTS +
+  // EXT_ARG timeout). Returns the number of SQEs consumed, or -errno.
+  int SubmitAndWait(unsigned wait_nr, int timeout_ms);
+
+  // Pop one completion; false when the CQ is empty.
+  bool PopCompletion(uint64_t* user_data, int32_t* res);
+
+  // Free SQE slots right now (capacity minus pushed-or-inflight entries);
+  // bounds how long a receive chain one submit can carry.
+  unsigned SqRoom() const;
+
+ private:
+  int fd_ = -1;
+  unsigned entries_ = 0;
+  unsigned pending_ = 0;  // pushed but not yet submitted
+  bool scratch_registered_ = false;
+  void* scratch_base_ = nullptr;
+  size_t scratch_len_ = 0;
+  // Ring mappings (SINGLE_MMAP kernels share one for SQ+CQ).
+  void* sq_ring_ = nullptr;
+  size_t sq_ring_len_ = 0;
+  void* cq_ring_ = nullptr;
+  size_t cq_ring_len_ = 0;
+  void* sqe_mem_ = nullptr;
+  size_t sqe_mem_len_ = 0;
+  // Mapped ring pointers (null when !valid()).
+  unsigned* sq_head_ = nullptr;
+  unsigned* sq_tail_ = nullptr;
+  unsigned* sq_mask_ = nullptr;
+  unsigned* sq_array_ = nullptr;
+  unsigned* cq_head_ = nullptr;
+  unsigned* cq_tail_ = nullptr;
+  unsigned* cq_mask_ = nullptr;
+  void* cqes_ = nullptr;
+  void* sqes_ = nullptr;
+
+  void* NextSqe();  // nullptr when the SQ is full
+};
+
+}  // namespace wire
+
+// --- NUMA placement --------------------------------------------------------
+// Explicit placement for the host plane: ReducePool lanes get pinned to
+// CPUs round-robined across nodes, and shm segments get mbind()-ed to their
+// owner's node. All best-effort — a kernel without NUMA (or a cpuset that
+// forbids the target CPU) leaves placement to the scheduler, never fails
+// the job.
+namespace numa {
+
+// Online NUMA node count (>= 1; 1 on non-NUMA boxes and where sysfs is
+// unreadable).
+int NodeCount();
+
+// CPUs of `node` per sysfs, intersected with this process's affinity mask;
+// falls back to the full affinity mask when sysfs is unreadable.
+std::vector<int> NodeCpus(int node);
+
+// Pin the calling thread to `cpus`; false if the set is empty or rejected.
+bool PinThisThread(const std::vector<int>& cpus);
+
+// Bind [p, p+len) to `node` (raw __NR_mbind, MPOL_BIND). Best-effort.
+bool BindMemory(void* p, size_t len, int node);
+
+// Compact, comma-free description of this process's CPU affinity for the
+// autotune CSV ("0-3" or "0-3.8-11"; "?" when unreadable).
+std::string AffinityString();
+
+}  // namespace numa
+}  // namespace hvd

@@ -430,11 +430,34 @@ ELASTIC_PROMOTIONS = gauge(
     "hvd_elastic_promotions",
     "Hot-spare promotions the driver reported (spare swapped in for an "
     "evicted/dead rank via an incremental epoch)")
+WIRE_TIER = gauge(
+    "hvd_wire_tier",
+    "Live cross-host wire tier (0 basic, 1 zerocopy, 2 uring — HVD_WIRE "
+    "probe + mesh agreement, possibly forced to basic by the autotune "
+    "wire arm)")
+WIRE_OPS = gauge(
+    "hvd_wire_ops",
+    "Full-duplex wire exchanges completed by the data plane")
+WIRE_SYSCALLS = gauge(
+    "hvd_wire_syscalls",
+    "Blocking syscalls the data plane issued inside wire exchanges "
+    "(poll/sendmsg/readv rounds on the basic tier, one io_uring_enter "
+    "per batch on the uring tier; syscalls-per-op is the batching proof)")
+WIRE_URING_SUBMITS = gauge(
+    "hvd_wire_uring_submits",
+    "io_uring_enter round-trips on the uring tier (each submits AND "
+    "reaps a whole SQE batch)")
+WIRE_ZC_SENDS = gauge(
+    "hvd_wire_zc_sends",
+    "Sends issued with MSG_ZEROCOPY on the zerocopy tier")
+WIRE_PINNED_LANES = gauge(
+    "hvd_wire_pinned_lanes",
+    "Reduce-pool lanes NUMA-pinned under HVD_NUMA")
 
 
 def sample_core_stats(hvd=None):
-    """Snapshot the core's ring-pipeline, shm-plane, reduce-pool, and
-    reduce-kernel counters into the gauge families above. Call after
+    """Snapshot the core's ring-pipeline, shm-plane, reduce-pool,
+    reduce-kernel, and wire-plane counters into the gauge families above. Call after
     synchronize() (or any quiesce point); cheap, so callers may sample per
     step. `hvd` defaults to the horovod_tpu package (parameter for
     tests)."""
@@ -460,6 +483,14 @@ def sample_core_stats(hvd=None):
     ELASTIC_EVICTIONS.set(es["evictions"])
     ELASTIC_KV_RETRIES.set(es["kv_retries"])
     ELASTIC_PROMOTIONS.set(es.get("promotions", 0))
+    ws = hvd.wire_stats()
+    WIRE_OPS.set(ws["ops"])
+    WIRE_SYSCALLS.set(ws["syscalls"])
+    WIRE_URING_SUBMITS.set(ws["uring_submits"])
+    WIRE_ZC_SENDS.set(ws["zc_sends"])
+    live, _, _, _, pinned = hvd.wire_state()
+    WIRE_TIER.set({"basic": 0, "zerocopy": 1, "uring": 2}[live])
+    WIRE_PINNED_LANES.set(pinned)
 
 
 def record_call(op, seconds, nbytes, process_set=0):

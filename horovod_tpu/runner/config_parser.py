@@ -13,6 +13,9 @@ YAML schema (any subset):
       start-timeout: 120
       log-level: info
       peer-timeout-ms: 2000
+      wire: auto
+      wire-zc-threshold: 16384
+      numa: 1
     timeline:
       filename: /tmp/tl.json
       mark-cycles: true
@@ -46,6 +49,9 @@ ARG_TO_ENV = {
     "reduce_threads": ("HVD_REDUCE_THREADS", lambda v: str(int(v))),
     "compression": ("HVD_COMPRESS", str),
     "topk_frac": ("HVD_COMPRESS_TOPK_FRAC", lambda v: str(float(v))),
+    "wire": ("HVD_WIRE", str),
+    "wire_zc_threshold": ("HVD_WIRE_ZC_THRESHOLD", lambda v: str(int(v))),
+    "numa": ("HVD_NUMA", lambda v: str(int(v))),
     "timeline_filename": ("HVD_TIMELINE", str),
     "timeline_mark_cycles": ("HVD_TIMELINE_MARK_CYCLES",
                              lambda v: "1" if v else "0"),
@@ -78,6 +84,9 @@ _FILE_SECTIONS = {
                "reduce-threads": "reduce_threads",
                "compression": "compression",
                "topk-frac": "topk_frac",
+               "wire": "wire",
+               "wire-zc-threshold": "wire_zc_threshold",
+               "numa": "numa",
                "start-timeout": "start_timeout",
                "log-level": "log_level",
                "peer-timeout-ms": "peer_timeout_ms"},

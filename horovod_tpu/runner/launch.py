@@ -105,6 +105,29 @@ def parse_args(argv=None):
                    help="reduce worker-pool lanes (HVD_REDUCE_THREADS): 1 "
                         "runs reductions inline, N>1 shards large "
                         "reductions across N-1 workers plus the caller")
+    p.add_argument("--wire", dest="wire",
+                   choices=["auto", "uring", "zerocopy", "basic"],
+                   default=None,
+                   help="cross-host wire tier (HVD_WIRE): auto probes the "
+                        "best supported one at init (uring > zerocopy > "
+                        "basic) and the mesh agrees on the minimum across "
+                        "ranks; uring batches the hot path through "
+                        "io_uring, zerocopy sends large buffers with "
+                        "MSG_ZEROCOPY, basic is the legacy "
+                        "poll/sendmsg/readv path")
+    p.add_argument("--wire-zc-threshold", dest="wire_zc_threshold",
+                   type=int, default=None,
+                   help="min payload bytes sent with MSG_ZEROCOPY on the "
+                        "zerocopy tier (HVD_WIRE_ZC_THRESHOLD, default "
+                        "16384): page pinning beats copying only for "
+                        "large buffers")
+    p.add_argument("--numa", dest="numa", type=int, choices=[0, 1],
+                   default=None,
+                   help="NUMA placement (HVD_NUMA): 1 pins reduce-pool "
+                        "lanes round-robin across nodes and mbinds shm "
+                        "segments to their owner's node, 0 leaves "
+                        "placement to the scheduler; unset auto-enables "
+                        "on multi-node boxes")
     p.add_argument("--timeline-filename", dest="timeline_filename")
     p.add_argument("--timeline-mark-cycles", dest="timeline_mark_cycles",
                    action="store_true", default=None)

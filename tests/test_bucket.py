@@ -111,6 +111,8 @@ def test_autotune_bucket_arm(tmp_path):
         "HVD_ZEROCOPY": "0",
         "HVD_RING_PIPELINE": "1",
         "HVD_SHM": "0",
+        # wire arm pinned off: covered by test_wire.py::test_autotune_wire_arm
+        "HVD_WIRE": "basic",
         "EXPECT_ARMS": "4",
     }, timeout=240)
     # The bucket column really swept both states.

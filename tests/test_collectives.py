@@ -92,6 +92,8 @@ def test_autotune(tmp_path):
         "AT_LOCAL_SIZE": "2",
         "HVD_SHM": "0",
         "HVD_BUCKET": "0",
+        # wire arm pinned off: covered by test_wire.py::test_autotune_wire_arm
+        "HVD_WIRE": "basic",
         "EXPECT_ARMS": "16",
     }, timeout=240)
 

@@ -177,6 +177,8 @@ def test_autotune_compress_arm(tmp_path):
         "HVD_SHM": "0",
         "HVD_BUCKET": "0",
         "HVD_COMPRESS": "int8",
+        # wire arm pinned off: covered by test_wire.py::test_autotune_wire_arm
+        "HVD_WIRE": "basic",
         "EXPECT_ARMS": "4",
     }, timeout=240)
     rows = [l for l in log.read_text().splitlines()[1:5]
@@ -184,17 +186,17 @@ def test_autotune_compress_arm(tmp_path):
     assert {l.split(",")[9] for l in rows} == {"0", "1"}, rows
 
 
-def test_arm_space_is_two_to_the_seventh():
-    """kMaxArms covers the full 2^7 categorical space: seven toggleable
-    dimensions (cache, hier, zerocopy, pipeline, shm, bucket, compress)
-    need 128 arm slots, and the Configure nest enumerates one loop per
-    dimension."""
+def test_arm_space_is_two_to_the_eighth():
+    """kMaxArms covers the full 2^8 categorical space: eight toggleable
+    dimensions (cache, hier, zerocopy, pipeline, shm, bucket, compress,
+    wire — ISSUE 12) need 256 arm slots, and the Configure nest
+    enumerates one loop per dimension."""
     src = open(os.path.join(_CSRC, "autotune.h")).read()
     m = re.search(r"kMaxArms\s*=\s*(\d+)", src)
-    assert m and int(m.group(1)) == 128, m
+    assert m and int(m.group(1)) == 256, m
     cc = open(os.path.join(_CSRC, "autotune.cc")).read()
     for dim in ("cache", "hier", "zerocopy", "pipeline", "shm", "bucket",
-                "compress"):
+                "compress", "wire"):
         assert re.search(r"can_toggle_%s\s*\?\s*2\s*:\s*1" % dim, cc), dim
 
 

@@ -117,6 +117,8 @@ def test_autotune_shm_arm(tmp_path):
         # bucket arm off: 16 arms would outgrow the 12-sample budget
         # (covered by test_bucket.py::test_autotune_bucket_arm)
         "HVD_BUCKET": "0",
+        # wire arm pinned off: covered by test_wire.py::test_autotune_wire_arm
+        "HVD_WIRE": "basic",
         "EXPECT_ARMS": "8",
     }, timeout=240)
     # The shm column really swept both states.

@@ -55,7 +55,7 @@ if r == 0 and log_path:
         lines = [l for l in f.read().splitlines() if l]
     assert lines[0] == \
         "sample,fusion_kb,cycle_ms,cache,hier,zerocopy,pipeline,shm," \
-        "bucket,compress,score_mbps", \
+        "bucket,compress,wire,affinity,score_mbps", \
         lines[:1]
     rows = [l for l in lines[1:] if not l.startswith("#")]
     assert len(rows) == max_samples, (len(rows), max_samples)
@@ -64,18 +64,19 @@ if r == 0 and log_path:
     points = {tuple(l.split(",")[1:3]) for l in rows}
     assert len(points) >= 3, points
     # The categorical sweep ran: the first rows walk every TOGGLEABLE
-    # (cache, hier, zerocopy, pipeline, shm, bucket, compress) arm at a
-    # pinned numeric point (reference: parameter_manager.cc categorical
-    # layers before numeric tuning). Up to 2^7 = 128 arms; HVD_ZEROCOPY=0,
-    # HVD_RING_PIPELINE=1, HVD_SHM=0, HVD_BUCKET=0, no HVD_COMPRESS codec,
-    # an invalid topology, or single-rank each remove a dimension.
+    # (cache, hier, zerocopy, pipeline, shm, bucket, compress, wire) arm
+    # at a pinned numeric point (reference: parameter_manager.cc
+    # categorical layers before numeric tuning). Up to 2^8 = 256 arms;
+    # HVD_ZEROCOPY=0, HVD_RING_PIPELINE=1, HVD_SHM=0, HVD_BUCKET=0, no
+    # HVD_COMPRESS codec, HVD_WIRE=basic (or a probe-refused kernel), an
+    # invalid topology, or single-rank each remove a dimension.
     n_arms = int(os.environ.get("EXPECT_ARMS", "8"))
-    arms = [tuple(l.split(",")[3:10]) for l in rows[:n_arms]]
+    arms = [tuple(l.split(",")[3:11]) for l in rows[:n_arms]]
     assert len(set(arms)) == n_arms, arms
     numeric_pts = {tuple(l.split(",")[1:3]) for l in rows[:n_arms]}
     assert len(numeric_pts) == 1, numeric_pts
     # ...and the numeric phase runs under ONE locked arm.
-    tail_arms = {tuple(l.split(",")[3:10]) for l in rows[n_arms:]}
+    tail_arms = {tuple(l.split(",")[3:11]) for l in rows[n_arms:]}
     assert len(tail_arms) == 1, tail_arms
 
 hvd.shutdown()

@@ -28,6 +28,15 @@ system and to pytest, so this lint parses the sources and enforces:
                  return-delimited path segment (the PR-3 contract: a
                  caller polling stats the instant its op resolves never
                  sees the op uncounted)
+  blocking-syscall
+                 every wait-class syscall site in csrc (poll/ppoll,
+                 accept, connect, epoll_wait, io_uring_enter — calls
+                 that can park the thread indefinitely) arms BOTH the
+                 fault-injection hook (fault::Check) and the lockdep
+                 blocking-IO hook (lockdep::OnBlockingSyscall) within
+                 the preceding few lines, so chaos tests can interpose
+                 on every place the data/control plane can wedge and
+                 debug builds flag locks held across the wait
 
 Run standalone (`python tools/hvdlint.py`, or `make check` from csrc/)
 or via pytest (tests/test_hvdlint.py, tier-1). Zero suppressions: a
@@ -360,6 +369,42 @@ def check_counter_order(root):
     return out
 
 
+# --- rule: blocking-syscall ------------------------------------------------
+
+# Wait-class syscalls: the calls that can park the thread until a peer (or
+# the kernel) acts. Byte-moving syscalls (sendmsg/recv/readv) are out of
+# scope — on the hot path they run only after poll reported readiness (or
+# inside io_uring, which has its own hook at the enter site). The
+# io_uring_enter pattern matches the raw-syscall invocation, not the
+# __NR_* feature-detection #ifdefs.
+WAIT_SYSCALL = re.compile(
+    r"::poll\s*\(|::ppoll\s*\(|::accept4?\s*\(|::connect\s*\(|"
+    r"::epoll_wait\s*\(|\bsyscall\s*\(\s*__NR_io_uring_enter\b")
+SYSCALL_HOOKS = ("fault::Check", "lockdep::OnBlockingSyscall")
+HOOK_WINDOW = 8  # lines above the syscall both hooks must appear within
+
+
+def check_blocking_syscall(root):
+    out = []
+    for path in _iter_files(root, "horovod_tpu/csrc", (".cc", ".h")):
+        lines = _read(path).splitlines()
+        for i, line in enumerate(lines, 1):
+            code = line.split("//")[0]
+            if not WAIT_SYSCALL.search(code):
+                continue
+            window = "\n".join(lines[max(0, i - 1 - HOOK_WINDOW):i])
+            for hook in SYSCALL_HOOKS:
+                if hook not in window:
+                    out.append(Violation(
+                        "blocking-syscall", _rel(root, path), i,
+                        code.strip()[:60],
+                        "wait-class syscall without %s() in the %d "
+                        "preceding lines — chaos tests cannot interpose "
+                        "here and debug builds cannot flag locks held "
+                        "across the wait" % (hook, HOOK_WINDOW)))
+    return out
+
+
 # --- driver ----------------------------------------------------------------
 
 CHECKS = [
@@ -368,6 +413,7 @@ CHECKS = [
     check_config_parity,
     check_raw_getenv,
     check_counter_order,
+    check_blocking_syscall,
 ]
 
 
