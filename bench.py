@@ -53,8 +53,12 @@ ragged wire formats — the BASELINE MoE graded config), and ``elastic``
 (measured fault-to-recovery seconds on real localhost elastic jobs
 across the churn matrix — clean death vs SIGSTOP wedge vs partition,
 full respawn vs hot-spare promotion — the BASELINE elastic graded
-config plus the ISSUE 10 latency evidence) in the same final JSON line
-under ``"extra"``. Set BENCH_CONFIG to one of those names to run
+config plus the ISSUE 10 latency evidence), and ``pipeline``
+(zero-bubble schedule accounting: measured bubble_fraction per schedule
+with the ISSUE 13 orderings asserted, schedule execution parity on 8
+forced-host devices, and the bucket-in-bubble A/B proving grouped
+negotiations launch inside pipeline idle spans) in the same final JSON
+line under ``"extra"``. Set BENCH_CONFIG to one of those names to run
 exactly one.
 """
 
@@ -1022,6 +1026,380 @@ def _bucket_bench_worker():
     hvd.shutdown()
 
 
+def _load_schedules_mod():
+    """horovod_tpu/parallel/schedules.py loaded standalone (it is
+    numpy-only) so the bubble accounting and the A/B worker's tick
+    replay never depend on a working jax install — the parallel
+    package's __init__ imports jax, the schedule tables don't."""
+    import importlib.util
+
+    path = os.path.join(_HERE, "horovod_tpu", "parallel", "schedules.py")
+    spec = importlib.util.spec_from_file_location("_hvd_pipe_schedules",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _pipeline_schedule_report(stages=8, multipliers=(1, 2, 4), virtual=2):
+    """Measured-vs-ideal bubble accounting per schedule at
+    M ∈ {S, 2S, 4S} from the same trace-time tick tables the compiled
+    scans index (ISSUE 13 acceptance). `bubble_fraction` is MEASURED —
+    idle (tick, stage) slots counted over the actual table — and
+    `ideal_bubble` is the closed form; they differ legitimately for
+    1f1b below M = 2S-2 (docs/perf_tuning.md). The acceptance orderings
+    are asserted here on the measured numbers. Reused verbatim by the
+    dryrun gate (__graft_entry__._pipeline_schedule_exercise)."""
+    sched = _load_schedules_mod()
+    S = int(stages)
+    table = {}
+    for name in ("gpipe", "1f1b", "interleaved", "zb"):
+        v = virtual if name == "interleaved" else None
+        label = sched.schedule_label(name, v or 1)
+        per_m = {}
+        for k in multipliers:
+            info = sched.schedule_info(name, S, k * S, v)
+            per_m[f"M={k * S}"] = {
+                "bubble_fraction": round(info.bubble_fraction, 4),
+                "ideal_bubble": round(info.ideal_bubble, 4),
+                "ticks": info.ticks}
+        table[label] = per_m
+    il = sched.schedule_label("interleaved", virtual)
+    for k in multipliers:
+        m = f"M={k * S}"
+        assert (table["1f1b"][m]["bubble_fraction"]
+                < table["gpipe"][m]["bubble_fraction"]), (m, table)
+        assert (table["zb"][m]["bubble_fraction"]
+                <= table["1f1b"][m]["bubble_fraction"]), (m, table)
+    if 1 in multipliers:  # interleaved divides the bubble at M = S
+        assert (table[il]["M=%d" % S]["bubble_fraction"]
+                < table["1f1b"]["M=%d" % S]["bubble_fraction"]), table
+    return table
+
+
+def _span_window_overlap(events, windows, name="TCP_BUCKET_LAUNCH"):
+    """Fraction of `name` span time that falls inside the recorded
+    pipeline bubble windows (same methodology as ISSUE 8's
+    backward/comms overlap number, but against explicit idle spans).
+    A zero-duration span (a bucket whose members all arrived in one
+    burst: first-arrival == release) is a 1 us point mass — 'did the
+    launch happen inside a bubble' is exactly the point test. Valid
+    raw intersection: the core timeline stamps steady_clock
+    microseconds (timeline.h NowUs) and the worker stamps
+    time.monotonic_ns()//1000 — both CLOCK_MONOTONIC on Linux."""
+    total = inter = 0.0
+    for e in events:
+        if e.get("name") != name:
+            continue
+        a0 = e["ts"]
+        a1 = a0 + max(1, e.get("dur", 0))
+        total += a1 - a0
+        for w0, w1 in windows:
+            lo, hi = max(a0, w0), min(a1, w1)
+            if hi > lo:
+                inter += hi - lo
+    if total <= 0:
+        return 0.0, 0.0
+    return inter / total, total
+
+
+def _bench_pipeline():
+    """Zero-bubble pipeline schedules (ISSUE 13 acceptance), three
+    parts. (1) Schedule accounting: measured bubble_fraction per
+    schedule at S=8, M ∈ {S, 2S, 4S} with the orderings asserted
+    (1f1b < gpipe everywhere, interleaved V=2 < 1f1b at M=S,
+    zb ≤ 1f1b). (2) Execution: every schedule runs a real
+    make_pipeline_value_and_grad step on 8 forced-host XLA devices
+    (JAX_PLATFORMS=cpu — deterministic, relay-immune) asserting
+    loss/grad parity across schedules; carried as an error note instead
+    of failing the config when the box's jax predates the parallel
+    package's floor. (3) Bucket-in-bubble A/B: the PR 7 bucket plane
+    run under a replay of the real 1F1B tick table, overlapped
+    (grads submitted at their backward ticks, drained in idle ticks)
+    vs sequential (grads after the last tick) — the timeline-span
+    overlap fraction proves grouped negotiations launch inside
+    pipeline idle spans. Loopback TCP caveat as _bench_bucket."""
+    import tempfile
+
+    from horovod_tpu.runner.local import run_local
+
+    schedules_table = _pipeline_schedule_report(stages=8)
+
+    # Part 2: schedule execution child (own process: it forces 8 host
+    # devices before importing jax, which must not leak to siblings).
+    fd, exec_out = tempfile.mkstemp(prefix="hvd_bench_pipe_exec_")
+    os.close(fd)
+    try:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _repo_pythonpath(os.environ.get("PYTHONPATH"))
+        env["_BENCH_PIPELINE_EXEC"] = "1"
+        env["_BENCH_PIPELINE_OUT"] = exec_out
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=8"
+                            ).strip()
+        rc, _ = _run_subprocess([sys.executable, os.path.abspath(__file__)],
+                                env, 150)
+        execution = None
+        if rc == 0:
+            try:
+                with open(exec_out) as f:
+                    execution = json.load(f)
+            except Exception:
+                execution = None
+        if execution is None:
+            execution = {"error": f"exec child exited rc={rc} "
+                                  f"with no JSON"}
+    finally:
+        try:
+            os.unlink(exec_out)
+        except OSError:
+            pass
+
+    # Part 3: bucket-in-bubble A/B. Both modes run the bucket assembler
+    # (HVD_BUCKET=1) — the A/B isolates WHEN grouped negotiations
+    # launch, not whether grouping happens.
+    np_ = int(os.environ.get("BENCH_PIPELINE_RANKS", "2"))
+    runs, timelines = {}, {}
+    for mode in ("overlapped", "sequential"):
+        fd, out_path = tempfile.mkstemp(prefix="hvd_bench_pipe_")
+        os.close(fd)
+        fd, tl_path = tempfile.mkstemp(prefix="hvd_bench_pipe_tl_",
+                                       suffix=".json")
+        os.close(fd)
+        try:
+            env = {"PYTHONPATH":
+                   _repo_pythonpath(os.environ.get("PYTHONPATH")),
+                   "JAX_PLATFORMS": "cpu",
+                   "_BENCH_PIPELINE_WORKER": "1",
+                   "_BENCH_PIPELINE_MODE": mode,
+                   "_BENCH_PIPELINE_OUT": out_path,
+                   "HVD_TIMELINE": tl_path,
+                   "HVD_BUCKET": "1",
+                   "HVD_BUCKET_BYTES": str(256 * 1024)}
+            codes = run_local(np_,
+                              [sys.executable, os.path.abspath(__file__)],
+                              env=env, timeout=120)
+            if codes != [0] * np_:
+                raise RuntimeError(f"pipeline ranks exited {codes}")
+            with open(out_path) as f:
+                runs[mode] = json.load(f)
+            with open(tl_path) as f:
+                timelines[mode] = json.load(f)
+        finally:
+            for p in (out_path, tl_path):
+                for suffix in ("",) + tuple(
+                        f".rank{i}" for i in range(1, np_)):
+                    try:
+                        os.unlink(p + suffix)
+                    except OSError:
+                        pass
+    ov, ov_us = _span_window_overlap(
+        timelines["overlapped"], runs["overlapped"]["bubble_windows"])
+    sv, _ = _span_window_overlap(
+        timelines["sequential"], runs["sequential"]["bubble_windows"])
+    # Supporting number: the wire time itself (TCP_ALLREDUCE spans)
+    # riding the bubbles, not just the launch instants.
+    ow, _ = _span_window_overlap(
+        timelines["overlapped"], runs["overlapped"]["bubble_windows"],
+        name="TCP_ALLREDUCE")
+    sw, _ = _span_window_overlap(
+        timelines["sequential"], runs["sequential"]["bubble_windows"],
+        name="TCP_ALLREDUCE")
+    o, q = runs["overlapped"], runs["sequential"]
+    # Grouped negotiations really launched, and they really landed in
+    # the bubbles — strictly more than the sequential control, which by
+    # construction cannot put comms inside an idle tick.
+    assert o["launched"] > 0, o
+    assert ov > 0.0, (ov, ov_us)
+    assert ov > sv, (ov, sv)
+    d = {"metric": "pipeline_bubble_bucket_overlap",
+         "value": round(ov, 3),
+         "unit": "fraction of TCP_BUCKET_LAUNCH span time inside "
+                 "pipeline bubble windows (overlapped mode, loopback)",
+         "n_ranks": np_,
+         "overlap_fraction_overlapped": round(ov, 3),
+         "overlap_fraction_sequential": round(sv, 3),
+         "allreduce_in_bubble_overlapped": round(ow, 3),
+         "allreduce_in_bubble_sequential": round(sw, 3),
+         "launch_span_us_overlapped": round(ov_us, 1),
+         "overlapped_step_ms": o["step_ms"],
+         "sequential_step_ms": q["step_ms"],
+         "schedule_ticks": o["ticks"],
+         "bubble_windows_recorded": len(o["bubble_windows"]),
+         "plan_buckets": o["plan_buckets"],
+         "schedule_bubbles": schedules_table,
+         "execution": execution,
+         "cpu_cores": len(os.sched_getaffinity(0)),
+         "vs_baseline": 1.0}
+    return d
+
+
+def _pipeline_bench_worker():
+    """Rank body for the bucket-in-bubble A/B (_BENCH_PIPELINE_WORKER).
+    The ranks are DATA-PARALLEL replicas of the LAST stage of an
+    S-stage 1F1B schedule — the PP x DP composition where bucketed
+    grad sync actually rides the bubbles: each rank replays that
+    stage's busy/idle tick pattern from the REAL table
+    (horovod_tpu/parallel/schedules.py — the same table the compiled
+    scan indexes), sleeping the compute quantum on busy ticks. The
+    stage's weight gradients are accumulated over microbatches, so
+    they complete at its LAST backward tick — right before the
+    cooldown bubble. overlapped: the grouped allreduces are launched
+    and drained inside the idle ticks that follow (the tentpole's
+    'bucketed comms launched into the bubbles'), and rank 0 records
+    each bubble's [start, end) monotonic-us window; sequential: the
+    same grads are submitted and synchronized only after the final
+    tick, so no comms can land in a bubble and the sync time is paid
+    on top of the schedule."""
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r, s = hvd.rank(), hvd.size()
+    sched = _load_schedules_mod()
+    S = int(os.environ.get("_BENCH_PIPELINE_STAGES", "8"))
+    M = int(os.environ.get("_BENCH_PIPELINE_MB", "8"))
+    tabs = sched._onef1b_tables(S, M)
+    f_mb, b_mb, T = tabs["f_mb"], tabs["b_mb"], tabs["T"]
+    stage = S - 1  # every rank: a dp replica of the last stage
+    tick_s = float(os.environ.get("_BENCH_PIPELINE_TICK_S", "0.006"))
+    n = int(os.environ.get("_BENCH_PIPELINE_FLOATS", str(32 * 1024)))
+    mode = os.environ.get("_BENCH_PIPELINE_MODE", "overlapped")
+    xs = [np.full(n, float(r + 1), np.float32) for _ in range(M)]
+    windows = []
+
+    last_b_tick = int(np.max(np.where(b_mb[:, stage] >= 0)[0]))
+
+    def sync_grads():
+        hs = [hvd.allreduce_async(xs[g], op=hvd.Sum, name=f"grad.{g}")
+              for g in range(len(xs))]
+        for h in hs:
+            out = hvd.synchronize(h)
+            assert np.allclose(out[:4], s * (s + 1) / 2.0), out[:4]
+
+    def step():
+        synced = False
+        for t in range(T):
+            busy = f_mb[t, stage] >= 0 or b_mb[t, stage] >= 0
+            t0 = time.monotonic_ns() // 1000
+            if busy:
+                time.sleep(tick_s)  # the stage's compute for this tick
+            else:
+                # Bubble: launch + drain the grouped grad sync inside
+                # the idle tick (once the accumulated grads exist),
+                # then pad to the tick quantum so the ranks stay
+                # tick-aligned.
+                if mode == "overlapped" and t > last_b_tick \
+                        and not synced:
+                    sync_grads()
+                    synced = True
+                spent = time.monotonic_ns() // 1000 - t0
+                if spent < tick_s * 1e6:
+                    time.sleep(tick_s - spent / 1e6)
+                if r == 0:
+                    windows.append([t0, time.monotonic_ns() // 1000])
+        if not synced:  # sequential: sync is paid on top of the schedule
+            sync_grads()
+
+    for _ in range(2):  # bucket-plan learning pass + first replay
+        step()
+    hvd.barrier()
+    iters = int(os.environ.get("_BENCH_PIPELINE_ITERS", "6"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        step()
+    dt = time.perf_counter() - t0
+    launched, early, assembled, flushes, invalid, plan = hvd.bucket_stats()
+    if r == 0:
+        info = sched.schedule_info("1f1b", S, M)
+        with open(os.environ["_BENCH_PIPELINE_OUT"], "w") as f:
+            json.dump({"mode": mode,
+                       "step_ms": round(dt / iters * 1e3, 2),
+                       "ticks": T, "stages": S, "microbatches": M,
+                       "bubble_fraction": round(info.bubble_fraction, 4),
+                       "bubble_windows": windows,
+                       "launched": launched, "early": early,
+                       "flushes": flushes, "plan_buckets": plan}, f)
+    hvd.barrier()
+    hvd.shutdown()
+
+
+def _pipeline_exec_worker():
+    """In-process schedule execution for _bench_pipeline
+    (_BENCH_PIPELINE_EXEC): every schedule runs a real
+    make_pipeline_value_and_grad step over the SAME 8 stage slices
+    (gpipe/1f1b/zb: S=8 devices; interleaved: S=4, V=2 — identical
+    math), asserting loss and gradient parity against the gpipe
+    reference (schedules change timing, not math) and recording
+    per-step wall time next to each schedule's tick accounting.
+    Errors are written as JSON, not raised — the parent carries them
+    as an environment note."""
+    out = {}
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+
+        from horovod_tpu.parallel import pipeline as pl
+
+        devs = jax.devices()
+        assert len(devs) >= 8, devs
+        rng = np.random.default_rng(7)
+        SV, D, B, M = 8, 16, 32, 8
+        W = rng.normal(size=(SV, D, D)).astype(np.float32) / np.sqrt(D)
+        bias = np.zeros((SV, D), np.float32)
+        x = rng.normal(size=(B, D)).astype(np.float32)
+        y = rng.normal(size=(B, D)).astype(np.float32)
+
+        def stage_fn(p, h):
+            return jnp.tanh(h @ p["w"] + p["b"])
+
+        def loss_fn(o, batch):
+            return jnp.mean((o - batch["y"]) ** 2)
+
+        ref_loss, ref_g = None, None
+        for name, S, V in (("gpipe", 8, None), ("1f1b", 8, None),
+                           ("interleaved", 4, 2), ("zb", 8, None)):
+            mesh = Mesh(np.asarray(devs[:S]), ("pipe",))
+            params = pl.shard_stage_params(
+                {"w": jnp.asarray(W), "b": jnp.asarray(bias)}, mesh,
+                virtual_stages=V or 1)
+            vg = pl.make_pipeline_value_and_grad(
+                stage_fn, loss_fn, mesh, n_microbatches=M,
+                schedule=name, virtual_stages=V)
+            batch = {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+            loss, g = vg(params, batch)  # compile + first run
+            jax.block_until_ready(loss)
+            iters = 5
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss, g = vg(params, batch)
+            jax.block_until_ready(loss)
+            dt = (time.perf_counter() - t0) / iters
+            loss = float(loss)
+            gw = np.asarray(g["w"])
+            if ref_loss is None:
+                ref_loss, ref_g = loss, gw
+                delta = 0.0
+            else:
+                assert abs(loss - ref_loss) < 1e-5, (name, loss, ref_loss)
+                delta = float(np.abs(gw - ref_g).max())
+                assert delta < 1e-4, (name, delta)
+            info = pl.schedule_info(name, S, M, V)
+            label = f"interleaved{V}" if V else name
+            out[label] = {"loss": round(loss, 6),
+                          "step_ms": round(dt * 1e3, 2),
+                          "max_grad_delta_vs_gpipe": delta,
+                          "bubble_fraction":
+                              round(info.bubble_fraction, 4),
+                          "ideal_bubble": round(info.ideal_bubble, 4),
+                          "ticks": info.ticks}
+    except Exception as e:  # noqa: BLE001 — carried, not fatal
+        out = {"error": f"{type(e).__name__}: {e}"}
+    with open(os.environ["_BENCH_PIPELINE_OUT"], "w") as f:
+        json.dump(out, f)
+
+
 def _bench_compress():
     """Compressed-collective A/B through the C++ host plane (ISSUE 11
     acceptance): the same steady-state f32 allreduce stream run under
@@ -1601,6 +1979,7 @@ _CONFIG_FNS = {
     "reduce": _bench_reduce,
     "moe": _bench_moe,
     "elastic": _bench_elastic,
+    "pipeline": _bench_pipeline,
 }
 
 _METRIC_NAMES = {
@@ -1616,6 +1995,8 @@ _METRIC_NAMES = {
     "reduce": ("reduce_kernel_vector_bandwidth", "GB/s"),
     "moe": ("moe_dispatch_throughput", "tokens/sec"),
     "elastic": ("elastic_recovery_seconds", "s"),
+    "pipeline": ("pipeline_bubble_bucket_overlap",
+                 "fraction of bucket-launch time inside pipeline bubbles"),
 }
 
 # Per-config wall caps (seconds). Only bind when something hangs; healthy
@@ -1647,6 +2028,10 @@ _CONFIG_CAPS = {
     # under 75 s alone, ~50 s healthy total; a tight sub-budget sheds
     # optional matrix jobs so the headline number always lands.
     "elastic": 300,
+    # Two loopback pods (overlapped/sequential tick replay) plus one
+    # 8-host-device schedule-execution child; runs LAST in the order so
+    # deadline pressure sheds it before the graded configs.
+    "pipeline": 150,
 }
 
 _PROBE_TIMEOUT = 75
@@ -1882,7 +2267,8 @@ def main():
 
     results = {}
     order = ["resnet50", "transformer", "allreduce", "longctx", "hostplane",
-             "bucket", "compress", "bridge", "reduce", "moe", "elastic"]
+             "bucket", "compress", "bridge", "reduce", "moe", "elastic",
+             "pipeline"]
     for name in order:
         cap = _cap(name)
         left = remaining() - 15  # reserve for final assembly
@@ -1927,5 +2313,9 @@ if __name__ == "__main__":
         _bridge_worker()
     elif os.environ.get("_BENCH_ELASTIC_WORKER") == "1":
         _elastic_worker()
+    elif os.environ.get("_BENCH_PIPELINE_WORKER") == "1":
+        _pipeline_bench_worker()
+    elif os.environ.get("_BENCH_PIPELINE_EXEC") == "1":
+        _pipeline_exec_worker()
     else:
         main()

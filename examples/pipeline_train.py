@@ -9,6 +9,12 @@ Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
          python examples/pipeline_train.py      (4-stage x 2-way dp)
      python examples/pipeline_train.py          (real chips: uses up to
                                                  4 for the pipe axis)
+     SCHEDULE=1f1b python examples/pipeline_train.py
+     SCHEDULE=interleaved:2 python examples/pipeline_train.py
+
+SCHEDULE picks the microbatch schedule (gpipe / 1f1b / interleaved[:V] /
+zb — docs/perf_tuning.md 'Pipeline schedules'); unset, the launcher's
+--pipeline-schedule / HVD_PIPE_SCHEDULE knob applies, else gpipe.
 """
 import dataclasses
 import os
@@ -22,22 +28,36 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from horovod_tpu.models import transformer as tfm
 from horovod_tpu.parallel.pipeline import (make_pipeline_train_step,
+                                           resolve_schedule, schedule_info,
                                            shard_stage_params)
 
 STEPS = int(os.environ.get("STEPS", 30))
 BATCH = int(os.environ.get("BATCH", 16))
+SCHEDULE = os.environ.get("SCHEDULE")  # else HVD_PIPE_SCHEDULE, else gpipe
+M = int(os.environ.get("MICROBATCHES", 4))
 
 devices = jax.devices()
 S = min(4, len(devices))
 dp = 2 if len(devices) >= 2 * S else 1
 mesh = Mesh(np.asarray(devices[:S * dp]).reshape(S, dp), ("pipe", "data"))
+sched_name, V = resolve_schedule(SCHEDULE)
+info = schedule_info(sched_name, S, M,
+                     V if sched_name == "interleaved" else None)
 print(f"mesh: {S} pipeline stages x {dp}-way data parallel")
+print(f"schedule: {info.label} — {info.ticks} ticks, bubble "
+      f"{info.bubble_fraction:.3f} measured / {info.ideal_bubble:.3f} "
+      f"ideal (docs/perf_tuning.md)")
 
-cfg = dataclasses.replace(tfm.tiny(), n_layers=S, dtype="float32")
+# interleaved runs V virtual slices per device: the block stack deepens
+# to S*V and each device owns V non-contiguous slices of it.
+n_slices = S * (V if sched_name == "interleaved" else 1)
+cfg = dataclasses.replace(tfm.tiny(), n_layers=n_slices, dtype="float32")
 params = tfm.init_params(jax.random.PRNGKey(0), cfg)
 stacked = jax.tree.map(lambda *xs: np.stack([np.asarray(a) for a in xs]),
                        *params["layers"])
-stage_params = shard_stage_params(stacked, mesh, "pipe")
+stage_params = shard_stage_params(
+    stacked, mesh, "pipe",
+    virtual_stages=V if sched_name == "interleaved" else 1)
 
 
 def stage_fn(layer, h):
@@ -52,8 +72,9 @@ def loss_fn(out, batch):
 
 tx = optax.adam(1e-3)
 step = make_pipeline_train_step(stage_fn, loss_fn, tx, mesh,
-                                n_microbatches=4,
-                                batch_axis="data" if dp > 1 else None)
+                                n_microbatches=M,
+                                batch_axis="data" if dp > 1 else None,
+                                schedule=SCHEDULE)
 
 rng = np.random.default_rng(0)
 tokens = rng.integers(0, cfg.vocab_size, (BATCH, 16))

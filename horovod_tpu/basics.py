@@ -191,6 +191,8 @@ _lib.hvd_compress_state.restype = c_int
 _lib.hvd_compress_state.argtypes = [P_int64, ctypes.POINTER(c_double)]
 _lib.hvd_set_compress.restype = c_int
 _lib.hvd_set_compress.argtypes = [c_int, c_double]
+_lib.hvd_register_pipeline_workload.restype = c_int
+_lib.hvd_register_pipeline_workload.argtypes = [c_char_p]
 _lib.hvd_reduce_pool_stats.restype = c_int
 _lib.hvd_reduce_pool_stats.argtypes = [P_int64, P_int64, P_int64]
 _lib.hvd_reduce_bench.restype = c_double
@@ -386,6 +388,18 @@ class HorovodBasics:
         if rc < 0:
             raise ValueError("horovod_tpu has not been initialized")
         return steps.value, blocks.value, serial.value, us.value
+
+    def register_pipeline_workload(self, schedule):
+        """Record the active pipeline-parallel SCHEDULE (gpipe / 1f1b /
+        interleavedV / zb — the JAX-layer microbatch schedule, unrelated
+        to the ring-pipeline depth above) so autotune CSV rows carry it
+        in their ``schedule`` column. Categorical and opt-in: the column
+        stays '-' until a pipeline workload registers. Returns True when
+        the core accepted it, False when the core is not initialized
+        (callers treat that as best-effort, not an error)."""
+        rc = _lib.hvd_register_pipeline_workload(
+            str(schedule).encode("utf-8"))
+        return rc == 0
 
     def pipeline_state(self):
         """(enabled, depth): whether ring-step streaming is live and the

@@ -125,7 +125,7 @@ void ParameterManager::Configure(bool enabled, const std::string& log_path,
       fprintf(
           log_,
           "sample,fusion_kb,cycle_ms,cache,hier,zerocopy,pipeline,shm,"
-          "bucket,compress,wire,affinity,score_mbps\n");
+          "bucket,compress,wire,affinity,schedule,score_mbps\n");
   }
   // First sample point = warmup[0]; adopted on the first Record proposal.
   memcpy(cur_x_, kWarmup[0], sizeof(cur_x_));
@@ -274,11 +274,12 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
     int64_t f;
     double c;
     ToParams(cur_x_, &f, &c);
-    fprintf(log_, "%lld,%.1f,%.3f,%d,%d,%d,%d,%d,%d,%d,%d,%s,%.3f\n",
+    fprintf(log_, "%lld,%.1f,%.3f,%d,%d,%d,%d,%d,%d,%d,%d,%s,%s,%.3f\n",
             (long long)n_samples_, f / 1024.0, c, cur_cache_ ? 1 : 0,
             cur_hier_ ? 1 : 0, cur_zerocopy_ ? 1 : 0, cur_pipeline_ ? 1 : 0,
             cur_shm_ ? 1 : 0, cur_bucket_ ? 1 : 0, cur_compress_ ? 1 : 0,
-            cur_wire_ ? 1 : 0, affinity_.c_str(), score / 1e6);
+            cur_wire_ ? 1 : 0, affinity_.c_str(), pipe_schedule().c_str(),
+            score / 1e6);
     fflush(log_);
   }
   if (score > best_score_) {
@@ -352,11 +353,12 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
     *compress_on = cur_compress_ ? 1 : 0;
     *wire_on = cur_wire_ ? 1 : 0;
     if (log_) {
-      fprintf(log_, "# final,%.1f,%.3f,%d,%d,%d,%d,%d,%d,%d,%d,%s,%.3f\n",
+      fprintf(log_, "# final,%.1f,%.3f,%d,%d,%d,%d,%d,%d,%d,%d,%s,%s,%.3f\n",
               best_fusion_ / 1024.0, best_cycle_ms_, cur_cache_ ? 1 : 0,
               cur_hier_ ? 1 : 0, cur_zerocopy_ ? 1 : 0, cur_pipeline_ ? 1 : 0,
               cur_shm_ ? 1 : 0, cur_bucket_ ? 1 : 0, cur_compress_ ? 1 : 0,
-              cur_wire_ ? 1 : 0, affinity_.c_str(), best_score_ / 1e6);
+              cur_wire_ ? 1 : 0, affinity_.c_str(), pipe_schedule().c_str(),
+              best_score_ / 1e6);
       fflush(log_);
     }
     return true;

@@ -18,6 +18,7 @@
 #include <array>
 #include <cstdint>
 #include <cstdio>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -68,6 +69,21 @@ class ParameterManager {
   double best_cycle_ms() const { return best_cycle_ms_; }
   int64_t samples() const { return n_samples_; }
 
+  // Categorical *recorded* field, not a swept arm (the `pipeline` arm
+  // above is the ring-pipeline toggle — unrelated): the active JAX
+  // pipeline-parallel schedule, "-" until a pipeline workload registers
+  // via hvd_register_pipeline_workload (same "operator opted in"
+  // discipline as the compress arm; docs/autotune.md). Guarded: the
+  // setter runs on a user thread, Record on the background loop.
+  void SetPipeSchedule(const std::string& s) {
+    std::lock_guard<std::mutex> l(sched_mu_);
+    pipe_schedule_ = s.empty() ? "-" : s;
+  }
+  std::string pipe_schedule() const {
+    std::lock_guard<std::mutex> l(sched_mu_);
+    return pipe_schedule_;
+  }
+
  private:
   // Parameter space: x in [0,1]^2 -> (fusion bytes log-scaled between
   // kFusionMin..kFusionMax, cycle ms log-scaled kCycleMin..kCycleMax).
@@ -112,6 +128,8 @@ class ParameterManager {
        cur_pipeline_ = true, cur_shm_ = true, cur_bucket_ = false,
        cur_compress_ = false, cur_wire_ = false;
   std::string affinity_ = "?";
+  mutable std::mutex sched_mu_;
+  std::string pipe_schedule_ = "-";
 
   // Current sample accumulation.
   double cur_x_[2] = {0.5, 0.5};

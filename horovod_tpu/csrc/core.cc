@@ -2592,6 +2592,18 @@ int hvd_set_compress(int codec, double topk_frac) {
   return 0;
 }
 
+// Pipeline-workload registration: the JAX pipeline layer reports its
+// active schedule (gpipe / 1f1b / interleavedV / zb) so autotune CSV
+// rows carry a `schedule` column — a categorical RECORDED field, not a
+// swept arm (the `pipeline` arm is the ring-pipeline toggle). Stays "-"
+// until a pipeline workload opts in, same discipline as the compress
+// arm. Process-local and monotonic-latest: the last registration wins.
+int hvd_register_pipeline_workload(const char* schedule) {
+  if (!g || !g->initialized) return -1;
+  g->autotune.SetPipeSchedule(schedule ? schedule : "");
+  return 0;
+}
+
 // Elastic-churn observability: control-plane heartbeat deadline misses
 // observed by this process, evictions it saw (decided on rank 0, received
 // via the shutdown broadcast on workers), and the last evicted rank (-1 =

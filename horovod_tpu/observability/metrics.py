@@ -368,10 +368,29 @@ PIPELINE_TRACES = counter(
     "hvd_pipeline_traces_total",
     "pipeline_apply schedule constructions (trace-time: one per "
     "compile, not per step)",
-    ("stages", "microbatches"))
+    ("stages", "microbatches", "schedule"))
 PIPELINE_BUBBLE = gauge(
     "hvd_pipeline_bubble_fraction",
-    "Bubble fraction (S-1)/(M+S-1) of the last-built pipeline schedule")
+    "Ideal (closed-form) bubble fraction of the last-built pipeline "
+    "schedule — e.g. (S-1)/(M+S-1) for gpipe; see docs/perf_tuning.md "
+    "section 'Pipeline schedules'")
+PIPELINE_BUBBLE_MEASURED = gauge(
+    "hvd_pipeline_bubble_measured_fraction",
+    "Measured bubble fraction of the last-built schedule: 1 - occupied "
+    "device-tick slots / (ticks x stages), counted from the very tables "
+    "the scan compiles")
+PIPELINE_TICKS = gauge(
+    "hvd_pipeline_schedule_ticks",
+    "Total tick count T of the last-built pipeline schedule (training "
+    "accounting: forward-only schedules mirror the forward table)")
+PIPELINE_STEPS = counter(
+    "hvd_pipeline_steps_total",
+    "Instrumented pipeline train steps executed (only counted when "
+    "metrics were enabled at step-build time)", ("schedule",))
+PIPELINE_ZB_FALLBACKS = counter(
+    "hvd_pipeline_zb_fallbacks_total",
+    "ZB-H1 requests that fell back to plain 1F1B because the split "
+    "schedule could not be made shape-stable", ("reason",))
 STALL_WARNINGS = counter(
     "hvd_stall_warnings_total",
     "Python-side stall inspector warnings", ("op",))

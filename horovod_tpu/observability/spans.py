@@ -63,6 +63,23 @@ class SpanRecorder:
             return _NOOP
         return self._span(name, cat, args)
 
+    def event(self, name, ts_us, dur_us, cat="python", **args):
+        """Record one complete event with caller-supplied wall-clock
+        timestamps (µs, ``time.time_ns() // 1000`` epoch) — for derived
+        sub-phases (e.g. pipeline warmup/steady/cooldown estimates)
+        where a context manager can't wrap the phase as it runs."""
+        if not _metrics.enabled():
+            return
+        ev = {"name": name, "ph": "X", "ts": int(ts_us),
+              "dur": max(0, int(dur_us)), "pid": self.pid,
+              "tid": threading.current_thread().name}
+        if cat:
+            ev["cat"] = cat
+        if args:
+            ev["args"] = dict(args)
+        with self._lock:
+            self._events.append(ev)
+
     def instant(self, name, **args):
         if not _metrics.enabled():
             return
@@ -94,6 +111,7 @@ class SpanRecorder:
 # Process-wide recorder + module-level conveniences.
 recorder = SpanRecorder()
 span = recorder.span
+event = recorder.event
 instant = recorder.instant
 dump = recorder.dump
 

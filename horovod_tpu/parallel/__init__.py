@@ -24,6 +24,11 @@ from .expert_parallel import (  # noqa: F401
 )
 from .pipeline import (  # noqa: F401
     make_pipeline_train_step,
+    make_pipeline_value_and_grad,
     pipeline_apply,
     shard_stage_params,
+)
+from .schedules import (  # noqa: F401
+    resolve_schedule,
+    schedule_info,
 )

@@ -3,23 +3,49 @@
 The reference has no pipeline parallelism and no p2p send/recv API at
 all (SURVEY.md §2.4: "PP — absent; no send/recv"). On TPU the natural
 p2p primitive is `lax.ppermute` over an ICI-adjacent mesh axis, and the
-natural schedule is the GPipe microbatch pipeline expressed as ONE
+natural execution form is a microbatch pipeline expressed as ONE
 `lax.scan` inside `shard_map` — every stage runs the same compiled
 program, activations hop stage→stage with a single collective-permute
 per tick, and XLA overlaps the permute with the next tick's compute.
-Autodiff flows through the whole schedule (scan + ppermute are both
-differentiable; the transpose of a forward hop is the reverse hop), so
-the backward pipeline comes for free instead of being hand-scheduled
-the way GPU frameworks do it.
 
-Scope: `pipeline_apply` is the forward primitive (differentiable — take
-`jax.grad` of a loss on its outputs to train);
-`make_pipeline_train_step` packages the standard loss/grad/update loop.
-`stage_fn` must be shape-preserving ([mb, ...] -> [mb, ...]): classic
-homogeneous-stack pipelining (transformer blocks). The pipeline bubble
-is the usual (S-1)/(M+S-1) fraction — pick n_microbatches >> stages.
+WHICH microbatch each (tick, stage) slot runs is a *schedule* — a
+trace-time table from :mod:`.schedules` baked into the scan:
+
+* ``gpipe`` (default): all forwards, then autodiff's mirrored backward.
+  Simplest; bubble (S-1)/(M+S-1); O(M) activation residency.
+* ``1f1b``: the training step fuses forward and backward into single
+  ticks (PipeDream-flush order) — stage S-1 runs F(m) and B(m) in the
+  same tick, so peak activation residency drops to O(S) (a 2S-1-slot
+  ring of stage inputs, recompute-based vjp) and the bubble shrinks to
+  (S-1)/(M+2S-2). Forward-only :func:`pipeline_apply` is unchanged by
+  construction (1F1B reorders the *training* ticks only).
+* ``interleaved`` (``interleaved:V``): each device hosts V
+  non-contiguous stage slices (``stage_params`` leading dim S·V), the
+  hop ring wraps around, and the bubble divides by ~V at the cost of V×
+  more ppermute hops per microbatch.
+* ``zb``: best-effort ZB-H1 — 1F1B with the backward split via
+  ``jax.vjp`` into a dL/dx tick (critical path) and a deferred dL/dw
+  tick placed into the stage's idle ticks, filling the cooldown tail.
+  Gated honest: if the split cannot be made shape-stable it falls back
+  to 1F1B and counts the fallback (PIPELINE_ZB_FALLBACKS).
+
+Autodiff flows through the gpipe/interleaved schedules (scan + ppermute
+are both differentiable; the transpose of a forward hop is the reverse
+hop); 1f1b/zb hand-schedule the backward inside the same scan because
+their point *is* the backward order.
+
+Scope: `pipeline_apply` is the forward primitive (differentiable),
+`make_pipeline_value_and_grad` the schedule-aware loss/grad engine, and
+`make_pipeline_train_step` the packaged loop. `stage_fn` must be
+shape-preserving ([mb, ...] -> [mb, ...]): classic homogeneous-stack
+pipelining (transformer blocks). Pick the schedule with the
+``schedule=`` kwarg or the ``HVD_PIPE_SCHEDULE`` / ``--pipeline-schedule``
+knob; see docs/perf_tuning.md §Pipeline schedules for the when-to-pick
+table.
 """
 import functools
+import sys
+import time
 
 import numpy as np
 
@@ -29,21 +55,94 @@ from jax import lax, shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..observability import metrics as _metrics
+from ..observability import spans as _spans
+from . import schedules as _schedules
+
+# Re-exported for callers that already import the pipeline module.
+resolve_schedule = _schedules.resolve_schedule
+schedule_info = _schedules.schedule_info
+
+
+def _check_stage_leading_dim(tree, n_slices, axis, virtual=1):
+    """Single validation (and single error format) for the stage-leading
+    dim, shared by `pipeline_apply` and `shard_stage_params`. A mismatch
+    would SILENTLY compute the wrong function: shard_map hands each
+    device shape[0]/S rows and the stage selection would drop the rest
+    (e.g. 8 stage slices on 4 devices = even stages only)."""
+    for leaf in jax.tree.leaves(tree):
+        shape = jnp.shape(leaf)
+        if len(shape) < 1 or shape[0] != n_slices:
+            hint = (f" (= {n_slices // virtual} stages x {virtual} "
+                    f"virtual slices)" if virtual > 1 else "")
+            raise ValueError(
+                f"stage_params leaf shape {shape} must lead with the "
+                f"pipeline stage count {n_slices}{hint} "
+                f"(mesh axis {axis!r})")
+
+
+def _register_autotune_workload(label):
+    """Best-effort: record the active pipeline schedule into the native
+    autotune CSV's ``schedule`` column (categorical, '-' until a
+    pipeline workload registers — same "operator opted in" discipline as
+    the compress arm). Never *imports* basics: that would trigger the
+    native build for pure-JAX pipeline users; only an already-loaded
+    core is told."""
+    mod = sys.modules.get("horovod_tpu.basics")
+    if mod is None:
+        return False
+    try:
+        return bool(mod.basics.register_pipeline_workload(label))
+    except Exception:
+        return False
+
+
+def _record_schedule(info):
+    """Trace-time schedule metadata (one per compile, not per step —
+    per-tick device work is XLA's, visible through the xplane profiler,
+    not host counters)."""
+    _register_autotune_workload(info.label)
+    if not _metrics.enabled():
+        return
+    _metrics.PIPELINE_TRACES.labels(
+        stages=str(info.stages), microbatches=str(info.n_microbatches),
+        schedule=info.label).inc()
+    _metrics.PIPELINE_BUBBLE.set(info.ideal_bubble)
+    _metrics.PIPELINE_BUBBLE_MEASURED.set(info.bubble_fraction)
+    _metrics.PIPELINE_TICKS.set(info.ticks)
+
+
+def _resolve_m(n_microbatches, S, B):
+    """M (default S — see the pipeline_apply docstring note) plus the
+    divisibility check with a copy-pasteable suggestion."""
+    M = int(n_microbatches or S)
+    if B % M != 0:
+        near = _schedules.suggest_n_microbatches(B, M)
+        raise ValueError(
+            f"batch {B} not divisible into {M} microbatches; nearest "
+            f"valid n_microbatches is {near}")
+    return M
 
 
 def pipeline_apply(stage_fn, stage_params, x, mesh, axis="pipe",
-                   n_microbatches=None, batch_axis=None):
-    """Run ``x`` through S pipeline stages laid out on ``mesh[axis]``.
+                   n_microbatches=None, batch_axis=None, schedule=None,
+                   virtual_stages=None):
+    """Run ``x`` through the pipeline stages laid out on ``mesh[axis]``.
 
     Args:
       stage_fn: ``(params_for_one_stage, h) -> h`` with ``h`` of shape
         ``[microbatch, ...]`` (shape-preserving).
       stage_params: pytree whose leaves have a leading stage dim of size
-        S == mesh.shape[axis] (stage s uses ``leaf[s]``).
+        S == mesh.shape[axis] (stage s uses ``leaf[s]``); for the
+        interleaved schedule the leading dim is S·V in *network order*
+        (slice j feeds slice j+1) and this function routes slice j to
+        device ``j % S`` internally.
       x: ``[batch, ...]`` input; ``batch`` must divide into
         ``n_microbatches`` equal microbatches.
-      n_microbatches: number of microbatches M (default: S, the minimum
-        that keeps every stage busy in steady state).
+      n_microbatches: number of microbatches M. Defaults to S — the
+        minimum that keeps every stage busy in steady state, but also
+        the M that MAXIMIZES the bubble fraction (gpipe idles
+        (S-1)/(2S-1) ≈ half the schedule at M=S). Prefer M >= 4S when
+        the batch allows; see docs/perf_tuning.md §Pipeline schedules.
       batch_axis: optional second mesh axis composing DATA parallelism
         with the pipeline (pp x dp): each microbatch's rows shard over
         it, every data replica runs the same pipeline schedule on its
@@ -53,37 +152,44 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis="pipe",
         cotangent across the data shards — ``jax.grad`` of a loss on
         these outputs IS the full-batch gradient (asserted in
         tests/test_pipeline.py); adding a manual psum would double-count.
+      schedule: ``"gpipe"`` | ``"1f1b"`` | ``"interleaved[:V]"`` |
+        ``"zb"`` (default: the ``HVD_PIPE_SCHEDULE`` env knob, then
+        gpipe). Forward execution is identical for gpipe/1f1b/zb — those
+        schedules reorder *training* ticks (see
+        :func:`make_pipeline_train_step`); interleaved changes the
+        forward layout itself.
+      virtual_stages: V for the interleaved schedule (overrides the
+        ``:V`` suffix; default 2).
 
     Returns ``[batch, ...]`` outputs (replicated across the pipe axis;
     sharded over ``batch_axis`` when given).
     """
+    name, V = _schedules.resolve_schedule(schedule, virtual_stages)
     S = int(mesh.shape[axis])
-    M = int(n_microbatches or S)
     B = x.shape[0]
-    if B % M != 0:
-        raise ValueError(f"batch {B} not divisible into {M} microbatches")
-    # A stage-count mismatch would SILENTLY compute the wrong function:
-    # shard_map hands each device shape[0]/S rows and `a[0]` would drop
-    # the rest (e.g. 8 stage slices on 4 devices = even stages only).
-    for leaf in jax.tree.leaves(stage_params):
-        if leaf.ndim < 1 or leaf.shape[0] != S:
-            raise ValueError(
-                f"stage_params leaf shape {jnp.shape(leaf)} must lead "
-                f"with the pipeline stage count {S} (mesh axis {axis!r})")
-    if _metrics.enabled():
-        # Trace-time schedule metadata (this body runs once per compile,
-        # not per step — per-tick device work is XLA's, visible through
-        # the xplane profiler, not host counters).
-        _metrics.PIPELINE_TRACES.labels(
-            stages=str(S), microbatches=str(M)).inc()
-        _metrics.PIPELINE_BUBBLE.set((S - 1) / (M + S - 1))
+    M = _resolve_m(n_microbatches, S, B)
+    _check_stage_leading_dim(stage_params, S * V, axis, virtual=V)
+    info = _schedules.schedule_info(name, S, M, V)
+    _record_schedule(info)
     mb = B // M
     xm = x.reshape((M, mb) + x.shape[1:])
-
-    fwd = [(i, i + 1) for i in range(S - 1)]
     # Microbatch rows shard over batch_axis (dp compose); the stage dim
     # of the params shards over the pipe axis either way.
     x_spec = P(None, batch_axis) if batch_axis else P()
+
+    if V == 1:
+        out = _gpipe_forward(stage_fn, stage_params, xm, mesh, axis,
+                             S, M, x_spec)
+    else:
+        out = _interleaved_forward(stage_fn, stage_params, xm, mesh,
+                                   axis, S, M, V, x_spec)
+    return out.reshape((B,) + out.shape[2:])
+
+
+def _gpipe_forward(stage_fn, stage_params, xm, mesh, axis, S, M, x_spec):
+    """The classic wavefront: stage s runs microbatch m at tick s+m —
+    the forward order every non-interleaved schedule shares."""
+    fwd = [(i, i + 1) for i in range(S - 1)]
 
     @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P(axis), x_spec), out_specs=x_spec,
@@ -128,47 +234,372 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis="pipe",
         # (every other shard contributes zeros).
         return lax.psum(out, axis)
 
-    out = run(stage_params, xm)
-    return out.reshape((B,) + out.shape[2:])
+    return run(stage_params, xm)
 
 
-def shard_stage_params(stage_params, mesh, axis="pipe"):
-    """Place a [S, ...]-leading pytree with stage s's slice on the
-    axis's s-th device row (host->mesh placement helper)."""
+def _interleaved_forward(stage_fn, stage_params, xm, mesh, axis,
+                         S, M, V, x_spec):
+    """Interleaved virtual stages: device s hosts chunks {s, S+s, ...};
+    the hop ring wraps (device S-1 -> device 0 carries the chunk-k ->
+    chunk-k+1 boundary). Chunk-boundary activations can wait up to
+    max(S, M) - S ticks for their consumer, so each device keeps a
+    microbatch-indexed inbox (one extra trash slot absorbs idle-tick
+    writes without branching on the buffer)."""
+    tabs = _schedules._forward_tables(S, M, V)
+    T = tabs["T"]
+    EXM = jnp.asarray(tabs["exec_mb"])
+    EXK = jnp.asarray(tabs["exec_chunk"])
+    RXM = jnp.asarray(tabs["recv_mb"])
+    ring = [(i, (i + 1) % S) for i in range(S)]
+    # Route network-order slice j = k*S + s to device s in chunk order:
+    # after this take, a P(axis) shard of the leading dim holds exactly
+    # its V chunks as rows k = 0..V-1.
+    perm = jnp.asarray(_schedules.interleave_permutation(S, V))
+    params_dev = jax.tree.map(lambda a: jnp.take(a, perm, axis=0),
+                              stage_params)
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(P(axis), x_spec), out_specs=x_spec,
+                       check_vma=False)
+    def run(params, xm):
+        s = lax.axis_index(axis)
+
+        def tick(carry, t):
+            inbox, out, rx = carry
+            # Deliver last tick's hop into the microbatch-indexed inbox
+            # (idle ticks write rx=zeros to the trash slot M).
+            rm = RXM[t, s]
+            inbox = lax.dynamic_update_slice(
+                inbox, rx[None],
+                (jnp.where(rm >= 0, rm, M),) + (0,) * rx.ndim)
+            m = EXM[t, s]
+            k = EXK[t, s]
+            act = m >= 0
+            mc = jnp.clip(m, 0, M - 1)
+            fresh = (s == 0) & (k == 0)
+            x_in = jnp.where(
+                fresh, xm[mc],
+                lax.dynamic_index_in_dim(inbox, mc, 0, keepdims=False))
+            p_k = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(
+                    a, jnp.clip(k, 0, V - 1), 0, keepdims=False), params)
+            y = stage_fn(p_k, x_in)
+            y = jnp.where(act, y, jnp.zeros_like(y))
+            # The final virtual stage records; everyone else forwards.
+            rec = act & (s == S - 1) & (k == V - 1)
+            out = lax.dynamic_update_slice(
+                out, jnp.where(rec, y, jnp.zeros_like(y))[None],
+                (jnp.where(rec, mc, M),) + (0,) * y.ndim)
+            rx = lax.ppermute(y, axis, ring) if S > 1 else y
+            return (inbox, out, rx), None
+
+        inbox0 = jnp.zeros((M + 1,) + xm.shape[1:], xm.dtype)
+        out0 = jnp.zeros((M + 1,) + xm.shape[1:], xm.dtype)
+        rx0 = jnp.zeros_like(xm[0])
+        (_, out, _), _ = lax.scan(tick, (inbox0, out0, rx0),
+                                  jnp.arange(T))
+        return lax.psum(out[:M], axis)
+
+    return run(params_dev, xm)
+
+
+def shard_stage_params(stage_params, mesh, axis="pipe", virtual_stages=1):
+    """Place a stage-leading pytree with stage s's slice on the axis's
+    s-th device row (host->mesh placement helper). With
+    ``virtual_stages=V`` the leading dim is S·V (network order; the
+    interleaved `pipeline_apply` routes slices to their hosting device
+    at trace time)."""
     S = int(mesh.shape[axis])
+    V = int(virtual_stages)
+    _check_stage_leading_dim(stage_params, S * V, axis, virtual=V)
 
     def place(a):
         a = np.asarray(a)
-        if a.ndim < 1 or a.shape[0] != S:
-            raise ValueError(
-                f"stage param leaf shape {a.shape} must lead with the "
-                f"stage count {S} (mesh axis {axis!r})")
         sh = NamedSharding(mesh, P(axis))
         return jax.make_array_from_callback(a.shape, sh,
                                             lambda idx: a[idx])
     return jax.tree.map(place, stage_params)
 
 
+# ---------------------------------------------------------------------------
+# Training: schedule-aware value-and-grad.
+# ---------------------------------------------------------------------------
+
+
+def _plan_zb(S, M):
+    """ZB-H1 tables, or (None, reason) when the split schedule can't be
+    made shape-stable in one `lax.scan` — the counted fallback path."""
+    if S < 2:
+        return None, "single_stage"
+    try:
+        tabs = _schedules._zb_tables(S, M)
+        w_mb, Rw = tabs["w_mb"], tabs["w_ring"]
+        # Verify the deferred (x, dy) ring never aliases: slot m % Rw is
+        # rewritten at Bx(m + Rw), which must come after Bw(m) reads it.
+        for s in range(S):
+            for t in range(tabs["T"]):
+                m = int(w_mb[t, s])
+                if m < 0:
+                    continue
+                next_write = 2 * S - 2 - s + m + Rw  # Bx tick of m + Rw
+                if m + Rw < M and next_write <= t:
+                    return None, "ring_alias"
+        if int((w_mb >= 0).sum()) != S * M:
+            return None, "unplaced_bw"
+    except Exception:
+        return None, "table_error"
+    return tabs, None
+
+
+def make_pipeline_value_and_grad(stage_fn, loss_fn, mesh, axis="pipe",
+                                 n_microbatches=None, batch_axis=None,
+                                 schedule=None, virtual_stages=None):
+    """``vg(stage_params, batch) -> (loss, grads)`` under the chosen
+    schedule. gpipe/interleaved differentiate the forward scan (autodiff
+    runs the mirrored backward); 1f1b/zb hand-schedule the backward in a
+    fused forward/backward scan with an O(S) activation ring
+    (recompute-based ``jax.vjp`` per backward tick).
+
+    The fused schedules require every ``batch`` leaf to lead with the
+    batch dim and ``loss_fn`` to be mean-decomposable over microbatches
+    (true for the usual mean MSE / mean cross-entropy): the loss is
+    computed per microbatch at the last stage *inside* the scan and the
+    cotangent seeded immediately — that in-scan seeding is what lets B
+    ticks interleave with F ticks at all. Gradients and loss match the
+    autodiff schedules to float tolerance (asserted in
+    tests/test_pipeline.py: schedules change timing, not math).
+    """
+    name, V = _schedules.resolve_schedule(schedule, virtual_stages)
+    S = int(mesh.shape[axis])
+
+    if name in ("gpipe", "interleaved"):
+        sched_arg = f"interleaved:{V}" if name == "interleaved" else name
+
+        def vg(params, batch):
+            def objective(p):
+                out = pipeline_apply(
+                    stage_fn, p, batch["x"], mesh, axis, n_microbatches,
+                    batch_axis=batch_axis, schedule=sched_arg)
+                return loss_fn(out, batch)
+            return jax.value_and_grad(objective)(params)
+        vg.schedule_label = _schedules.schedule_label(name, V)
+        return vg
+
+    # Fused 1F1B / ZB-H1. M is static here (tables are trace-time).
+    M = int(n_microbatches or S)
+    zb_tabs = None
+    if name == "zb":
+        zb_tabs, reason = _plan_zb(S, M)
+        if zb_tabs is None:
+            if _metrics.enabled():
+                _metrics.PIPELINE_ZB_FALLBACKS.labels(reason=reason).inc()
+            name = "1f1b"
+    tabs = zb_tabs if zb_tabs is not None else _schedules._onef1b_tables(S, M)
+    info = _schedules.schedule_info(name, S, M, 1)
+    vg = _fused_value_and_grad(stage_fn, loss_fn, mesh, axis, S, M,
+                               batch_axis, tabs, zb=zb_tabs is not None,
+                               info=info)
+    vg.schedule_label = info.label
+    return vg
+
+
+def _fused_value_and_grad(stage_fn, loss_fn, mesh, axis, S, M,
+                          batch_axis, tabs, zb, info):
+    """The fused 1F1B/ZB scan: per tick, an F half (wavefront forward,
+    ring-buffered stage input), a B half (recompute vjp; dx hops the
+    reverse ring, dp accumulates — or, under ZB, is deferred), and under
+    ZB a W half replaying a saved (x, dy) pair for the weight grad."""
+    T = tabs["T"]
+    FM = jnp.asarray(tabs["f_mb"])
+    BM = jnp.asarray(tabs["b_mb"])
+    WM = jnp.asarray(tabs["w_mb"]) if zb else None
+    Rw = int(tabs.get("w_ring", 1))
+    # Stage-input ring: F(m) writes slot m % R at tick s+m, B(m) reads
+    # it at 2S-2-s+m; R = 2S-1 outlives every read (the next writer of
+    # the slot, F(m+R), lands strictly after). Slot R is the trash slot
+    # for idle-tick writes.
+    R = max(1, 2 * S - 1)
+    fwd = [(i, i + 1) for i in range(S - 1)]
+    rev = [(i + 1, i) for i in range(S - 1)]
+    dp_n = int(mesh.shape[batch_axis]) if batch_axis else 1
+    x_spec = P(None, batch_axis) if batch_axis else P()
+
+    def vg(params, batch):
+        x = batch["x"]
+        B = x.shape[0]
+        _resolve_m(M, S, B)  # reuse the divisibility error + suggestion
+        _check_stage_leading_dim(params, S, axis)
+        _record_schedule(info)
+        mb = B // M
+
+        def to_microbatches(a):
+            if a.shape[0] != B:
+                raise ValueError(
+                    f"fused pipeline schedules need every batch leaf to "
+                    f"lead with the batch dim {B}, got shape {a.shape}")
+            return a.reshape((M, mb) + a.shape[1:])
+        bm_tree = jax.tree.map(to_microbatches, batch)
+
+        @functools.partial(shard_map, mesh=mesh,
+                           in_specs=(P(axis), x_spec),
+                           out_specs=(P(), P(axis)),
+                           check_vma=False)
+        def run(params, bm):
+            p_s = jax.tree.map(lambda a: a[0], params)
+            s = lax.axis_index(axis)
+            last = S - 1
+            xm = bm["x"]
+
+            def tick(carry, t):
+                cur, dyx, buf, wx, wdy, gacc, lacc = carry
+                # ---- F half: the gpipe wavefront ----
+                fm = FM[t, s]
+                fact = fm >= 0
+                fmc = jnp.clip(fm, 0, M - 1)
+                x_in = jnp.where(s == 0, xm[fmc], cur)
+                x_in = jnp.where(fact, x_in, jnp.zeros_like(x_in))
+                y = stage_fn(p_s, x_in)
+                y = jnp.where(fact, y, jnp.zeros_like(y))
+                # Ring-buffer the stage INPUT (recompute vjp at B).
+                buf = lax.dynamic_update_slice(
+                    buf, x_in[None],
+                    (jnp.where(fact, fmc % R, R),) + (0,) * x_in.ndim)
+                # Last stage seeds the cotangent from the per-microbatch
+                # loss in the SAME tick (B(m) and F(m) share it there).
+                mb_t = jax.tree.map(
+                    lambda a: lax.dynamic_index_in_dim(
+                        a, fmc, 0, keepdims=False), bm)
+                lval, dy_seed = jax.value_and_grad(
+                    lambda o: loss_fn(o, mb_t))(y)
+                lacc = lacc + jnp.where(
+                    (s == last) & fact, lval, 0.0).astype(lacc.dtype)
+                # ---- B half: dx on the critical path ----
+                bmx = BM[t, s]
+                bact = bmx >= 0
+                bmc = jnp.clip(bmx, 0, M - 1)
+                x_saved = lax.dynamic_index_in_dim(
+                    buf, bmc % R, 0, keepdims=False)
+                dy_in = jnp.where(s == last, dy_seed / (M * dp_n), dyx)
+                dy_in = jnp.where(bact, dy_in, jnp.zeros_like(dy_in))
+                _, pullback = jax.vjp(stage_fn, p_s, x_saved)
+                dp, dx = pullback(dy_in)
+                dx = jnp.where(bact, dx, jnp.zeros_like(dx))
+                if zb:
+                    # Defer dL/dw: park (x, dy) and replay at the W tick
+                    # scheduled into this stage's idle tail.
+                    wslot = (jnp.where(bact, bmc % Rw, Rw),)
+                    wx = lax.dynamic_update_slice(
+                        wx, x_saved[None], wslot + (0,) * x_saved.ndim)
+                    wdy = lax.dynamic_update_slice(
+                        wdy, dy_in[None], wslot + (0,) * dy_in.ndim)
+                    wm = WM[t, s]
+                    wact = wm >= 0
+                    wmc = jnp.clip(wm, 0, M - 1)
+                    xw = lax.dynamic_index_in_dim(
+                        wx, wmc % Rw, 0, keepdims=False)
+                    dyw = lax.dynamic_index_in_dim(
+                        wdy, wmc % Rw, 0, keepdims=False)
+                    _, pb_w = jax.vjp(stage_fn, p_s, xw)
+                    dpw, _ = pb_w(dyw)
+                    gacc = jax.tree.map(
+                        lambda g, d: g + jnp.where(wact, d,
+                                                   jnp.zeros_like(d)),
+                        gacc, dpw)
+                else:
+                    gacc = jax.tree.map(
+                        lambda g, d: g + jnp.where(bact, d,
+                                                   jnp.zeros_like(d)),
+                        gacc, dp)
+                # ---- hops ----
+                cur = lax.ppermute(y, axis, fwd) if S > 1 else y
+                dyx = lax.ppermute(dx, axis, rev) if S > 1 else dx
+                return (cur, dyx, buf, wx, wdy, gacc, lacc), None
+
+            zeros_mb = jnp.zeros_like(xm[0])
+            buf0 = jnp.zeros((R + 1,) + xm.shape[1:], xm.dtype)
+            wn = (Rw + 1) if zb else 1  # dummy 1-slot when unused
+            wx0 = jnp.zeros((wn,) + xm.shape[1:], xm.dtype)
+            wdy0 = jnp.zeros((wn,) + xm.shape[1:], xm.dtype)
+            gacc0 = jax.tree.map(jnp.zeros_like, p_s)
+            carry0 = (zeros_mb, zeros_mb, buf0, wx0, wdy0, gacc0,
+                      jnp.zeros((), jnp.float32))
+            (c, d, b_, w1, w2, gacc, lacc), _ = lax.scan(
+                tick, carry0, jnp.arange(T))
+            loss = lax.psum(lacc / M, axis)  # nonzero on stage S-1 only
+            if batch_axis:
+                loss = lax.psum(loss, batch_axis) / dp_n
+                # dy was pre-scaled by 1/(M*dp_n); summing replica grads
+                # completes the full-batch mean.
+                gacc = jax.tree.map(
+                    lambda g: lax.psum(g, batch_axis), gacc)
+            grads = jax.tree.map(lambda g: g[None], gacc)
+            return loss, grads
+
+        return run(params, bm_tree)
+    return vg
+
+
 def make_pipeline_train_step(stage_fn, loss_fn, tx, mesh, axis="pipe",
                              n_microbatches=None, batch_axis=None,
-                             jit=True):
+                             jit=True, schedule=None, virtual_stages=None):
     """Standard train step over the pipeline: ``loss_fn(outputs, batch)``
     -> scalar; grads w.r.t. the stage-sharded params; optimizer applies
     per-stage updates in place. ``batch_axis`` composes data parallelism
-    (see pipeline_apply — grads come out already reduced). Returns
+    (see pipeline_apply — grads come out already reduced); ``schedule``
+    picks the tick order (see the module docstring — 1f1b/zb run the
+    fused forward/backward scan, which needs a mean-decomposable
+    ``loss_fn``; gradients are schedule-invariant). Returns
     ``step(stage_params, opt_state, batch) -> (params, opt_state, loss)``.
+
+    With metrics enabled at build time (``HVD_METRICS=1``) the step is
+    wrapped to count PIPELINE_STEPS and emit PIPELINE_STEP /
+    PIPELINE_{WARMUP,STEADY,COOLDOWN} timeline spans (the phase spans
+    are tick-proportional estimates of the measured step wall time —
+    the host cannot observe intra-XLA tick boundaries).
     """
-    def objective(params, batch):
-        out = pipeline_apply(stage_fn, params, batch["x"], mesh, axis,
-                             n_microbatches, batch_axis=batch_axis)
-        return loss_fn(out, batch)
+    vg = make_pipeline_value_and_grad(
+        stage_fn, loss_fn, mesh, axis, n_microbatches,
+        batch_axis=batch_axis, schedule=schedule,
+        virtual_stages=virtual_stages)
 
     import optax
 
     def step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(objective)(params, batch)
+        loss, grads = vg(params, batch)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    return jax.jit(step, donate_argnums=(0, 1)) if jit else step
+    stepc = jax.jit(step, donate_argnums=(0, 1)) if jit else step
+    if not _metrics.enabled():
+        return stepc
+
+    name, V = _schedules.resolve_schedule(schedule, virtual_stages)
+    S = int(mesh.shape[axis])
+    info = _schedules.schedule_info(name, S, int(n_microbatches or S), V)
+
+    def timed_step(params, opt_state, batch):
+        t0 = time.perf_counter_ns()
+        params, opt_state, loss = stepc(params, opt_state, batch)
+        jax.block_until_ready(loss)
+        dur_us = (time.perf_counter_ns() - t0) // 1000
+        _metrics.PIPELINE_STEPS.labels(schedule=info.label).inc()
+        end_us = time.time_ns() // 1000
+        start_us = end_us - dur_us
+        _spans.event("PIPELINE_STEP", start_us, dur_us, cat="pipeline",
+                     schedule=info.label, ticks=info.ticks,
+                     bubble=round(info.bubble_fraction, 4))
+        # Tick-proportional phase estimates of the measured wall time.
+        tot = max(1, info.ticks)
+        w_us = dur_us * info.warmup_ticks // tot
+        c_us = dur_us * info.cooldown_ticks // tot
+        s_us = dur_us - w_us - c_us
+        _spans.event("PIPELINE_WARMUP", start_us, w_us,
+                     cat="pipeline", estimate=True)
+        _spans.event("PIPELINE_STEADY", start_us + w_us, s_us,
+                     cat="pipeline", estimate=True)
+        _spans.event("PIPELINE_COOLDOWN", start_us + w_us + s_us, c_us,
+                     cat="pipeline", estimate=True)
+        return params, opt_state, loss
+
+    return timed_step

@@ -49,6 +49,7 @@ ARG_TO_ENV = {
     "reduce_threads": ("HVD_REDUCE_THREADS", lambda v: str(int(v))),
     "compression": ("HVD_COMPRESS", str),
     "topk_frac": ("HVD_COMPRESS_TOPK_FRAC", lambda v: str(float(v))),
+    "pipeline_schedule": ("HVD_PIPE_SCHEDULE", str),
     "wire": ("HVD_WIRE", str),
     "wire_zc_threshold": ("HVD_WIRE_ZC_THRESHOLD", lambda v: str(int(v))),
     "numa": ("HVD_NUMA", lambda v: str(int(v))),
@@ -84,6 +85,7 @@ _FILE_SECTIONS = {
                "reduce-threads": "reduce_threads",
                "compression": "compression",
                "topk-frac": "topk_frac",
+               "pipeline-schedule": "pipeline_schedule",
                "wire": "wire",
                "wire-zc-threshold": "wire_zc_threshold",
                "numa": "numa",

@@ -100,6 +100,17 @@ def parse_args(argv=None):
                         "(0, 1] (HVD_COMPRESS_TOPK_FRAC, default 0.01): "
                         "wire bytes scale with k = max(1, round(frac*n)) "
                         "per rank; only meaningful with --compression topk")
+    p.add_argument("--pipeline-schedule", dest="pipeline_schedule",
+                   default=None,
+                   help="pipeline-parallel microbatch schedule for the "
+                        "JAX pipeline layer (HVD_PIPE_SCHEDULE): gpipe "
+                        "(the default), 1f1b (fused forward/backward "
+                        "scan, O(S) activation residency), "
+                        "interleaved[:V] (V virtual stage slices per "
+                        "device), or zb (best-effort ZB-H1 backward "
+                        "split; counted fallback to 1f1b). See "
+                        "docs/perf_tuning.md section 'Pipeline "
+                        "schedules'")
     p.add_argument("--reduce-threads", dest="reduce_threads", type=int,
                    default=None,
                    help="reduce worker-pool lanes (HVD_REDUCE_THREADS): 1 "

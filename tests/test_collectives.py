@@ -98,6 +98,31 @@ def test_autotune(tmp_path):
     }, timeout=240)
 
 
+def test_autotune_schedule_column(tmp_path):
+    """A registered pipeline workload (hvd_register_pipeline_workload)
+    stamps its schedule label into every subsequent CSV row's recorded
+    `schedule` column — so sweep scores are attributable to the schedule
+    that shaped the traffic (docs/autotune.md; the unregistered "-"
+    default is asserted by every other autotune run of this worker)."""
+    log = tmp_path / "autotune_sched.csv"
+    run_worker_job(2, "autotune_worker.py", extra_env={
+        "HVD_AUTOTUNE": "1",
+        "HVD_AUTOTUNE_LOG": str(log),
+        "HVD_AUTOTUNE_CYCLES_PER_SAMPLE": "4",
+        "HVD_AUTOTUNE_MAX_SAMPLES": "12",
+        "AT_PIPE_SCHEDULE": "interleaved2",
+        # single dimension (cache) keeps the tiny budget valid
+        "HVD_ZEROCOPY": "0",
+        "HVD_RING_PIPELINE": "1",
+        "HVD_SHM": "0",
+        "HVD_BUCKET": "0",
+        "HVD_WIRE": "basic",
+        "EXPECT_ARMS": "2",
+    }, timeout=240)
+    rows = [l for l in log.read_text().splitlines()[1:] if l]
+    assert all(l.split(",")[12] == "interleaved2" for l in rows), rows[:3]
+
+
 def test_autotune_beats_defaults_32rank(tmp_path):
     """32-rank fake pod: the locked configuration must move more bytes/sec
     than the (deliberately pathological) defaults — the categorical arms
@@ -122,6 +147,10 @@ def test_autotune_beats_defaults_32rank(tmp_path):
         "HVD_RING_PIPELINE": "1",
         "HVD_SHM": "0",
         "HVD_BUCKET": "0",
+        # wire arm pinned off too (covered by test_wire.py): a probed
+        # uring/zerocopy kernel would add a dimension and the 8-arm
+        # sweep no longer fits the 8-sample budget (sweep skipped).
+        "HVD_WIRE": "basic",
     }, timeout=600)
     text = log.read_text()
     assert text.startswith("sample,fusion_kb,cycle_ms,cache,hier,"), text

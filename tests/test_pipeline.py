@@ -4,10 +4,22 @@ schedule must match the sequential composition, forward AND gradients,
 on the virtual CPU mesh."""
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
+
+try:  # the whole parallel package needs jax >= 0.8's jax.shard_map
+    from jax import shard_map as _shard_map  # noqa: F401
+    _HAVE_SHARD_MAP = True
+except ImportError:
+    _HAVE_SHARD_MAP = False
+
+pytestmark = pytest.mark.skipif(
+    not _HAVE_SHARD_MAP,
+    reason="jax.shard_map unavailable (jax < 0.8): "
+           "horovod_tpu.parallel cannot import here")
 
 
 def test_pipeline_forward_matches_sequential():
@@ -250,3 +262,156 @@ def test_pipeline_with_remat_stage():
     g_seq = np.asarray(jax.grad(seq_loss)(jnp.asarray(W)))
     assert np.allclose(g_pipe, g_seq, atol=1e-5), np.abs(
         g_pipe - g_seq).max()
+
+
+# ---------------------------------------------------------------------------
+# Schedule parity (ISSUE 13): every schedule is a different ORDER of the
+# same math — loss and grads must match the single-device sequential
+# reference, across stage counts and composed with data parallelism.
+# ---------------------------------------------------------------------------
+
+
+def _schedule_parity_setup(S, dp, n_slices):
+    """Mesh + params + batch + the sequential reference for one case."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    D, B = 8, 16
+    cpus = jax.devices("cpu")
+    assert len(cpus) >= S * dp
+    if dp > 1:
+        mesh = Mesh(np.asarray(cpus[:S * dp]).reshape(S, dp),
+                    ("pipe", "data"))
+    else:
+        mesh = Mesh(np.asarray(cpus[:S]), ("pipe",))
+
+    rng = np.random.default_rng(7)
+    W = (rng.normal(size=(n_slices, D, D)).astype(np.float32)
+         / np.sqrt(D))
+    x = rng.normal(size=(B, D)).astype(np.float32)
+    y = np.roll(x, 1, axis=1) * 0.5
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    def loss_fn(out, batch):
+        return jnp.mean((out - batch["y"]) ** 2)
+
+    def seq_loss(Wf):
+        h = jnp.asarray(x)
+        for j in range(n_slices):
+            h = jnp.tanh(h @ Wf[j])
+        return jnp.mean((h - jnp.asarray(y)) ** 2)
+
+    xs = jnp.asarray(x)
+    if dp > 1:
+        xs = jax.device_put(xs, NamedSharding(mesh, P("data")))
+    batch = {"x": xs, "y": jnp.asarray(y)}
+    return mesh, W, batch, stage_fn, loss_fn, seq_loss
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b", "interleaved", "zb"])
+@pytest.mark.parametrize("S,dp", [(2, 1), (4, 1), (8, 1), (2, 2), (4, 2)])
+def test_schedule_parity_vs_reference(schedule, S, dp):
+    """Outputs AND gradients: each schedule x {2,4,8} stages (dp=1) and
+    x {2,4} stages (dp=2) must allclose the single-device sequential
+    composition — schedules change timing, not math."""
+    from horovod_tpu.parallel.pipeline import (make_pipeline_value_and_grad,
+                                               shard_stage_params)
+
+    V = 2 if schedule == "interleaved" else None
+    n_slices = S * (V or 1)
+    mesh, W, batch, stage_fn, loss_fn, seq_loss = _schedule_parity_setup(
+        S, dp, n_slices)
+
+    params = shard_stage_params({"w": W}, mesh, "pipe",
+                                virtual_stages=V or 1)
+    vg = make_pipeline_value_and_grad(
+        stage_fn, loss_fn, mesh, n_microbatches=S,
+        batch_axis="data" if dp > 1 else None,
+        schedule=schedule, virtual_stages=V)
+    loss, grads = vg(params, batch)
+
+    ref_loss, ref_grad = jax.value_and_grad(seq_loss)(jnp.asarray(W))
+    assert np.isclose(float(loss), float(ref_loss), atol=1e-5), (
+        schedule, S, dp, float(loss), float(ref_loss))
+    g = np.asarray(grads["w"])
+    assert g.shape == np.asarray(ref_grad).shape
+    assert np.allclose(g, np.asarray(ref_grad), atol=1e-4), (
+        schedule, S, dp, np.abs(g - np.asarray(ref_grad)).max())
+
+
+def test_divisibility_error_suggests_nearest():
+    """The divisibility error must hand the user the nearest valid
+    n_microbatches instead of a bare modulo complaint."""
+    from horovod_tpu.parallel.pipeline import (pipeline_apply,
+                                               shard_stage_params)
+
+    cpus = jax.devices("cpu")
+    mesh = Mesh(np.asarray(cpus[:4]), ("pipe",))
+    W = np.zeros((4, 4, 4), np.float32)
+    params = shard_stage_params({"w": W}, mesh)
+    with pytest.raises(ValueError,
+                       match="nearest valid n_microbatches is 4"):
+        pipeline_apply(lambda p, h: h @ p["w"], params,
+                       jnp.zeros((16, 4)), mesh, n_microbatches=5)
+
+
+def test_stage_dim_error_mentions_virtual_slices():
+    """With virtual_stages > 1 the stage-dim validator must explain the
+    S*V expectation — '6 != 4' alone would send the user hunting."""
+    from horovod_tpu.parallel.pipeline import shard_stage_params
+
+    cpus = jax.devices("cpu")
+    mesh = Mesh(np.asarray(cpus[:4]), ("pipe",))
+    with pytest.raises(ValueError, match="virtual slices"):
+        shard_stage_params({"w": np.zeros((6, 4, 4), np.float32)}, mesh,
+                           virtual_stages=2)
+
+
+def test_zb_single_stage_falls_back_and_stays_correct():
+    """S=1 can't split the backward (nothing to overlap) — zb must fall
+    back to the fused 1F1B path, count the fallback when metrics are on,
+    and still produce the exact sequential loss/grads."""
+    from horovod_tpu.observability import metrics
+    from horovod_tpu.parallel.pipeline import (make_pipeline_value_and_grad,
+                                               shard_stage_params)
+
+    D, B = 8, 16
+    cpus = jax.devices("cpu")
+    mesh = Mesh(np.asarray(cpus[:1]), ("pipe",))
+    rng = np.random.default_rng(9)
+    W = (rng.normal(size=(1, D, D)).astype(np.float32) / np.sqrt(D))
+    x = rng.normal(size=(B, D)).astype(np.float32)
+    y = np.roll(x, 1, axis=1) * 0.5
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    def loss_fn(out, batch):
+        return jnp.mean((out - batch["y"]) ** 2)
+
+    was_enabled = metrics.enabled()
+    metrics.enable()
+    try:
+        vg = make_pipeline_value_and_grad(stage_fn, loss_fn, mesh,
+                                          n_microbatches=4, schedule="zb")
+        snap = metrics.snapshot()["hvd_pipeline_zb_fallbacks_total"]
+        reasons = {s["labels"]["reason"]: s["value"]
+                   for s in snap["samples"]}
+        assert reasons.get("single_stage", 0) >= 1, snap
+    finally:
+        if not was_enabled:
+            metrics.disable()
+
+    assert vg.schedule_label == "1f1b"
+    params = shard_stage_params({"w": W}, mesh)
+    loss, grads = vg(params, {"x": jnp.asarray(x), "y": jnp.asarray(y)})
+
+    def seq_loss(Wf):
+        h = jnp.tanh(jnp.asarray(x) @ Wf[0])
+        return jnp.mean((h - jnp.asarray(y)) ** 2)
+
+    ref_loss, ref_grad = jax.value_and_grad(seq_loss)(jnp.asarray(W))
+    assert np.isclose(float(loss), float(ref_loss), atol=1e-5)
+    assert np.allclose(np.asarray(grads["w"]), np.asarray(ref_grad),
+                       atol=1e-4)

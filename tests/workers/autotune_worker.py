@@ -29,6 +29,13 @@ import horovod_tpu as hvd
 hvd.init()
 r, s = hvd.rank(), hvd.size()
 
+# Optional: register a pipeline-parallel schedule so the CSV's recorded
+# `schedule` column carries its label instead of "-" (ISSUE 13).
+_SCHED = os.environ.get("AT_PIPE_SCHEDULE", "")
+if _SCHED:
+    from horovod_tpu.basics import basics as _basics
+    assert _basics.register_pipeline_workload(_SCHED)
+
 status0, fusion0, cycle0 = hvd.autotune_state()
 assert status0 == "searching", status0
 default_fusion = 64 * 1024 * 1024
@@ -55,11 +62,16 @@ if r == 0 and log_path:
         lines = [l for l in f.read().splitlines() if l]
     assert lines[0] == \
         "sample,fusion_kb,cycle_ms,cache,hier,zerocopy,pipeline,shm," \
-        "bucket,compress,wire,affinity,score_mbps", \
+        "bucket,compress,wire,affinity,schedule,score_mbps", \
         lines[:1]
     rows = [l for l in lines[1:] if not l.startswith("#")]
     assert len(rows) == max_samples, (len(rows), max_samples)
     assert any(l.startswith("# final") for l in lines), lines[-2:]
+    # The schedule column is a recorded context field: "-" until a
+    # pipeline workload registers, the registered label afterwards.
+    want_sched = _SCHED or "-"
+    assert all(l.split(",")[12] == want_sched for l in rows), \
+        (want_sched, rows[:2])
     # More than one distinct numeric point was actually explored.
     points = {tuple(l.split(",")[1:3]) for l in rows}
     assert len(points) >= 3, points
