@@ -1967,6 +1967,131 @@ def _elastic_worker():
 # Wedge-proof driver layer (pure Python — no jax in this process).
 # --------------------------------------------------------------------------
 
+def _bench_serve():
+    """Serving plane (ISSUE 14 acceptance): the continuous-batching
+    decode loop under synthetic Poisson load at 1 and 8 ranks (8 = TP
+    mesh over forced host devices, KV cache sharded on heads), with the
+    continuous-vs-static A/B at equal offered load. Each cell is its own
+    subprocess (8-rank forces host devices before importing jax, which
+    must not leak to siblings). CPU smoke sizes per the 512 MB streaming
+    precedent: a tiny float32 model — the measured quantity is the
+    SCHEDULING win (batch-fill recovery), which is model-size
+    independent; tok/s magnitudes are not TPU claims. Emits tok/s,
+    p50/p99 TTFT and inter-token latency, and the batch-fill /
+    KV-occupancy gauges per cell; asserts continuous strictly beats
+    static tok/s wherever both cells ran."""
+    import tempfile
+
+    runs = {}
+    for ranks in (1, 8):
+        for mode in ("continuous", "static"):
+            fd, out_path = tempfile.mkstemp(prefix="hvd_bench_serve_")
+            os.close(fd)
+            try:
+                env = dict(os.environ)
+                env["PYTHONPATH"] = _repo_pythonpath(
+                    os.environ.get("PYTHONPATH"))
+                env["_BENCH_SERVE_WORKER"] = "1"
+                env["_BENCH_SERVE_OUT"] = out_path
+                env["_BENCH_SERVE_RANKS"] = str(ranks)
+                env["_BENCH_SERVE_MODE"] = mode
+                env["JAX_PLATFORMS"] = "cpu"
+                if ranks > 1:
+                    env["XLA_FLAGS"] = (
+                        env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8"
+                    ).strip()
+                rc, _ = _run_subprocess(
+                    [sys.executable, os.path.abspath(__file__)], env,
+                    60 if ranks == 1 else 120)
+                data = None
+                if rc == 0:
+                    try:
+                        with open(out_path) as f:
+                            data = json.load(f)
+                    except Exception:
+                        data = None
+                if data is None:
+                    data = {"error": f"serve child ({mode}, {ranks}r) "
+                                     f"exited rc={rc} with no JSON"}
+                runs[f"{mode}_{ranks}r"] = data
+            finally:
+                try:
+                    os.unlink(out_path)
+                except OSError:
+                    pass
+
+    c1, s1 = runs["continuous_1r"], runs["static_1r"]
+    assert "error" not in c1, c1
+    assert "error" not in s1, s1
+    # The acceptance A/B: equal offered load (same seed, same arrival
+    # process), continuous strictly higher tok/s. Static drains the
+    # whole batch before admitting, so its batch fill decays as short
+    # requests finish — exactly what the gauges show.
+    assert c1["tok_s"] > s1["tok_s"], (c1["tok_s"], s1["tok_s"])
+    assert c1["batch_fill_mean"] > s1["batch_fill_mean"], runs
+    c8, s8 = runs["continuous_8r"], runs["static_8r"]
+    if "error" not in c8 and "error" not in s8:
+        assert c8["tok_s"] > s8["tok_s"], (c8["tok_s"], s8["tok_s"])
+    d = {"metric": "serve_continuous_vs_static_throughput",
+         "value": round(c1["tok_s"] / s1["tok_s"], 3),
+         "unit": "x (continuous tok/s / static tok/s, equal Poisson "
+                 "load, 1 rank; CPU smoke sizes)",
+         "tok_s_continuous_1r": c1["tok_s"],
+         "tok_s_static_1r": s1["tok_s"],
+         "runs": runs,
+         "cpu_cores": len(os.sched_getaffinity(0)),
+         "vs_baseline": 1.0}
+    return d
+
+
+def _serve_worker():
+    """One serve-bench cell (_BENCH_SERVE_WORKER): Poisson load through
+    ServeLoop at _BENCH_SERVE_RANKS ranks in _BENCH_SERVE_MODE, summary
+    JSON to _BENCH_SERVE_OUT. Errors are written as JSON, not raised —
+    the parent carries them as an environment note."""
+    out = {}
+    try:
+        import jax
+
+        from horovod_tpu.models import transformer as tfm
+        from horovod_tpu.serving import kv_cache
+        from horovod_tpu.serving.loop import ServeLoop, poisson_requests
+
+        ranks = int(os.environ.get("_BENCH_SERVE_RANKS", "1"))
+        mode = os.environ.get("_BENCH_SERVE_MODE", "continuous")
+        mesh = None
+        if ranks > 1:
+            from jax.sharding import Mesh
+
+            devs = jax.devices()
+            assert len(devs) >= ranks, devs
+            mesh = Mesh(np.asarray(devs[:ranks]), ("model",))
+        # n_heads = 8 so the head shard divides the 8-rank TP mesh.
+        cfg = tfm.TransformerConfig(
+            vocab_size=256, d_model=64, n_heads=8, n_layers=2, d_ff=128,
+            max_seq_len=96, dtype="float32")
+        params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+        geo = kv_cache.geometry(n_pages=96, page_size=8, max_context=96)
+        n_req = int(os.environ.get("BENCH_SERVE_REQUESTS",
+                                   "32" if ranks == 1 else "12"))
+        rng = np.random.default_rng(11)
+        reqs = poisson_requests(n_req, rate=200.0, rng=rng,
+                                prompt_len=(4, 12), max_new=(2, 32),
+                                vocab=cfg.vocab_size)
+        sl = ServeLoop(params, cfg, geo=geo, mesh=mesh, max_batch=4,
+                       mode=mode)
+        sl.warmup()  # compile outside the measured window
+        summary, finished = sl.run(reqs)
+        assert len(finished) == n_req, (len(finished), n_req)
+        summary["n_ranks"] = ranks
+        out = summary
+    except Exception as e:  # noqa: BLE001 — carried, not fatal
+        out = {"error": f"{type(e).__name__}: {e}"}
+    with open(os.environ["_BENCH_SERVE_OUT"], "w") as f:
+        json.dump(out, f)
+
+
 _CONFIG_FNS = {
     "resnet50": _bench_resnet50,
     "transformer": _bench_transformer,
@@ -1980,6 +2105,7 @@ _CONFIG_FNS = {
     "moe": _bench_moe,
     "elastic": _bench_elastic,
     "pipeline": _bench_pipeline,
+    "serve": _bench_serve,
 }
 
 _METRIC_NAMES = {
@@ -1997,6 +2123,8 @@ _METRIC_NAMES = {
     "elastic": ("elastic_recovery_seconds", "s"),
     "pipeline": ("pipeline_bubble_bucket_overlap",
                  "fraction of bucket-launch time inside pipeline bubbles"),
+    "serve": ("serve_continuous_vs_static_throughput",
+              "x (continuous tok/s / static tok/s at equal Poisson load)"),
 }
 
 # Per-config wall caps (seconds). Only bind when something hangs; healthy
@@ -2032,6 +2160,9 @@ _CONFIG_CAPS = {
     # 8-host-device schedule-execution child; runs LAST in the order so
     # deadline pressure sheds it before the graded configs.
     "pipeline": 150,
+    # Four serve cells ({continuous, static} x {1, 8 ranks}), CPU smoke
+    # sizes; runs after pipeline so deadline pressure sheds it first.
+    "serve": 300,
 }
 
 _PROBE_TIMEOUT = 75
@@ -2268,7 +2399,7 @@ def main():
     results = {}
     order = ["resnet50", "transformer", "allreduce", "longctx", "hostplane",
              "bucket", "compress", "bridge", "reduce", "moe", "elastic",
-             "pipeline"]
+             "pipeline", "serve"]
     for name in order:
         cap = _cap(name)
         left = remaining() - 15  # reserve for final assembly
@@ -2317,5 +2448,7 @@ if __name__ == "__main__":
         _pipeline_bench_worker()
     elif os.environ.get("_BENCH_PIPELINE_EXEC") == "1":
         _pipeline_exec_worker()
+    elif os.environ.get("_BENCH_SERVE_WORKER") == "1":
+        _serve_worker()
     else:
         main()

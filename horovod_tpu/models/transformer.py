@@ -310,29 +310,61 @@ def _ffn(x, layer, cfg):
     return jnp.einsum("bsf,fd->bsd", h, layer["w_out"].astype(dt))
 
 
-def resolve_attn(cfg: TransformerConfig, seq_len: int, mesh=None) -> str:
-    """Resolve attn_impl="auto" to the best concrete kernel for this
-    (seq_len, mesh, backend) at trace time (VERDICT r3 #3: the framework
-    must pick its best kernel unconditionally, not make users tune it).
+# The measured flash-vs-gather crossover expressed as LIVE score
+# elements rather than a bare query length: causal self-attention at the
+# measured S=1024 v5e crossover materializes S*S/2 = 524288 live logits,
+# and that footprint — not the query length — is what the fused kernel
+# eliminates. Keying on it makes the same calibration cover asymmetric
+# shapes (chunked prefill: q=512 against an 8k KV cache is 4M live
+# elements — flash territory the old q-only rule misfiled as "gather").
+_FLASH_SCORE_ELEMS = 1024 * 1024 // 2
 
-    - sequence-sharded mesh → "ring" (the only impl that keeps K/V
-      sharded over ICI);
+
+def resolve_attn(cfg: TransformerConfig, seq_len: int, mesh=None,
+                 kv_len=None, causal=True) -> str:
+    """Resolve attn_impl="auto" to the best concrete kernel for this
+    (seq_len, kv_len, mesh, backend) at trace time (VERDICT r3 #3: the
+    framework must pick its best kernel unconditionally, not make users
+    tune it).
+
+    ``seq_len`` is the QUERY length; ``kv_len`` the key/value length
+    (defaults to ``seq_len`` — ordinary self-attention). The serving
+    plane's shapes (horovod_tpu/serving/engine.py) are what force the
+    distinction: a decode step is q_len=1 against a KV cache thousands
+    of tokens long, and a chunked prefill is a short query block against
+    a long cache.
+
+    - sequence-sharded mesh → "ring", but only for full self-attention
+      (``kv_len == seq_len``): the ring rotates K/V shards past every
+      query shard, which is meaningless for a 1-token query against an
+      externally-held cache;
     - non-TPU backend → "gather" (the pallas kernel would run in the
       interpreter: numerically right, not fast);
-    - TPU → "flash" from 1k tokens (measured on v5e, b8·bert-large: the
-      fused kernel beats the XLA gather path per-op from S=512 at
-      block=512, but end-to-end the gather path's XLA fusion wins below
-      ~1k; from S≥2048 gather materializes [B,H,S,S] logits and falls
-      behind, then OOMs), else "gather".
+    - decode (``seq_len == 1``) → "gather" REGARDLESS of kv_len: the
+      score tensor is [B,H,1,KV] — linear in KV, nothing for flash's
+      q-block tiling to eliminate, and the kernel would pad the single
+      query row to a full block;
+    - otherwise key on the LIVE score footprint: ``seq_len * kv_len``
+      elements (halved for the causal self-attention triangle) against
+      the measured S=1024 self-attention crossover. Causal mask mode
+      matters: a causal square materializes half the logits a bidirectional
+      one does, so bidirectional attention crosses to flash at ~724
+      tokens while causal crosses at 1024.
     """
     if cfg.attn_impl != "auto":
         return cfg.attn_impl
+    kv = seq_len if kv_len is None else int(kv_len)
     if (mesh is not None and cfg.seq_axis in mesh.axis_names
-            and mesh.shape[cfg.seq_axis] > 1):
+            and mesh.shape[cfg.seq_axis] > 1 and kv == seq_len):
         return "ring"
     if jax.default_backend() != "tpu":
         return "gather"
-    return "flash" if seq_len >= 1024 else "gather"
+    if seq_len == 1:
+        return "gather"
+    score = seq_len * kv
+    if causal and kv == seq_len:
+        score //= 2  # only the lower triangle is live
+    return "flash" if score >= _FLASH_SCORE_ELEMS else "gather"
 
 
 def _constrain(v, spec):

@@ -472,6 +472,34 @@ WIRE_ZC_SENDS = gauge(
 WIRE_PINNED_LANES = gauge(
     "hvd_wire_pinned_lanes",
     "Reduce-pool lanes NUMA-pinned under HVD_NUMA")
+SERVE_QUEUE_DEPTH = gauge(
+    "hvd_serve_queue_depth",
+    "Requests waiting for admission into the decode batch (the "
+    "autoscale policy's primary input — docs/serving.md)")
+SERVE_KV_OCCUPANCY = gauge(
+    "hvd_serve_kv_occupancy",
+    "Fraction of usable KV pages currently owned by running requests "
+    "(page 0 is the reserved trash page and never counts)")
+SERVE_BATCH_FILL = gauge(
+    "hvd_serve_batch_fill",
+    "Fraction of decode-batch slots doing useful work this step — the "
+    "quantity static batching wastes and continuous batching recovers")
+SERVE_TOKENS = counter(
+    "hvd_serve_tokens",
+    "Decode tokens generated (all requests, this serve loop)")
+SERVE_PREEMPTIONS = counter(
+    "hvd_serve_preemptions",
+    "Running requests preempted back to the queue on KV-page starvation "
+    "(their generated prefix replays through prefill on re-admission)")
+SERVE_TTFT_SECONDS = histogram(
+    "hvd_serve_ttft_seconds",
+    "Per-request time-to-first-token: arrival to first decoded token "
+    "(includes queueing + prefill)",
+    buckets=(.005, .01, .025, .05, .1, .25, .5, 1, 2.5, 5, 10, 30))
+SERVE_ITL_SECONDS = histogram(
+    "hvd_serve_itl_seconds",
+    "Per-request mean inter-token latency over its decode life",
+    buckets=(.001, .0025, .005, .01, .025, .05, .1, .25, .5, 1, 2.5))
 
 
 def sample_core_stats(hvd=None):

@@ -29,6 +29,13 @@ YAML schema (any subset):
     metrics:
       enable: true
       port: 9090
+    serve:
+      page-size: 16
+      kv-pages: 256
+      max-batch: 8
+      mode: continuous
+      autoscale: true
+      autoscale-high: 8
 """
 
 # arg attribute name → (env var, transform-to-env)
@@ -70,6 +77,17 @@ ARG_TO_ENV = {
     # HVD_METRICS; HVD_METRICS_PORT adds a per-worker /metrics endpoint.
     "metrics": ("HVD_METRICS", lambda v: "1" if v else "0"),
     "metrics_port": ("HVD_METRICS_PORT", str),
+    # Serving plane (horovod_tpu/serving/): KV-cache geometry and batcher
+    # mode for the serve loop (scheduler.serve_knobs), plus the driver's
+    # queue-depth autoscaler (serving/autoscale.py, consumed in
+    # runner/elastic/driver.py).
+    "serve_page_size": ("HVD_SERVE_PAGE_SIZE", lambda v: str(int(v))),
+    "serve_kv_pages": ("HVD_SERVE_KV_PAGES", lambda v: str(int(v))),
+    "serve_max_batch": ("HVD_SERVE_MAX_BATCH", lambda v: str(int(v))),
+    "serve_mode": ("HVD_SERVE_MODE", str),
+    "serve_autoscale": ("HVD_SERVE_AUTOSCALE", lambda v: "1" if v else "0"),
+    "serve_autoscale_high": ("HVD_SERVE_AUTOSCALE_HIGH",
+                             lambda v: str(int(v))),
 }
 
 _FILE_SECTIONS = {
@@ -100,6 +118,12 @@ _FILE_SECTIONS = {
                     "stall_check_shutdown_time_seconds"},
     "autotune": {"enable": "autotune", "log-file": "autotune_log_file"},
     "metrics": {"enable": "metrics", "port": "metrics_port"},
+    "serve": {"page-size": "serve_page_size",
+              "kv-pages": "serve_kv_pages",
+              "max-batch": "serve_max_batch",
+              "mode": "serve_mode",
+              "autoscale": "serve_autoscale",
+              "autoscale-high": "serve_autoscale_high"},
 }
 
 

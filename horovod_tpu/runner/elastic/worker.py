@@ -85,6 +85,25 @@ def report_eviction(rank, epoch):
         pass
 
 
+def report_serve_load(queue_depth, batch_fill, kv_occupancy=0.0):
+    """Publish the serving loop's load sample to the driver's KV store
+    (/ctl/serve_load/<wid>) for queue-depth autoscaling. Rank 0 of the
+    serve loop calls this each boundary interval; the driver consumes
+    the keys, folds them through its AutoscalePolicy, and republishes
+    the epoch with a resized active set (serving/autoscale.py). Best
+    effort like report_eviction: a lost sample just delays the next
+    scale decision by one interval."""
+    try:
+        http_server.put_kv(
+            _rdv_addr(), "ctl", f"serve_load/{_worker_id()}",
+            json.dumps({"queue_depth": int(queue_depth),
+                        "batch_fill": float(batch_fill),
+                        "kv_occupancy": float(kv_occupancy)}).encode(),
+            secret_key=_rdv_secret())
+    except Exception:
+        pass
+
+
 _driver_stats_cache = {}
 _driver_stats_ts = 0.0
 _DRIVER_STATS_TTL_S = 2.0

@@ -180,6 +180,35 @@ def parse_args(argv=None):
                    help="control-plane liveness heartbeat deadline in ms "
                         "(HVD_PEER_TIMEOUT_MS; 0 disables eviction — "
                         "docs/elastic.md)")
+    # serving plane (docs/serving.md)
+    p.add_argument("--serve-page-size", dest="serve_page_size", type=int,
+                   default=None,
+                   help="serving: KV-cache page size in token slots "
+                        "(HVD_SERVE_PAGE_SIZE; docs/serving.md)")
+    p.add_argument("--serve-kv-pages", dest="serve_kv_pages", type=int,
+                   default=None,
+                   help="serving: total KV-cache pages per replica, page 0 "
+                        "reserved (HVD_SERVE_KV_PAGES)")
+    p.add_argument("--serve-max-batch", dest="serve_max_batch", type=int,
+                   default=None,
+                   help="serving: decode-batch slots per replica "
+                        "(HVD_SERVE_MAX_BATCH)")
+    p.add_argument("--serve-mode", dest="serve_mode", default=None,
+                   choices=["continuous", "static"],
+                   help="serving: continuous batching, or the static "
+                        "baseline that drains the whole batch before "
+                        "admitting (HVD_SERVE_MODE)")
+    p.add_argument("--serve-autoscale", dest="serve_autoscale",
+                   action="store_true", default=None,
+                   help="serving: let the elastic driver resize the "
+                        "active set from /ctl/serve_load queue-depth "
+                        "reports (HVD_SERVE_AUTOSCALE; scale-up promotes "
+                        "hot spares, scale-down parks them)")
+    p.add_argument("--serve-autoscale-high", dest="serve_autoscale_high",
+                   type=int, default=None,
+                   help="serving: queue depth above which the autoscaler "
+                        "wants another rank (HVD_SERVE_AUTOSCALE_HIGH; "
+                        "hysteresis band bottom is fixed at depth<=1)")
     p.add_argument("--check-build", action="store_true",
                    help="print framework/native-layer availability and "
                         "exit (reference: horovodrun --check-build)")

@@ -1,0 +1,29 @@
+"""Serving plane: continuous-batching decode over a TP-sharded paged KV
+cache, with queue-depth autoscaling through the elastic driver.
+
+Layout (docs/serving.md is the architecture doc):
+
+- :mod:`.scheduler` — jax-free continuous batcher + page allocator
+- :mod:`.autoscale` — jax-free queue-depth policy for the driver
+- :mod:`.kv_cache`  — paged K/V arrays, heads sharded on the TP axis
+- :mod:`.engine`    — jit'd prefill / decode_step with block tables
+- :mod:`.loop`      — the serve loop: Poisson load, latency spans, gauges
+
+Lazy submodule access keeps the jax-free halves (scheduler, autoscale)
+importable — by the elastic driver and by the pure-numpy tests — without
+pulling jax into the process.
+"""
+
+import importlib
+
+_SUBMODULES = ("scheduler", "autoscale", "kv_cache", "engine", "loop")
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_SUBMODULES))

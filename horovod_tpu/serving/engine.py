@@ -1,0 +1,201 @@
+"""Decode engine: jit'd prefill + single-token decode over the paged KV
+cache, built directly on :mod:`horovod_tpu.models.transformer` params.
+
+Two compiled paths, compiled ONCE each regardless of the request mix:
+
+- **prefill**: one request's (padded) prompt through the full causal
+  forward, writing every layer's K/V into the request's pages via its
+  block table and returning the last real position's logits. Padding
+  rows compute garbage that is either overwritten by the first decode
+  write or masked by the decode read — never branched on.
+- **decode_step**: ONE token for every batch slot simultaneously —
+  embed at the slot's position, append K/V into the page slot the
+  block table names, attend over the gathered pages under a
+  ``kv_pos <= position`` causal mask, next-token logits out. Inactive
+  slots run the same program with their writes routed to trash page 0.
+
+Both paths resolve their attention kernel through
+``transformer.resolve_attn`` with the REAL (q_len, kv_len, causal)
+shape — the decode step is q_len=1 against ``max_kv`` cached tokens,
+which must resolve to "gather" (a [B,H,1,KV] score tensor is linear in
+KV; there is nothing for flash's q-tiling to eliminate). That contract
+is exactly the heuristic fix this module forced (resolve_attn keyed on
+query length alone would also have misfiled long chunked prefills).
+
+The batch-slot ↔ request mapping, page ownership, and admission policy
+live host-side in :mod:`.scheduler`; this module never allocates.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models import transformer as tfm
+from ..models.transformer import _ffn, _layer_norm, _moe_ffn
+from . import kv_cache
+
+
+def _constrain(x, mesh, spec):
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, spec))
+
+
+def _check_decode_impl(cfg, geo, mesh):
+    impl = tfm.resolve_attn(cfg, 1, mesh, kv_len=geo.max_kv, causal=True)
+    if impl != "gather":
+        raise ValueError(
+            f"serving decode needs the gather attention path for its "
+            f"q_len=1 paged reads, but attn_impl={cfg.attn_impl!r} "
+            f"resolved to {impl!r}; use attn_impl='auto' or 'gather'")
+
+
+def _ffn_block(x, layer, cfg):
+    h = _layer_norm(x, layer["ln2"])
+    if cfg.n_experts > 0:
+        return x + _moe_ffn(h, layer, cfg)
+    return x + _ffn(h, layer, cfg)
+
+
+def _qkv(h, layer, cfg):
+    qkv = jnp.einsum("bsd,dchk->cbshk", h,
+                     layer["wqkv"].astype(cfg.compute_dtype))
+    return qkv[0], qkv[1], qkv[2]
+
+
+def make_prefill(cfg, geo, mesh=None, prefill_pad=None):
+    """Compiled ``(params, cache, tokens, length, block_table) ->
+    (cache, logits)``.
+
+    tokens: [prefill_pad] int32 (zero-padded); length: scalar int32 real
+    token count; block_table: [max_blocks] int32 page ids (trash 0 past
+    the owned pages). Returns the updated cache and the last REAL
+    position's next-token logits [vocab] (float32).
+
+    ``prefill_pad`` defaults to the full cache width ``geo.max_kv`` so a
+    preempted request can replay prompt + generated prefix through the
+    same compiled program; it must cover whole pages.
+    """
+    _check_decode_impl(cfg, geo, mesh)
+    pad = geo.max_kv if prefill_pad is None else int(prefill_pad)
+    if pad % geo.page_size != 0:
+        raise ValueError(f"prefill_pad {pad} must be a multiple of "
+                         f"page_size {geo.page_size}")
+    if pad > cfg.max_seq_len:
+        raise ValueError(
+            f"prefill_pad {pad} exceeds the model's max_seq_len "
+            f"{cfg.max_seq_len} (pos_embed rows); shrink the cache "
+            f"geometry or raise max_seq_len")
+    n_blocks = pad // geo.page_size
+    dt = cfg.compute_dtype
+    kv_spec = kv_cache.spec(cfg)
+
+    def prefill(params, cache, tokens, length, block_table):
+        x = params["embed"].astype(dt)[tokens][None]
+        x = x + params["pos_embed"].astype(dt)[:pad][None]
+        ck, cv = cache["k"], cache["v"]
+        scale = 1.0 / math.sqrt(cfg.head_dim)
+        mask = jnp.tril(jnp.ones((pad, pad), bool))
+        for li, layer in enumerate(params["layers"]):
+            h = _layer_norm(x, layer["ln1"])
+            q, k, v = _qkv(h, layer, cfg)
+            # Page write: [1, pad, H, dh] -> [n_blocks, page, H, dh]
+            # scattered through the block table (garbage past `length`
+            # lands in owned-page slots the decode mask hides, or in
+            # trash page 0).
+            kp = k[0].reshape(n_blocks, geo.page_size,
+                              cfg.n_heads, cfg.head_dim)
+            vp = v[0].reshape(n_blocks, geo.page_size,
+                              cfg.n_heads, cfg.head_dim)
+            ck = ck.at[li, block_table[:n_blocks]].set(kp)
+            cv = cv.at[li, block_table[:n_blocks]].set(vp)
+            # Causal self-attention — the exact _attention math from
+            # models/transformer.py (parity is pinned by
+            # tests/test_serving.py against forward()).
+            logits = jnp.einsum("bshk,bthk->bhst", q, k) * scale
+            logits = jnp.where(mask, logits, jnp.finfo(dt).min)
+            probs = jax.nn.softmax(logits.astype(jnp.float32),
+                                   -1).astype(dt)
+            ctx = jnp.einsum("bhst,bthk->bshk", probs, v)
+            x = x + jnp.einsum("bshk,hkd->bsd", ctx,
+                               layer["wo"].astype(dt))
+            x = _ffn_block(x, layer, cfg)
+        x = _layer_norm(x, params["final_ln"])
+        last = jnp.take(x[0], length - 1, axis=0)
+        logits = jnp.einsum("d,vd->v", last, params["embed"].astype(dt))
+        ck = _constrain(ck, mesh, kv_spec)
+        cv = _constrain(cv, mesh, kv_spec)
+        return {"k": ck, "v": cv}, logits.astype(jnp.float32)
+
+    return jax.jit(prefill, donate_argnums=(1,))
+
+
+def make_decode_step(cfg, geo, mesh=None, max_batch=8):
+    """Compiled ``(params, cache, tokens, positions, block_tables,
+    active) -> (cache, logits)`` — one token for every slot.
+
+    tokens/positions/active: [max_batch] (int32/int32/bool);
+    block_tables: [max_batch, max_blocks] int32. ``positions[b]`` is the
+    index the slot's token is WRITTEN at (its context length before this
+    step); the causal read mask is ``kv_pos <= position``, so the step
+    attends to everything cached plus itself. Inactive slots write to
+    trash page 0 and their logits are garbage the scheduler never reads.
+    """
+    _check_decode_impl(cfg, geo, mesh)
+    dt = cfg.compute_dtype
+    kv_spec = kv_cache.spec(cfg)
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    max_kv = geo.max_kv
+
+    def decode(params, cache, tokens, positions, block_tables, active):
+        x = params["embed"].astype(dt)[tokens]
+        x = x + params["pos_embed"].astype(dt)[positions]
+        x = x[:, None, :]                                  # [B, 1, D]
+        ck, cv = cache["k"], cache["v"]
+        blk = positions // geo.page_size
+        slot = positions % geo.page_size
+        page_ids = jnp.take_along_axis(block_tables, blk[:, None],
+                                       axis=1)[:, 0]
+        page_ids = jnp.where(active, page_ids, 0)          # trash route
+        slot_w = jnp.where(active, slot, 0)
+        kv_mask = (jnp.arange(max_kv)[None, None, :]
+                   <= positions[:, None, None])            # [B, 1, KV]
+        for li, layer in enumerate(params["layers"]):
+            h = _layer_norm(x, layer["ln1"])
+            q, k, v = _qkv(h, layer, cfg)                  # [B, 1, H, dh]
+            ck = ck.at[li, page_ids, slot_w].set(k[:, 0])
+            cv = cv.at[li, page_ids, slot_w].set(v[:, 0])
+            # Gather the slot's pages: [B, max_blocks, page, H, dh] ->
+            # [B, max_kv, H, dh]; the block table IS the indirection
+            # that lets every context length share this one program.
+            kp = ck[li][block_tables].reshape(
+                -1, max_kv, cfg.n_heads, cfg.head_dim)
+            vp = cv[li][block_tables].reshape(
+                -1, max_kv, cfg.n_heads, cfg.head_dim)
+            logits = jnp.einsum("bshk,bthk->bhst", q, kp) * scale
+            logits = jnp.where(kv_mask[:, :, None, :].swapaxes(1, 2),
+                               logits, jnp.finfo(dt).min)
+            probs = jax.nn.softmax(logits.astype(jnp.float32),
+                                   -1).astype(dt)
+            ctx = jnp.einsum("bhst,bthk->bshk", probs, vp)
+            x = x + jnp.einsum("bshk,hkd->bsd", ctx,
+                               layer["wo"].astype(dt))
+            x = _ffn_block(x, layer, cfg)
+        x = _layer_norm(x, params["final_ln"])
+        logits = jnp.einsum("bsd,vd->bsv", x,
+                            params["embed"].astype(dt))[:, 0]
+        ck = _constrain(ck, mesh, kv_spec)
+        cv = _constrain(cv, mesh, kv_spec)
+        return {"k": ck, "v": cv}, logits.astype(jnp.float32)
+
+    return jax.jit(decode, donate_argnums=(1,))
+
+
+@functools.partial(jax.jit, static_argnums=())
+def greedy(logits):
+    """Greedy next token per row (float32 logits [.., vocab])."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)

@@ -1,0 +1,79 @@
+"""Paged KV cache: the device-side half of the serving plane's memory.
+
+Geometry: two arrays per cache, ``[n_layers, n_pages, page_size,
+n_heads, head_dim]`` for K and V. A *page* holds ``page_size`` token
+slots; requests own pages through the numpy-side
+:class:`~horovod_tpu.serving.scheduler.PageAllocator` and reach them
+through per-request **block tables** (page-id lists), so the jit'd
+decode step (:mod:`.engine`) serves requests of any mix of lengths with
+one compiled program — the indirection, not padding, absorbs the length
+variance.
+
+Page 0 is the **trash page**: the allocator never hands it out, and the
+engine routes every masked write there (inactive batch slots, padding
+positions), so the compiled scatter needs no branches.
+
+Tensor-parallel layout: heads ride the mesh's ``model`` axis — the SAME
+shard the attention weights already live on (models/transformer.py
+``param_specs``: wqkv column-parallel over heads), so a decode step's
+cache reads and writes are local to each TP shard and no K/V ever
+crosses the interconnect. ``spec()`` returns the PartitionSpec;
+:func:`make_cache` applies it when given a mesh.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheGeometry:
+    """Static shape half of the cache — everything the jit'd paths close
+    over. max_kv (= max_blocks * page_size) is the fixed KV width every
+    decode step gathers; per-request live length is masked, not shaped."""
+    n_pages: int
+    page_size: int
+    max_blocks: int      # block-table width = max context pages/request
+
+    @property
+    def max_kv(self):
+        return self.max_blocks * self.page_size
+
+
+def geometry(n_pages, page_size, max_context):
+    """Cache geometry for a max per-request context length (rounded up
+    to whole pages)."""
+    max_blocks = -(-int(max_context) // int(page_size))
+    return CacheGeometry(n_pages=int(n_pages), page_size=int(page_size),
+                         max_blocks=max_blocks)
+
+
+def spec(cfg):
+    """PartitionSpec of the K/V arrays: heads on the model axis (mirrors
+    wqkv's column-parallel head shard)."""
+    return P(None, None, None, cfg.model_axis, None)
+
+
+def make_cache(cfg, geo, mesh=None):
+    """Allocate the zeroed K/V arrays: {"k": [...], "v": [...]}, each
+    [n_layers, n_pages, page_size, n_heads, head_dim] in the model's
+    compute dtype. With a mesh, the arrays are placed sharded on the
+    model axis (when that axis exists in the mesh)."""
+    shape = (cfg.n_layers, geo.n_pages, geo.page_size, cfg.n_heads,
+             cfg.head_dim)
+    k = jnp.zeros(shape, cfg.compute_dtype)
+    v = jnp.zeros(shape, cfg.compute_dtype)
+    if mesh is not None and cfg.model_axis in mesh.axis_names:
+        sh = NamedSharding(mesh, spec(cfg))
+        k = jax.device_put(k, sh)
+        v = jax.device_put(v, sh)
+    return {"k": k, "v": v}
+
+
+def cache_bytes(cfg, geo):
+    """Total cache footprint in bytes (both K and V)."""
+    per = (cfg.n_layers * geo.n_pages * geo.page_size * cfg.n_heads *
+           cfg.head_dim * jnp.dtype(cfg.compute_dtype).itemsize)
+    return 2 * per

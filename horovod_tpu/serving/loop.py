@@ -1,0 +1,267 @@
+"""The serve loop: open-loop Poisson load in, tokens + latency spans out.
+
+One iteration = one token boundary:
+
+1. submit every request whose (open-loop) arrival time has passed —
+   arrivals do NOT wait for capacity; the queue absorbs bursts and the
+   queue DEPTH is what the autoscaler watches,
+2. admit + prefill newcomers (each prefill emits the request's first
+   token — TTFT is arrival → that token, queueing and prefill included),
+3. one jit'd decode step over every occupied slot,
+4. feed the tokens back through the scheduler boundary (evict finished,
+   grow pages, admit into the freed slots) and sample the SERVE_* gauges.
+
+Latency accounting (docs/serving.md has the formal definitions):
+TTFT = first_token_t - arrival_t per request; inter-token latency (ITL)
+= the gaps between a request's consecutive token timestamps. The
+summary reports p50/p99 over all requests' TTFTs and over ALL gaps.
+
+Every request also becomes one ``serve.request`` span (arrival →
+finish, with rid/tokens/ttft_ms args) on the observability timeline, so
+a merged trace shows request lifetimes above the per-step
+``serve.prefill`` / ``serve.decode_step`` spans.
+"""
+
+import time
+
+import numpy as np
+
+from ..observability import metrics as _metrics
+from ..observability import spans as _spans
+from . import engine, kv_cache
+from .scheduler import (DEFAULT_KV_PAGES, DEFAULT_MAX_BATCH,
+                        DEFAULT_PAGE_SIZE, ContinuousBatcher, PageAllocator,
+                        Request)
+
+
+def poisson_requests(n, rate, rng, prompt_len=(4, 32), max_new=(4, 64),
+                     vocab=256, eos_id=-1):
+    """Synthetic open-loop load: `n` requests with exponential
+    inter-arrival gaps (rate = requests/second) and uniform prompt /
+    max-new-token draws. The max_new spread is what continuous batching
+    monetizes: short requests finish early and their slots refill while
+    a static batch would idle them until the longest request drains."""
+    reqs, t = [], 0.0
+    lo_p, hi_p = prompt_len
+    lo_n, hi_n = max_new
+    for i in range(n):
+        t += float(rng.exponential(1.0 / rate))
+        prompt = rng.integers(0, vocab,
+                              size=int(rng.integers(lo_p, hi_p + 1)))
+        reqs.append(Request(
+            rid=i, prompt=[int(x) for x in prompt],
+            max_new_tokens=int(rng.integers(lo_n, hi_n + 1)),
+            arrival_t=t, eos_id=eos_id))
+    return reqs
+
+
+class ServeLoop:
+    """Continuous-batching serve loop over one model replica.
+
+    `mode` picks the scheduler ("continuous" vs the "static" A/B
+    baseline); the engine paths are identical either way — the A/B
+    isolates the scheduling policy. `load_reporter`, when set, is called
+    every `report_interval` boundaries with (queue_depth, batch_fill,
+    kv_occupancy) — wire it to runner.elastic.worker.report_serve_load
+    to drive the driver's queue-depth autoscaler."""
+
+    def __init__(self, params, cfg, geo=None, mesh=None,
+                 max_batch=DEFAULT_MAX_BATCH, mode="continuous",
+                 load_reporter=None, report_interval=16):
+        if geo is None:
+            geo = kv_cache.geometry(DEFAULT_KV_PAGES, DEFAULT_PAGE_SIZE,
+                                    cfg.max_seq_len)
+        self.params = params
+        self.cfg = cfg
+        self.geo = geo
+        self.mesh = mesh
+        self.max_batch = int(max_batch)
+        self.mode = mode
+        self.load_reporter = load_reporter
+        self.report_interval = int(report_interval)
+        self.prefill_fn = engine.make_prefill(cfg, geo, mesh)
+        self.decode_fn = engine.make_decode_step(cfg, geo, mesh, max_batch)
+        self.cache = kv_cache.make_cache(cfg, geo, mesh)
+        self.alloc = PageAllocator(geo.n_pages, geo.page_size)
+        self.batcher = ContinuousBatcher(self.alloc, max_batch, mode)
+
+    def warmup(self):
+        """Compile the prefill/decode/argmax jits outside any measured
+        window. Every cache write routes to trash page 0 (all-zero block
+        table, all-inactive batch), so the cache stays semantically
+        untouched. bench.py calls this before starting the A/B clock so
+        compile time never pollutes the throughput comparison."""
+        toks = np.zeros(self.geo.max_kv, np.int32)
+        bt = np.zeros(self.geo.max_blocks, np.int32)
+        self.cache, logits = self.prefill_fn(
+            self.params, self.cache, toks, np.int32(1), bt)
+        int(engine.greedy(logits))
+        B = self.max_batch
+        self.cache, logits = self.decode_fn(
+            self.params, self.cache, np.zeros(B, np.int32),
+            np.zeros(B, np.int32),
+            np.zeros((B, self.geo.max_blocks), np.int32),
+            np.zeros(B, bool))
+        np.asarray(engine.greedy(logits))
+
+    # -- per-request engine calls ----------------------------------------
+
+    def _prefill(self, req):
+        """Run the request's (re-)prefill and return its next token."""
+        ctx = list(req.prompt) + list(req.generated)
+        toks = np.zeros(self.geo.max_kv, np.int32)
+        toks[:len(ctx)] = ctx
+        bt = np.asarray(self.batcher.block_table(req, self.geo.max_blocks),
+                        np.int32)
+        with _spans.span("serve.prefill", cat="serve", rid=req.rid,
+                         context=len(ctx)):
+            self.cache, logits = self.prefill_fn(
+                self.params, self.cache, toks, np.int32(len(ctx)), bt)
+        return int(engine.greedy(logits))
+
+    def _decode(self):
+        """One jit'd decode step over every occupied slot; returns
+        {slot: token}."""
+        B, mb = self.max_batch, self.geo.max_blocks
+        tokens = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        tables = np.zeros((B, mb), np.int32)
+        active = np.zeros(B, bool)
+        for slot, req in self.batcher.running.items():
+            tokens[slot] = req.generated[-1]
+            positions[slot] = req.context_len - 1
+            tables[slot] = self.batcher.block_table(req, mb)
+            active[slot] = True
+        with _spans.span("serve.decode_step", cat="serve",
+                         fill=self.batcher.batch_fill()):
+            self.cache, logits = self.decode_fn(
+                self.params, self.cache, tokens, positions, tables, active)
+        out = np.asarray(engine.greedy(logits))
+        return {s: int(out[s]) for s in list(self.batcher.running)}
+
+    # -- the loop ---------------------------------------------------------
+
+    def run(self, requests, clock=time.monotonic):
+        """Serve `requests` (arrival_t = seconds from start) to
+        completion; returns (summary dict, finished Request list)."""
+        for r in requests:
+            if r.prompt_len >= self.geo.max_kv:
+                raise ValueError(f"request {r.rid}: prompt {r.prompt_len} "
+                                 f">= cache context {self.geo.max_kv}")
+            # Cap generation to the cache geometry so a block table can
+            # never overflow mid-decode.
+            r.max_new_tokens = min(r.max_new_tokens,
+                                   self.geo.max_kv - r.prompt_len)
+        pending = sorted(requests, key=lambda r: r.arrival_t)
+        token_times = {}          # rid -> [t, ...] production timestamps
+        finished = []
+        prefilled = {}            # rid -> admit_seq at last prefill
+        fill_samples, occ_samples = [], []
+        boundaries = 0
+        wall_t0_us = time.time_ns() // 1000
+        t0 = clock()
+        preempt_seen = 0
+
+        def _now():
+            return clock() - t0
+
+        def _boundary(done, produced_at):
+            nonlocal preempt_seen, boundaries
+            for req in done:
+                prefilled.pop(req.rid, None)
+                finished.append(req)
+                ttft = req.first_token_t - req.arrival_t
+                _metrics.SERVE_TTFT_SECONDS.observe(max(0.0, ttft))
+                gaps = np.diff(token_times.get(req.rid, []))
+                if len(gaps):
+                    _metrics.SERVE_ITL_SECONDS.observe(float(np.mean(gaps)))
+                _spans.event("serve.request",
+                             wall_t0_us + req.arrival_t * 1e6,
+                             (req.finished_t - req.arrival_t) * 1e6,
+                             cat="serve", rid=req.rid,
+                             tokens=len(req.generated),
+                             reason=req.finish_reason,
+                             preemptions=req.preemptions,
+                             ttft_ms=round(ttft * 1e3, 3))
+            _metrics.SERVE_QUEUE_DEPTH.set(self.batcher.queue_depth())
+            _metrics.SERVE_BATCH_FILL.set(self.batcher.batch_fill())
+            _metrics.SERVE_KV_OCCUPANCY.set(self.batcher.kv_occupancy())
+            _metrics.SERVE_TOKENS.inc(len(produced_at))
+            new_preempt = self.batcher.stats["preemptions"] - preempt_seen
+            if new_preempt:
+                _metrics.SERVE_PREEMPTIONS.inc(new_preempt)
+                preempt_seen = self.batcher.stats["preemptions"]
+            fill_samples.append(self.batcher.batch_fill())
+            occ_samples.append(self.batcher.kv_occupancy())
+            boundaries += 1
+            if (self.load_reporter is not None
+                    and boundaries % self.report_interval == 0):
+                self.load_reporter(self.batcher.queue_depth(),
+                                   self.batcher.batch_fill(),
+                                   self.batcher.kv_occupancy())
+
+        while pending or not self.batcher.idle():
+            now = _now()
+            while pending and pending[0].arrival_t <= now:
+                self.batcher.submit(pending.pop(0), now)
+            self.batcher.admit(now)
+            # Prefill anything (re-)admitted since its last prefill. Each
+            # prefill's token runs a boundary, which may admit more — so
+            # rescan until the running set is fully prefilled.
+            while True:
+                todo = [r for r in self.batcher.running.values()
+                        if prefilled.get(r.rid) != r.admit_seq]
+                if not todo:
+                    break
+                req = min(todo, key=lambda r: r.admit_seq)
+                tok = self._prefill(req)
+                prefilled[req.rid] = req.admit_seq
+                t = _now()
+                token_times.setdefault(req.rid, []).append(t)
+                done = self.batcher.on_tokens({req.slot: tok}, t)
+                _boundary(done, (req.rid,))
+            if self.batcher.running:
+                by_slot = self._decode()
+                t = _now()
+                rids = [self.batcher.running[s].rid for s in by_slot]
+                for s in by_slot:
+                    token_times.setdefault(
+                        self.batcher.running[s].rid, []).append(t)
+                done = self.batcher.on_tokens(by_slot, t)
+                _boundary(done, rids)
+            elif pending:
+                # Idle until the next arrival (open loop: don't spin).
+                time.sleep(min(0.005,
+                               max(0.0, pending[0].arrival_t - _now())))
+
+        return self._summary(finished, token_times, _now(),
+                             fill_samples, occ_samples), finished
+
+    def _summary(self, finished, token_times, duration, fills, occs):
+        ttfts = [r.first_token_t - r.arrival_t for r in finished]
+        gaps = np.concatenate(
+            [np.diff(ts) for ts in token_times.values() if len(ts) > 1]
+        ) if any(len(ts) > 1 for ts in token_times.values()) else np.array([0.0])
+        tokens = sum(len(r.generated) for r in finished)
+        return {
+            "mode": self.mode,
+            "requests": len(finished),
+            "tokens": int(tokens),
+            "duration_s": round(float(duration), 4),
+            "tok_s": round(tokens / max(duration, 1e-9), 2),
+            "ttft_p50_ms": _pct_ms(ttfts, 50),
+            "ttft_p99_ms": _pct_ms(ttfts, 99),
+            "itl_p50_ms": _pct_ms(gaps, 50),
+            "itl_p99_ms": _pct_ms(gaps, 99),
+            "batch_fill_mean": round(float(np.mean(fills)), 4) if fills
+            else 0.0,
+            "kv_occupancy_mean": round(float(np.mean(occs)), 4) if occs
+            else 0.0,
+            "preemptions": self.batcher.stats["preemptions"],
+        }
+
+
+def _pct_ms(xs, q):
+    if not len(xs):
+        return 0.0
+    return round(float(np.percentile(np.asarray(xs), q)) * 1e3, 3)

@@ -1,0 +1,340 @@
+"""Continuous-batching scheduler + paged-KV page accounting (jax-free).
+
+The serving plane's control half. Everything here is deliberately plain
+Python/numpy — no jax import anywhere in this module — so the scheduling
+invariants (admission, eviction, page conservation, batch-fill
+monotonicity) are testable without an accelerator stack, the same way
+:mod:`horovod_tpu.parallel.schedules` keeps its pipeline tables
+numpy-only (tests/test_pipeline_schedules.py is the idiom this module's
+tests mirror).
+
+Model (vLLM-style continuous batching, scoped to what the decode engine
+in :mod:`.engine` executes):
+
+- The KV cache is ``n_pages`` fixed-size pages of ``page_size`` token
+  slots each. A request owns ceil(context_len / page_size) pages,
+  recorded in its **block table** — the indirection that lets requests
+  of wildly different lengths share ONE jit'd decode step
+  (``docs/serving.md``).
+- The batch is ``max_batch`` *slots*. A request keeps its slot for its
+  whole running life (the engine indexes cache writes by slot-stable
+  block tables, so slot churn would mean recompilation or copies).
+- **Admission happens at token boundaries**: after every decode step the
+  scheduler evicts finished requests (EOS / max-tokens), grows pages for
+  requests crossing a page boundary, and admits waiting requests into
+  free slots while their first allocation (prompt pages + one decode
+  page) fits. That is the whole continuous-batching optimization — a
+  static batch instead holds admissions until the ENTIRE batch drains.
+- **Preemption**: when a running request crosses a page boundary and no
+  page is free, the *youngest* running request is evicted back to the
+  waiting queue (its pages freed, its generated tokens kept so the
+  re-prefill replays prompt + generated prefix). Admission-reserved
+  pages can therefore never deadlock the batch: the oldest request can
+  always finish.
+
+Page accounting contract (tests/test_serving_scheduler.py pins these):
+``free + sum(owned) == n_pages - 1`` at every boundary (page 0 is the
+engine's trash page for masked writes and is never handed out), a page
+is never owned twice, and ``free()`` of a page not currently owned
+raises instead of corrupting the pool.
+"""
+
+import collections
+import dataclasses
+import math
+import os
+
+
+def _int(raw, default):
+    try:
+        return int(raw or default)
+    except ValueError:
+        return default
+
+
+# Knob defaults (CLI `--serve-*` / YAML `serve:` / env HVD_SERVE_* —
+# docs/running.md knob table; parity held by tools/hvdlint.py).
+DEFAULT_PAGE_SIZE = 16
+DEFAULT_KV_PAGES = 256
+DEFAULT_MAX_BATCH = 8
+
+
+def serve_knobs():
+    """The serve loop's HVD_SERVE_* env knobs (set directly or via the
+    tpurun --serve-* flags / YAML `serve:` section — docs/running.md)."""
+    mode = os.environ.get("HVD_SERVE_MODE", "") or "continuous"
+    return {
+        "page_size": _int(os.environ.get("HVD_SERVE_PAGE_SIZE", ""),
+                          DEFAULT_PAGE_SIZE),
+        "kv_pages": _int(os.environ.get("HVD_SERVE_KV_PAGES", ""),
+                         DEFAULT_KV_PAGES),
+        "max_batch": _int(os.environ.get("HVD_SERVE_MAX_BATCH", ""),
+                          DEFAULT_MAX_BATCH),
+        "mode": mode,
+    }
+
+
+class PageError(RuntimeError):
+    """KV-page accounting violation (double-free / foreign page)."""
+
+
+class PageAllocator:
+    """Fixed pool of KV pages with a free list and strict ownership.
+
+    Page 0 is reserved as the engine's trash page (inactive batch slots
+    route their cache writes there) and is never allocated. ``alloc`` is
+    all-or-nothing so a half-admitted request can never leak pages.
+    """
+
+    def __init__(self, n_pages, page_size):
+        if n_pages < 2:
+            raise ValueError(f"need >= 2 KV pages (1 is the reserved "
+                             f"trash page), got {n_pages}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self._free = collections.deque(range(1, self.n_pages))
+        self._owned = set()
+
+    @property
+    def usable_pages(self):
+        """Pages that can ever be handed out (excludes the trash page)."""
+        return self.n_pages - 1
+
+    def free_pages(self):
+        return len(self._free)
+
+    def used_pages(self):
+        return len(self._owned)
+
+    def occupancy(self):
+        """Fraction of usable pages currently owned — the
+        SERVE_KV_OCCUPANCY gauge."""
+        return len(self._owned) / max(1, self.usable_pages)
+
+    def alloc(self, n):
+        """Take `n` pages or none. Returns the page list, or None when
+        the pool cannot cover the request."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        pages = [self._free.popleft() for _ in range(n)]
+        self._owned.update(pages)
+        return pages
+
+    def free(self, pages):
+        """Return pages to the pool. A page not currently owned (double
+        free, or a number that was never allocated) raises PageError
+        BEFORE any state changes — the pool stays consistent."""
+        pages = list(pages)
+        for p in pages:
+            if p not in self._owned:
+                raise PageError(f"free of unowned KV page {p} (double "
+                                f"free or foreign page)")
+        for p in pages:
+            self._owned.discard(p)
+            self._free.append(p)
+
+
+_WAITING, _RUNNING, _DONE = "waiting", "running", "done"
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``prompt`` is the token list; the
+    scheduler only reads its length — the engine feeds the tokens."""
+    rid: int
+    prompt: list
+    max_new_tokens: int
+    arrival_t: float = 0.0
+    eos_id: int = -1           # -1: never matches (length-capped only)
+
+    # lifecycle (scheduler-owned)
+    state: str = _WAITING
+    slot: int = -1
+    pages: list = dataclasses.field(default_factory=list)
+    generated: list = dataclasses.field(default_factory=list)
+    admitted_t: float = 0.0
+    first_token_t: float = 0.0  # TTFT anchor (0 until the first token)
+    finished_t: float = 0.0
+    finish_reason: str = ""
+    preemptions: int = 0
+    admit_seq: int = -1         # admission order (preemption picks max)
+
+    @property
+    def prompt_len(self):
+        return len(self.prompt)
+
+    @property
+    def context_len(self):
+        """Tokens currently in the KV cache once running: the prompt plus
+        every generated token (each decode step appends one)."""
+        return len(self.prompt) + len(self.generated)
+
+    def pages_needed(self, page_size, extra_tokens=1):
+        """Pages for the current context plus `extra_tokens` upcoming
+        positions (admission reserves the first decode slot too, so a
+        fresh admit can always take at least one step)."""
+        return math.ceil((self.context_len + extra_tokens) / page_size)
+
+
+class ContinuousBatcher:
+    """Token-boundary scheduler over a PageAllocator and `max_batch`
+    engine slots.
+
+    mode="continuous": admit into any free slot whenever pages allow.
+    mode="static": the A/B baseline — admissions only happen when the
+    running set is EMPTY (classic padded static batching: the batch
+    drains fully, finished requests' slots idle until the last one ends).
+    """
+
+    def __init__(self, allocator, max_batch=DEFAULT_MAX_BATCH,
+                 mode="continuous"):
+        if mode not in ("continuous", "static"):
+            raise ValueError(f"serve mode must be 'continuous' or "
+                             f"'static', got {mode!r}")
+        self.alloc = allocator
+        self.max_batch = int(max_batch)
+        self.mode = mode
+        self.waiting = collections.deque()
+        self.running = {}          # slot -> Request
+        self.done = []
+        self._admit_seq = 0
+        self.stats = {"admissions": 0, "evictions": 0, "preemptions": 0,
+                      "tokens": 0}
+
+    # -- gauges -----------------------------------------------------------
+
+    def queue_depth(self):
+        return len(self.waiting)
+
+    def batch_fill(self):
+        """Fraction of engine slots doing useful work this step — the
+        SERVE_BATCH_FILL gauge (the quantity static batching wastes)."""
+        return len(self.running) / max(1, self.max_batch)
+
+    def kv_occupancy(self):
+        return self.alloc.occupancy()
+
+    # -- submission -------------------------------------------------------
+
+    def submit(self, req, now=0.0):
+        req.arrival_t = now if req.arrival_t == 0.0 else req.arrival_t
+        req.state = _WAITING
+        self.waiting.append(req)
+
+    # -- token boundary ---------------------------------------------------
+
+    def on_tokens(self, tokens_by_slot, now=0.0):
+        """Record one decode step's outputs (slot -> token id), then run
+        the boundary: evict finished, grow pages (preempting if starved),
+        admit. Returns the list of requests evicted as DONE this
+        boundary."""
+        finished = []
+        for slot, tok in tokens_by_slot.items():
+            req = self.running.get(slot)
+            if req is None:
+                continue
+            req.generated.append(tok)
+            self.stats["tokens"] += 1
+            if req.first_token_t == 0.0:
+                req.first_token_t = now
+            if tok == req.eos_id:
+                req.finish_reason = "eos"
+            elif len(req.generated) >= req.max_new_tokens:
+                req.finish_reason = "max_tokens"
+            if req.finish_reason:
+                finished.append(self._finish(req, now))
+        self._grow_pages(now)
+        self.admit(now)
+        return finished
+
+    def _finish(self, req, now):
+        del self.running[req.slot]
+        self.alloc.free(req.pages)
+        req.pages = []
+        req.state = _DONE
+        req.finished_t = now
+        req.slot = -1
+        self.done.append(req)
+        self.stats["evictions"] += 1
+        return req
+
+    def _grow_pages(self, now):
+        """Every running request must own a page slot for its NEXT token
+        position before the next decode step. Requests crossing a page
+        boundary take one page; page starvation preempts the youngest
+        running request (freeing its pages) until the growth fits."""
+        for slot in sorted(self.running):
+            req = self.running.get(slot)
+            if req is None:
+                continue  # preempted by an earlier growth this boundary
+            while len(req.pages) < req.pages_needed(self.alloc.page_size):
+                got = self.alloc.alloc(1)
+                if got is not None:
+                    req.pages.extend(got)
+                    continue
+                victim = max(self.running.values(),
+                             key=lambda r: r.admit_seq)
+                if victim is req:
+                    # Nothing younger to preempt: this request IS the
+                    # youngest. Preempt it rather than stall the batch.
+                    self._preempt(req, now)
+                    break
+                self._preempt(victim, now)
+
+    def _preempt(self, req, now):
+        """Back to the waiting queue, pages freed, generated prefix kept
+        (the re-prefill replays prompt + generated so no tokens are
+        lost). Preempted requests go to the FRONT of the queue — they
+        have priority over never-admitted work."""
+        del self.running[req.slot]
+        self.alloc.free(req.pages)
+        req.pages = []
+        req.slot = -1
+        req.state = _WAITING
+        req.preemptions += 1
+        self.stats["preemptions"] += 1
+        self.waiting.appendleft(req)
+
+    def admit(self, now=0.0):
+        """Fill free slots from the waiting queue while the first
+        allocation fits. Returns newly admitted requests (they need a
+        prefill before the next decode step)."""
+        if self.mode == "static" and self.running:
+            return []
+        admitted = []
+        free_slots = [s for s in range(self.max_batch)
+                      if s not in self.running]
+        while self.waiting and free_slots:
+            req = self.waiting[0]
+            need = req.pages_needed(self.alloc.page_size)
+            pages = self.alloc.alloc(need)
+            if pages is None:
+                break  # head-of-line: keep arrival order, wait for pages
+            self.waiting.popleft()
+            req.pages = pages
+            req.slot = free_slots.pop(0)
+            req.state = _RUNNING
+            req.admitted_t = now
+            req.admit_seq = self._admit_seq
+            self._admit_seq += 1
+            self.running[req.slot] = req
+            self.stats["admissions"] += 1
+            admitted.append(req)
+        return admitted
+
+    def block_table(self, req, max_blocks):
+        """The request's page list padded with trash page 0 to the
+        engine's fixed block-table width."""
+        if len(req.pages) > max_blocks:
+            raise ValueError(
+                f"request {req.rid} holds {len(req.pages)} pages > "
+                f"max_blocks {max_blocks} (context "
+                f"{req.context_len} too long for the cache geometry)")
+        return list(req.pages) + [0] * (max_blocks - len(req.pages))
+
+    def idle(self):
+        return not self.waiting and not self.running

@@ -16,7 +16,10 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 from .util import _REPO
+from .util import have_shard_map
 
 BENCH = os.path.join(_REPO, "bench.py")
 
@@ -34,6 +37,7 @@ def _run_bench(extra_env, timeout):
     return p, lines
 
 
+@pytest.mark.skipif(not have_shard_map(), reason="jax.shard_map unavailable (jax < 0.8): the graded moe bench config cannot import horovod_tpu.parallel here")
 def test_hung_config_is_killed_and_rest_still_measure():
     """transformer hangs forever; the parent must kill it at the (tiny)
     sub-deadline, emit its error line in sequence, and still deliver

@@ -8,11 +8,23 @@ jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 import optax  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
-from jax import shard_map  # noqa: E402
 
-from horovod_tpu.ops import jax_ops  # noqa: E402
-from horovod_tpu.parallel import create_mesh, make_train_step  # noqa: E402
-from horovod_tpu.parallel.data_parallel import replicate, shard_batch  # noqa: E402
+try:  # the mesh layer needs jax >= 0.8's jax.shard_map (PR 13 gate)
+    from jax import shard_map  # noqa: E402
+    _HAVE_SHARD_MAP = True
+except ImportError:
+    _HAVE_SHARD_MAP = False
+
+pytestmark = pytest.mark.skipif(
+    not _HAVE_SHARD_MAP,
+    reason="jax.shard_map unavailable (jax < 0.8): "
+           "horovod_tpu.parallel cannot import here")
+
+if _HAVE_SHARD_MAP:
+    from horovod_tpu.ops import jax_ops  # noqa: E402
+    from horovod_tpu.parallel import create_mesh, make_train_step  # noqa: E402
+    from horovod_tpu.parallel.data_parallel import (  # noqa: E402
+        replicate, shard_batch)
 
 
 @pytest.fixture(scope="module")

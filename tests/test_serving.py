@@ -1,0 +1,321 @@
+"""Serving-plane engine + loop (ISSUE 14) — the jax half.
+
+What tier-1 pins here:
+
+- **Decode parity**: the paged-KV incremental decode (prefill once, then
+  one token per jit'd step through block-table indirection) produces the
+  SAME logits and the same greedy chain as running the full
+  ``transformer.forward`` over the growing sequence. This is the
+  correctness contract of the whole serving plane — the cache layout,
+  the position convention (token ``generated[-1]`` lands at position
+  ``context_len - 1``, attending kv_pos <= position), and the trash-page
+  masking all collapse into this one comparison.
+- **Mixed lengths, one step**: requests at different context lengths
+  share a single jit'd decode step (the point of the block table);
+  each slot matches its own full-forward reference.
+- **resolve_attn decode shapes**: the auto-resolver keys on KV length
+  and causal mode (satellite: a q_len=1 decode step must pick "gather"
+  regardless of cache length; a chunked prefill crosses to "flash" on
+  live-score footprint; the pre-existing self-attention threshold is
+  unchanged).
+- **ServeLoop**: end-to-end continuous batching over Poisson arrivals —
+  all requests finish, the continuous-vs-static batch-fill gap is
+  scheduling (not timing), preemption replays losslessly.
+- **Driver autoscale**: the elastic driver consumes /ctl/serve_load
+  observations and folds them into a target world size.
+
+The jax-free scheduling invariants live in
+tests/test_serving_scheduler.py (numpy-only).
+"""
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from horovod_tpu.models import transformer as tfm  # noqa: E402
+from horovod_tpu.serving import engine, kv_cache  # noqa: E402
+from horovod_tpu.serving.loop import (ServeLoop,  # noqa: E402
+                                      poisson_requests)
+
+pytestmark = pytest.mark.serve
+
+
+def _cfg(**kw):
+    """float32 so logits parity is tight (tiny() is bf16)."""
+    base = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                d_ff=64, max_seq_len=64, dtype="float32")
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
+
+
+def _ref_logits(params, cfg, seq):
+    """Full-forward reference: logits for the NEXT token after `seq`."""
+    return np.asarray(
+        tfm.forward(params, np.asarray([seq], np.int32), cfg)[0, -1],
+        np.float32)
+
+
+# ---------------------------------------------------------------------------
+# engine parity
+# ---------------------------------------------------------------------------
+
+def test_decode_parity_with_forward():
+    cfg = _cfg()
+    geo = kv_cache.geometry(n_pages=16, page_size=8, max_context=64)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    prefill = engine.make_prefill(cfg, geo)
+    decode = engine.make_decode_step(cfg, geo, max_batch=1)
+    cache = kv_cache.make_cache(cfg, geo)
+
+    rng = np.random.default_rng(3)
+    prompt = [int(x) for x in rng.integers(0, cfg.vocab_size, size=9)]
+    n_new = 10
+    pages = list(range(1, 1 + (len(prompt) + n_new + geo.page_size - 1)
+                       // geo.page_size))
+    bt = np.asarray(pages + [0] * (geo.max_blocks - len(pages)), np.int32)
+
+    toks = np.zeros(geo.max_kv, np.int32)
+    toks[:len(prompt)] = prompt
+    cache, logits = prefill(params, cache, toks, np.int32(len(prompt)), bt)
+    step_logits = [np.asarray(logits, np.float32)]
+    seq = list(prompt) + [int(engine.greedy(logits))]
+
+    for _ in range(n_new - 1):
+        # the newest token goes in at position len(seq)-1 and predicts
+        # the next one.
+        cache, logits = decode(
+            params, cache,
+            np.asarray([seq[-1]], np.int32),
+            np.asarray([len(seq) - 1], np.int32),
+            bt[None, :], np.asarray([True]))
+        step_logits.append(np.asarray(logits[0], np.float32))
+        seq.append(int(engine.greedy(logits)[0]))
+
+    # ONE full forward over the final sequence references every step:
+    # causal attention makes logits[i] a function of seq[:i+1] alone, so
+    # per-position agreement + argmax consistency proves (by induction)
+    # the incremental chain equals full-recompute greedy decoding.
+    ref_all = np.asarray(
+        tfm.forward(params, np.asarray([seq], np.int32), cfg)[0],
+        np.float32)
+    for i, got in enumerate(step_logits):
+        pos = len(prompt) + i - 1      # position that produced seq[pos+1]
+        np.testing.assert_allclose(got, ref_all[pos],
+                                   rtol=1e-4, atol=1e-5)
+        assert seq[pos + 1] == int(np.argmax(ref_all[pos]))
+
+
+def test_mixed_lengths_share_one_decode_step():
+    """Two requests at different context lengths decode in ONE jit'd
+    step via their block tables; each slot matches its own reference."""
+    cfg = _cfg()
+    geo = kv_cache.geometry(n_pages=16, page_size=8, max_context=64)
+    params = tfm.init_params(jax.random.PRNGKey(1), cfg)
+    prefill = engine.make_prefill(cfg, geo)
+    decode = engine.make_decode_step(cfg, geo, max_batch=3)
+    cache = kv_cache.make_cache(cfg, geo)
+
+    rng = np.random.default_rng(5)
+    seqs = [[int(x) for x in rng.integers(0, cfg.vocab_size, size=n)]
+            for n in (5, 13)]
+    tables = []
+    next_page = 1
+    for seq in seqs:
+        n_pages = (len(seq) + 1 + geo.page_size - 1) // geo.page_size
+        pages = list(range(next_page, next_page + n_pages))
+        next_page += n_pages
+        bt = np.asarray(pages + [0] * (geo.max_blocks - len(pages)),
+                        np.int32)
+        toks = np.zeros(geo.max_kv, np.int32)
+        toks[:len(seq)] = seq
+        cache, logits = prefill(params, cache, toks,
+                                np.int32(len(seq)), bt)
+        seq.append(int(engine.greedy(logits)))
+        tables.append(bt)
+
+    # slot 2 is INACTIVE garbage — its writes must route to trash page 0
+    # and not perturb the live slots.
+    cache, logits = decode(
+        params, cache,
+        np.asarray([seqs[0][-1], seqs[1][-1], 0], np.int32),
+        np.asarray([len(seqs[0]) - 1, len(seqs[1]) - 1, 0], np.int32),
+        np.stack([tables[0], tables[1],
+                  np.zeros(geo.max_blocks, np.int32)]),
+        np.asarray([True, True, False]))
+    for slot, seq in enumerate(seqs):
+        np.testing.assert_allclose(np.asarray(logits[slot], np.float32),
+                                   _ref_logits(params, cfg, seq),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_prefill_pad_validated():
+    cfg = _cfg()
+    geo = kv_cache.geometry(n_pages=16, page_size=8, max_context=64)
+    with pytest.raises(ValueError):
+        engine.make_prefill(cfg, geo, prefill_pad=13)   # not page-aligned
+    with pytest.raises(ValueError):
+        engine.make_prefill(cfg, geo, prefill_pad=128)  # > max_seq_len
+
+
+# ---------------------------------------------------------------------------
+# resolve_attn: serving shapes (satellite)
+# ---------------------------------------------------------------------------
+
+def test_resolve_attn_kv_len_and_causal(monkeypatch):
+    cfg = dataclasses.replace(tfm.tiny(), attn_impl="auto")
+    monkeypatch.setattr(tfm.jax, "default_backend", lambda: "tpu")
+    # decode: q_len=1 against a long cache is ALWAYS gather — the score
+    # row is linear in KV, flash's q-tiling has nothing to eliminate.
+    assert tfm.resolve_attn(cfg, 1, None, kv_len=8192) == "gather"
+    assert tfm.resolve_attn(cfg, 1, None, kv_len=128) == "gather"
+    # chunked prefill: a 512-query block against an 8K cache has a 4M
+    # live score footprint -> flash.
+    assert tfm.resolve_attn(cfg, 512, None, kv_len=8192) == "flash"
+    # pre-existing causal self-attention threshold unchanged: the live
+    # triangle crosses the S=1024 measured crossover.
+    assert tfm.resolve_attn(cfg, 1024, None) == "flash"
+    assert tfm.resolve_attn(cfg, 1023, None) == "gather"
+    # bidirectional squares materialize twice the logits -> earlier
+    # crossover (724^2 < threshold <= 725^2).
+    assert tfm.resolve_attn(cfg, 725, None, causal=False) == "flash"
+    assert tfm.resolve_attn(cfg, 724, None, causal=False) == "gather"
+
+
+def test_resolve_attn_ring_requires_self_attention(monkeypatch):
+    """A sequence-sharded mesh resolves to ring ONLY for full
+    self-attention — rotating K/V shards past a 1-token query against an
+    external cache is meaningless (the pre-fix failure mode)."""
+    cfg = dataclasses.replace(tfm.tiny(), attn_impl="auto")
+    monkeypatch.setattr(tfm.jax, "default_backend", lambda: "tpu")
+
+    class _SeqMesh:
+        axis_names = (cfg.seq_axis,)
+        shape = {cfg.seq_axis: 4}
+
+    assert tfm.resolve_attn(cfg, 128, _SeqMesh()) == "ring"
+    assert tfm.resolve_attn(cfg, 1, _SeqMesh(), kv_len=4096) == "gather"
+
+
+def test_resolve_attn_cpu_backend_gathers():
+    cfg = dataclasses.replace(tfm.tiny(), attn_impl="auto")
+    if jax.default_backend() == "tpu":
+        pytest.skip("CPU-backend branch")
+    assert tfm.resolve_attn(cfg, 4096, None) == "gather"
+
+
+# ---------------------------------------------------------------------------
+# ServeLoop end to end
+# ---------------------------------------------------------------------------
+
+def _instant(reqs):
+    """Open-loop arrivals collapsed to t=0: scheduling (not wall-clock
+    arrival timing) decides every admission — deterministic A/B."""
+    for r in reqs:
+        r.arrival_t = 1e-9
+    return reqs
+
+
+def test_serve_loop_continuous_vs_static_fill():
+    cfg = _cfg()
+    geo = kv_cache.geometry(n_pages=32, page_size=8, max_context=64)
+    params = tfm.init_params(jax.random.PRNGKey(2), cfg)
+    summaries = {}
+    for mode in ("continuous", "static"):
+        rng = np.random.default_rng(9)
+        reqs = _instant(poisson_requests(
+            10, rate=1e6, rng=rng, prompt_len=(2, 6), max_new=(1, 12),
+            vocab=cfg.vocab_size))
+        sl = ServeLoop(params, cfg, geo=geo, max_batch=4, mode=mode)
+        sl.warmup()
+        summary, finished = sl.run(reqs)
+        assert len(finished) == 10
+        assert summary["tokens"] == sum(len(r.generated) for r in finished)
+        assert all(r.finish_reason == "max_tokens" for r in finished)
+        summaries[mode] = summary
+    # the A/B gap the bench measures, isolated from timing: continuous
+    # refills drained slots, static idles them until the batch empties.
+    assert summaries["continuous"]["batch_fill_mean"] \
+        > summaries["static"]["batch_fill_mean"]
+
+
+def test_serve_loop_preemption_replays_losslessly():
+    """A page-starved pool forces preemption; the re-prefill replays
+    prompt + generated so every request still finishes with its full
+    greedy chain (matching an uncontended run)."""
+    cfg = _cfg()
+    params = tfm.init_params(jax.random.PRNGKey(4), cfg)
+    roomy = kv_cache.geometry(n_pages=32, page_size=4, max_context=32)
+    tight = dataclasses.replace(roomy, n_pages=7)  # 6 usable pages
+
+    def _run(geo):
+        rng = np.random.default_rng(13)
+        reqs = _instant(poisson_requests(
+            4, rate=1e6, rng=rng, prompt_len=(3, 6), max_new=(8, 12),
+            vocab=cfg.vocab_size))
+        sl = ServeLoop(params, cfg, geo=geo, max_batch=2, mode="continuous")
+        summary, finished = sl.run(reqs)
+        assert len(finished) == 4
+        return summary, {r.rid: list(r.generated) for r in finished}
+
+    tight_summary, tight_chains = _run(tight)
+    _, roomy_chains = _run(roomy)
+    assert tight_summary["preemptions"] > 0
+    assert tight_chains == roomy_chains
+
+
+def test_serve_loop_rejects_oversized_prompt():
+    cfg = _cfg()
+    geo = kv_cache.geometry(n_pages=8, page_size=4, max_context=16)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    sl = ServeLoop(params, cfg, geo=geo, max_batch=1)
+    from horovod_tpu.serving.scheduler import Request
+    with pytest.raises(ValueError):
+        sl.run([Request(rid=0, prompt=list(range(16)), max_new_tokens=4)])
+
+
+# ---------------------------------------------------------------------------
+# driver autoscale plumbing
+# ---------------------------------------------------------------------------
+
+def test_driver_consumes_serve_load():
+    """The elastic driver drains /ctl/serve_load through the autoscale
+    policy: consumed keys leave the KV bounded, a sustained breach moves
+    _target_np (the epoch active-set cap), malformed payloads are
+    ignored."""
+    from horovod_tpu.runner.elastic.discovery import FixedHosts
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+    from horovod_tpu.serving.autoscale import AutoscalePolicy
+
+    d = ElasticDriver(["true"], FixedHosts({}), 1, 4)
+    try:
+        d.autoscale = AutoscalePolicy(1, 4, high_depth=8, patience=2)
+
+        def _push(payload):
+            d.rdv.put("/ctl/serve_load/w1", payload)
+
+        _push(b"not json")                      # ignored, still consumed
+        assert d._check_serve_load() is False
+        assert d.rdv.scan("/ctl/serve_load") == {}
+
+        _push(json.dumps({"queue_depth": 20, "batch_fill": 1.0}).encode())
+        assert d._check_serve_load() is False   # streak 1 < patience
+        _push(json.dumps({"queue_depth": 20, "batch_fill": 1.0}).encode())
+        assert d._check_serve_load() is True    # streak 2 -> scale up
+        assert d._target_np == 2
+        assert d.stats["autoscale_events"] == 1
+        assert d.stats["target_np"] == 2
+        assert json.loads(d.rdv.get("/ctl/elastic_stats"))["target_np"] == 2
+
+        # sustained idle walks the target back down to min_np
+        for _ in range(2):
+            _push(json.dumps({"queue_depth": 0,
+                              "batch_fill": 0.1}).encode())
+            d._check_serve_load()
+        assert d._target_np == 1
+    finally:
+        d.stop()

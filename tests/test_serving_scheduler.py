@@ -1,0 +1,363 @@
+"""Serving-plane scheduling invariants (ISSUE 14) — pure-numpy tier-1.
+
+The control half of the serving plane (horovod_tpu/serving/scheduler.py
+and autoscale.py) is deliberately jax-free, so the invariants that keep
+the paged KV cache sound — page conservation, no double-allocation,
+strict-ownership frees, admission/eviction at token boundaries,
+batch-fill monotonicity under backlog — are all testable without an
+accelerator stack. Modules are loaded standalone (the serving package
+lazy-imports, but standalone load keeps parity with how bench.py's
+jax-free parent would read them), the test_pipeline_schedules.py idiom.
+
+Engine-side coverage (prefill/decode parity against forward(), the
+mixed-length jit'd step, the ServeLoop A/B) lives in
+tests/test_serving.py, which needs jax.
+"""
+import importlib.util
+import os
+
+import pytest
+
+from .util import _REPO
+
+pytestmark = pytest.mark.serve
+
+
+def _load(name):
+    path = os.path.join(_REPO, "horovod_tpu", "serving", name + ".py")
+    spec = importlib.util.spec_from_file_location(name + "_under_test",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+sched = _load("scheduler")
+autoscale = _load("autoscale")
+
+
+def _mk(n_pages=32, page_size=4, max_batch=4, mode="continuous"):
+    alloc = sched.PageAllocator(n_pages, page_size)
+    return alloc, sched.ContinuousBatcher(alloc, max_batch, mode)
+
+
+def _req(rid, prompt_len=4, max_new=8, eos=-1):
+    return sched.Request(rid=rid, prompt=list(range(prompt_len)),
+                         max_new_tokens=max_new, eos_id=eos)
+
+
+def _conserved(b):
+    """The page-accounting contract: free + owned == usable, and every
+    running request's pages are disjoint."""
+    owned = [p for r in b.running.values() for p in r.pages]
+    assert len(owned) == len(set(owned)), "page owned twice"
+    assert 0 not in owned, "trash page 0 handed out"
+    assert b.alloc.free_pages() + b.alloc.used_pages() \
+        == b.alloc.usable_pages
+    assert b.alloc.used_pages() == len(owned)
+
+
+# ---------------------------------------------------------------------------
+# PageAllocator
+# ---------------------------------------------------------------------------
+
+def test_allocator_reserves_trash_page():
+    a = sched.PageAllocator(8, 4)
+    assert a.usable_pages == 7
+    got = a.alloc(7)
+    assert got is not None and 0 not in got
+    assert a.alloc(1) is None  # page 0 is never the fallback
+
+
+def test_allocator_all_or_nothing():
+    a = sched.PageAllocator(5, 4)
+    assert a.alloc(5) is None          # only 4 usable
+    assert a.free_pages() == 4         # failed alloc took nothing
+    assert a.alloc(4) is not None
+    assert a.free_pages() == 0
+
+
+def test_allocator_double_free_raises_before_mutation():
+    a = sched.PageAllocator(8, 4)
+    pages = a.alloc(3)
+    a.free(pages[:1])
+    with pytest.raises(sched.PageError):
+        a.free(pages)                  # pages[0] no longer owned
+    # the failed free must not have returned pages[1:] either
+    assert a.used_pages() == 2
+    assert a.free_pages() == 5
+
+
+def test_allocator_foreign_page_raises():
+    a = sched.PageAllocator(8, 4)
+    a.alloc(2)
+    with pytest.raises(sched.PageError):
+        a.free([6])                    # never allocated
+    with pytest.raises(sched.PageError):
+        a.free([0])                    # the trash page
+
+
+def test_allocator_rejects_degenerate_pools():
+    with pytest.raises(ValueError):
+        sched.PageAllocator(1, 4)      # only the trash page
+    with pytest.raises(ValueError):
+        sched.PageAllocator(8, 0)
+
+
+def test_allocator_occupancy():
+    a = sched.PageAllocator(9, 4)
+    assert a.occupancy() == 0.0
+    a.alloc(4)
+    assert a.occupancy() == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+def test_serve_knobs_defaults(monkeypatch):
+    for k in ("HVD_SERVE_PAGE_SIZE", "HVD_SERVE_KV_PAGES",
+              "HVD_SERVE_MAX_BATCH", "HVD_SERVE_MODE"):
+        monkeypatch.delenv(k, raising=False)
+    k = sched.serve_knobs()
+    assert k == {"page_size": sched.DEFAULT_PAGE_SIZE,
+                 "kv_pages": sched.DEFAULT_KV_PAGES,
+                 "max_batch": sched.DEFAULT_MAX_BATCH,
+                 "mode": "continuous"}
+
+
+def test_serve_knobs_env_overrides(monkeypatch):
+    monkeypatch.setenv("HVD_SERVE_PAGE_SIZE", "32")
+    monkeypatch.setenv("HVD_SERVE_KV_PAGES", "512")
+    monkeypatch.setenv("HVD_SERVE_MAX_BATCH", "not-a-number")
+    monkeypatch.setenv("HVD_SERVE_MODE", "static")
+    k = sched.serve_knobs()
+    assert k["page_size"] == 32 and k["kv_pages"] == 512
+    assert k["max_batch"] == sched.DEFAULT_MAX_BATCH  # garbage -> default
+    assert k["mode"] == "static"
+
+
+# ---------------------------------------------------------------------------
+# admission / eviction
+# ---------------------------------------------------------------------------
+
+def test_admission_fills_free_slots_lowest_first():
+    _, b = _mk(max_batch=4)
+    for i in range(6):
+        b.submit(_req(i))
+    got = b.admit()
+    assert [r.rid for r in got] == [0, 1, 2, 3]
+    assert sorted(b.running) == [0, 1, 2, 3]
+    assert b.queue_depth() == 2
+    assert b.batch_fill() == 1.0
+    _conserved(b)
+
+
+def test_admission_reserves_first_decode_slot():
+    # prompt 4 + 1 upcoming decode position at page_size 4 -> 2 pages.
+    _, b = _mk(n_pages=3, page_size=4)  # 2 usable
+    b.submit(_req(0, prompt_len=4))
+    assert len(b.admit()) == 1
+    assert len(b.running[0].pages) == 2
+    _conserved(b)
+
+
+def test_admission_head_of_line_keeps_arrival_order():
+    _, b = _mk(n_pages=4, page_size=4)  # 3 usable
+    b.submit(_req(0, prompt_len=8))     # needs 3 pages
+    b.submit(_req(1, prompt_len=1))     # would fit, but is behind rid 0
+    assert len(b.admit()) == 1
+    b.submit(_req(2, prompt_len=1))
+    assert b.admit() == []              # rid 1 blocked -> rid 2 waits too
+    assert [r.rid for r in b.waiting] == [1, 2]
+
+
+def test_eviction_on_eos_and_max_tokens_frees_pages():
+    _, b = _mk()
+    b.submit(_req(0, max_new=8, eos=7))
+    b.submit(_req(1, max_new=2))
+    b.admit()
+    done = b.on_tokens({0: 7, 1: 5})    # rid 0 hits EOS immediately
+    assert [r.rid for r in done] == [0]
+    assert done[0].finish_reason == "eos" and done[0].pages == []
+    done = b.on_tokens({1: 5})          # rid 1 reaches max_new=2
+    assert [r.rid for r in done] == [1]
+    assert done[0].finish_reason == "max_tokens"
+    assert b.idle()
+    assert b.alloc.used_pages() == 0
+    _conserved(b)
+
+
+def test_eviction_readmits_in_same_boundary():
+    _, b = _mk(max_batch=1)
+    b.submit(_req(0, max_new=1))
+    b.submit(_req(1))
+    b.admit()
+    assert b.queue_depth() == 1
+    done = b.on_tokens({0: 3})
+    # rid 0 finished AND rid 1 took its slot within one boundary — the
+    # continuous-batching property itself.
+    assert [r.rid for r in done] == [0]
+    assert b.running[0].rid == 1
+    _conserved(b)
+
+
+def test_static_mode_admits_only_into_empty_batch():
+    _, b = _mk(max_batch=2, mode="static")
+    for i in range(4):
+        b.submit(_req(i, max_new=2 + i))
+    b.admit()
+    assert sorted(r.rid for r in b.running.values()) == [0, 1]
+    done = b.on_tokens({0: 1, 1: 1})
+    assert not done
+    done = b.on_tokens({0: 1, 1: 1})    # rid 0 done (max_new=2)...
+    assert [r.rid for r in done] == [0]
+    assert [r.rid for r in b.running.values()] == [1]  # slot idles
+    done = b.on_tokens({1: 1})          # rid 1 done -> batch empty
+    assert [r.rid for r in done] == [1]
+    assert sorted(r.rid for r in b.running.values()) == [2, 3]
+    _conserved(b)
+
+
+def test_batch_fill_monotone_under_backlog():
+    """With a standing queue and ample pages, continuous batching keeps
+    every slot busy at every boundary — fill never drops below 1.0 until
+    the backlog drains (the quantity the bench A/B measures)."""
+    _, b = _mk(n_pages=128, page_size=4, max_batch=4)
+    for i in range(12):
+        b.submit(_req(i, prompt_len=2, max_new=1 + (i % 4)))
+    b.admit()
+    fills = []
+    while not b.idle():
+        b.on_tokens({s: 1 for s in list(b.running)})
+        if b.queue_depth() > 0 or b.batch_fill() == 1.0:
+            fills.append(b.batch_fill())
+        _conserved(b)
+    assert fills and all(f == 1.0 for f in fills)
+    assert len(b.done) == 12
+
+
+def test_no_double_free_over_random_workload():
+    """Fuzz the full lifecycle (admit/evict/grow/preempt) against the
+    conservation invariant; any double-free raises PageError."""
+    import numpy as np
+    rng = np.random.default_rng(7)
+    _, b = _mk(n_pages=12, page_size=2, max_batch=3)
+    for i in range(40):
+        b.submit(_req(i, prompt_len=int(rng.integers(1, 5)),
+                      max_new=int(rng.integers(1, 9))))
+    b.admit()
+    steps = 0
+    while not b.idle():
+        b.on_tokens({s: int(rng.integers(0, 9)) for s in list(b.running)})
+        _conserved(b)
+        steps += 1
+        assert steps < 2000, "scheduler wedged"
+    assert len(b.done) == 40
+    assert b.alloc.used_pages() == 0
+
+
+# ---------------------------------------------------------------------------
+# preemption
+# ---------------------------------------------------------------------------
+
+def test_preemption_youngest_victim_keeps_generated():
+    # 4 usable pages, page_size 2: two requests of prompt 2 own 2 pages
+    # each (context + 1 reserved) and the pool is exhausted. The elder's
+    # growth across the page boundary starves -> the YOUNGER is
+    # preempted, keeps its generated prefix, and lands at the FRONT of
+    # the waiting queue.
+    _, b = _mk(n_pages=5, page_size=2, max_batch=2)
+    b.submit(_req(0, prompt_len=2, max_new=8))
+    b.admit()
+    b.submit(_req(1, prompt_len=2, max_new=8))
+    b.submit(_req(2, prompt_len=2, max_new=8))   # queued behind
+    done = b.on_tokens({0: 5})                   # admits rid 1 (pool now full)
+    assert not done and sorted(b.running) == [0, 1]
+    b.on_tokens({0: 5, 1: 5})    # rid 0 ctx 4 -> needs a 3rd page: starved
+    victim = [r for r in b.waiting if r.rid == 1]
+    assert victim and victim[0] is b.waiting[0]  # front, ahead of rid 2
+    assert victim[0].preemptions == 1
+    assert victim[0].generated == [5]            # prefix kept for replay
+    assert victim[0].pages == [] and victim[0].slot == -1
+    _conserved(b)
+
+
+def test_preemption_self_when_youngest():
+    _, b = _mk(n_pages=3, page_size=1, max_batch=1)  # 2 usable
+    b.submit(_req(0, prompt_len=1, max_new=8))
+    b.admit()
+    assert len(b.running[0].pages) == 2
+    b.on_tokens({0: 5})                 # needs a 3rd page -> none left
+    assert not b.running                # preempted itself, no deadlock
+    assert b.waiting[0].rid == 0 and b.waiting[0].preemptions == 1
+    assert b.alloc.used_pages() == 0
+
+
+def test_block_table_pads_with_trash_and_bounds():
+    _, b = _mk()
+    b.submit(_req(0))
+    b.admit()
+    req = b.running[0]
+    bt = b.block_table(req, 6)
+    assert len(bt) == 6
+    assert bt[:len(req.pages)] == req.pages
+    assert all(p == 0 for p in bt[len(req.pages):])
+    with pytest.raises(ValueError):
+        b.block_table(req, len(req.pages) - 1)
+
+
+def test_mode_validated():
+    alloc = sched.PageAllocator(8, 4)
+    with pytest.raises(ValueError):
+        sched.ContinuousBatcher(alloc, 4, mode="dynamic")
+
+
+# ---------------------------------------------------------------------------
+# AutoscalePolicy
+# ---------------------------------------------------------------------------
+
+def test_autoscale_scale_up_needs_patience():
+    p = autoscale.AutoscalePolicy(1, 4, high_depth=8, patience=3)
+    assert p.observe(20, 1.0) is None
+    assert p.observe(20, 1.0) is None
+    assert p.observe(20, 1.0) == 2      # third consecutive breach
+    assert p.observe(20, 1.0) is None   # streak reset after acting
+    assert p.observe(20, 1.0) is None
+    assert p.observe(20, 1.0) == 3
+
+
+def test_autoscale_breach_streak_resets_in_band():
+    p = autoscale.AutoscalePolicy(1, 4, high_depth=8, patience=3)
+    p.observe(20, 1.0)
+    p.observe(20, 1.0)
+    assert p.observe(4, 1.0) is None    # in band: streak dies
+    assert p.observe(20, 1.0) is None
+    assert p.observe(20, 1.0) is None
+    assert p.observe(20, 1.0) == 2
+
+
+def test_autoscale_scale_down_needs_idle_batch_too():
+    p = autoscale.AutoscalePolicy(1, 4, low_depth=1, low_fill=0.5,
+                                  patience=2)
+    p.target = 3
+    assert p.observe(0, 0.9) is None    # queue empty but batch busy
+    assert p.observe(0, 0.9) is None    # ...never scales down
+    assert p.observe(0, 0.2) is None
+    assert p.observe(0, 0.2) == 2       # empty AND half-idle: down
+
+
+def test_autoscale_clamps_to_bounds():
+    p = autoscale.AutoscalePolicy(2, 3, patience=1)
+    assert p.observe(0, 0.0) is None    # already at min_np
+    assert p.observe(99, 1.0) == 3
+    assert p.observe(99, 1.0) is None   # at max_np: hold
+    assert p.observe(0, 0.0) == 2
+    assert p.observe(0, 0.0) is None    # back at min_np
+
+
+def test_autoscale_validates_band_and_bounds():
+    with pytest.raises(ValueError):
+        autoscale.AutoscalePolicy(4, 2)
+    with pytest.raises(ValueError):
+        autoscale.AutoscalePolicy(1, 4, high_depth=1, low_depth=1)

@@ -210,3 +210,26 @@ def run_single(worker_file, extra_env=None, timeout=120,
         env=env, timeout=timeout, capture_output=True, text=True,
     )
     assert p.returncode == 0, f"stdout:\n{p.stdout}\nstderr:\n{p.stderr}"
+
+
+def have_shard_map():
+    """jax >= 0.8 probe (the PR 13 availability-gate pattern): the
+    parallel package — and every worker script that imports it — needs
+    jax.shard_map. Tests that only SPAWN such workers use this to skip
+    up front instead of failing on the workers' ImportError."""
+    try:
+        from jax import shard_map  # noqa: F401
+        return True
+    except Exception:  # noqa: BLE001 — no jax at all also means no
+        return False
+
+
+def have_torch_native_ext():
+    """Whether the torch native extension (csrc/torch_ops.cc) builds and
+    loads against the installed torch; the jit build is cached, so the
+    probe pays the compile at most once per environment."""
+    try:
+        from horovod_tpu.torch import native_ext
+        return native_ext.lib() is not None
+    except Exception:  # noqa: BLE001 — no torch / build failure
+        return False
