@@ -2092,6 +2092,173 @@ def _serve_worker():
         json.dump(out, f)
 
 
+def _bench_ckpt():
+    """Sharded state plane (ISSUE 15 acceptance): two A/Bs over the same
+    32 MB TP-sharded train state.
+
+    1. sync vs async save — a short train loop (sharded matmul per step,
+       save every step); value = mean time save() BLOCKS the loop. Sync
+       pays snapshot + serialization + fsync + commit barriers on the
+       step path; async pays only the device->host snapshot. Headline
+       ``ckpt_async_stall_ratio`` must be strictly < 1.
+    2. N->M reshard restore vs full restore — save at 2 ranks, restore
+       at 4: a sharded tree_like makes each rank fetch only its
+       overlapping fragments (~1/4 of the bytes); a plain-numpy like is
+       the naive restore that assembles the FULL tree on every rank.
+
+    Each cell is its own run_local job (multi-rank cells form one global
+    8-device mesh over forced host devices via the jax coordinator);
+    rank 0 writes summary JSON. A tight sub-budget sheds the reshard
+    trio, never the headline A/B."""
+    import tempfile
+
+    from horovod_tpu.runner.local import run_local
+
+    tmp = tempfile.mkdtemp(prefix="hvd_bench_ckpt_")
+    budget = float(os.environ.get("_BENCH_SUB_BUDGET", "0"))
+    t0 = time.time()
+
+    def _cell(cell, np_, ckdir, timeout=90):
+        out_path = os.path.join(tmp, f"{cell}.json")
+        env = {"PYTHONPATH": _repo_pythonpath(os.environ.get("PYTHONPATH")),
+               "JAX_PLATFORMS": "cpu",
+               "_BENCH_CKPT_WORKER": "1",
+               "_BENCH_CKPT_CELL": cell,
+               "_BENCH_CKPT_DIR": ckdir,
+               "_BENCH_CKPT_OUT": out_path}
+        codes = run_local(np_, [sys.executable, os.path.abspath(__file__)],
+                          env=env, timeout=timeout, jax_coord=np_ > 1)
+        if codes != [0] * np_:
+            raise RuntimeError(f"ckpt cell {cell} exit codes: {codes}")
+        with open(out_path) as f:
+            data = json.load(f)
+        if "error" in data:
+            raise RuntimeError(f"ckpt cell {cell}: {data['error']}")
+        return data
+
+    sync = _cell("sync", 1, os.path.join(tmp, "ck_sync"))
+    async_ = _cell("async", 1, os.path.join(tmp, "ck_async"))
+    ratio = async_["blocked_ms_mean"] / sync["blocked_ms_mean"]
+    # The acceptance A/B: the async snapshot stall is strictly below the
+    # sync full-save stall, else the background writer buys nothing.
+    assert ratio < 1.0, (async_["blocked_ms_mean"], sync["blocked_ms_mean"])
+    out = {"metric": "ckpt_async_stall_ratio",
+           "value": round(ratio, 3),
+           "unit": "x (async save blocked-ms / sync save blocked-ms, "
+                   "32 MB sharded state, CPU fake pod)",
+           "sync": sync, "async": async_,
+           "note": "blocked = time save() holds the train loop; async "
+                   "pays only the device->host snapshot "
+                   "(docs/checkpoint.md methodology)",
+           "vs_baseline": 1.0}
+    # Reshard trio (save@2 -> {reshard, full}@4): each multi-rank cell
+    # needs worst-case room inside the parent's sub-deadline; shedding
+    # degrades to the headline-only record, never a killed config.
+    if budget and budget - (time.time() - t0) < 3 * 90 + 15:
+        out["reshard_skipped"] = "sub-deadline too tight for the 3 " \
+                                 "multi-rank reshard cells"
+        return out
+    ckdir = os.path.join(tmp, "ck_rs")
+    _cell("save2", 2, ckdir)
+    reshard = _cell("reshard", 4, ckdir)
+    full = _cell("full", 4, ckdir)
+    out["reshard"] = {
+        "restore_s_sharded_like": reshard["restore_s"],
+        "restore_s_full_tree": full["restore_s"],
+        "speedup": round(full["restore_s"] / reshard["restore_s"], 2),
+        # Fetch-only-your-shard: the fraction of checkpoint bytes one
+        # rank reads when restoring 2-rank shards into a 4-rank mesh.
+        "bytes_fraction": round(reshard["bytes_read"] / full["bytes_read"],
+                                3),
+    }
+    return out
+
+
+def _ckpt_bench_worker():
+    """One ckpt-bench cell (_BENCH_CKPT_WORKER): rank body under
+    run_local; rank 0 writes summary JSON to _BENCH_CKPT_OUT. Errors are
+    written as JSON, not raised, so the parent names the failing cell."""
+    out = {}
+    try:
+        from horovod_tpu.jax.distributed import force_cpu_platform
+
+        np_ = int(os.environ.get("HVD_SIZE", "1"))
+        force_cpu_platform(8 // np_)  # same 8-device mesh at every np
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        if np_ > 1:
+            from horovod_tpu.jax import distributed as jd
+
+            assert jd.initialize_from_env(), "no jax coordinator in env"
+        import horovod_tpu as hvd
+        from horovod_tpu import checkpoint
+
+        hvd.init()
+        cell = os.environ["_BENCH_CKPT_CELL"]
+        ckdir = os.environ["_BENCH_CKPT_DIR"]
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("model",))
+        shd = NamedSharding(mesh, P("model"))
+        rows, cols, nleaf = 2048, 512, 8  # 8 x 4 MB f32 = 32 MB state
+        base = np.arange(rows * cols, dtype=np.float32).reshape(rows, cols)
+
+        def _mk(seed):
+            return jax.make_array_from_callback(
+                (rows, cols), shd, lambda idx, _s=seed: base[idx] + _s)
+
+        tree = {f"w{i}": _mk(float(i)) for i in range(nleaf)}
+        if cell in ("sync", "async"):
+            # Train-loop stand-in: a sharded matmul chain long enough
+            # for the async writer to overlap with.
+            x = jax.device_put(np.ones((1024, 1024), np.float32),
+                               NamedSharding(mesh, P("model", None)))
+            g = jax.jit(lambda a: (a @ a.T) / 1024.0)
+            g(x).block_until_ready()  # compile outside the window
+            steps, blocked = 5, []
+            t_wall = time.perf_counter()
+            for s in range(steps):
+                t0 = time.perf_counter()
+                checkpoint.save(ckdir, s, tree,
+                                async_=(cell == "async"))
+                blocked.append((time.perf_counter() - t0) * 1e3)
+                for _ in range(4):
+                    x = g(x)
+                x.block_until_ready()
+            checkpoint.wait()
+            st = hvd.checkpoint_stats()
+            out = {"blocked_ms_mean": round(sum(blocked) / steps, 2),
+                   "blocked_ms_max": round(max(blocked), 2),
+                   "wall_s": round(time.perf_counter() - t_wall, 2),
+                   "snapshot_stall_ms": round(st["snapshot_stall_ms"], 2),
+                   "write_ms": round(st["write_ms"], 2),
+                   "bytes": st["bytes"], "commits": st["commits"]}
+        elif cell == "save2":
+            checkpoint.save(ckdir, 1, tree)
+            out = {"bytes": hvd.checkpoint_stats()["bytes"]}
+        elif cell in ("reshard", "full"):
+            if cell == "reshard":
+                like = {f"w{i}": _mk(0.0) for i in range(nleaf)}
+            else:  # the naive restore: full tree on every rank's host
+                like = {f"w{i}": np.zeros((rows, cols), np.float32)
+                        for i in range(nleaf)}
+            t0 = time.perf_counter()
+            got, step = checkpoint.restore(ckdir, like)
+            restore_s = time.perf_counter() - t0
+            assert step == 1, step
+            st = hvd.checkpoint_stats()
+            out = {"restore_s": round(restore_s, 3),
+                   "bytes_read": st["bytes_read"],
+                   "fragments": st["fragments_fetched"]}
+        else:
+            raise SystemExit(f"unknown _BENCH_CKPT_CELL {cell!r}")
+        hvd.shutdown()
+    except Exception as e:  # noqa: BLE001 — carried, not fatal
+        out = {"error": f"{type(e).__name__}: {e}"}
+    if os.environ.get("HVD_RANK", "0") == "0":
+        with open(os.environ["_BENCH_CKPT_OUT"], "w") as f:
+            json.dump(out, f)
+
+
 _CONFIG_FNS = {
     "resnet50": _bench_resnet50,
     "transformer": _bench_transformer,
@@ -2106,6 +2273,7 @@ _CONFIG_FNS = {
     "elastic": _bench_elastic,
     "pipeline": _bench_pipeline,
     "serve": _bench_serve,
+    "ckpt": _bench_ckpt,
 }
 
 _METRIC_NAMES = {
@@ -2125,6 +2293,8 @@ _METRIC_NAMES = {
                  "fraction of bucket-launch time inside pipeline bubbles"),
     "serve": ("serve_continuous_vs_static_throughput",
               "x (continuous tok/s / static tok/s at equal Poisson load)"),
+    "ckpt": ("ckpt_async_stall_ratio",
+             "x (async save blocked-ms / sync save blocked-ms)"),
 }
 
 # Per-config wall caps (seconds). Only bind when something hangs; healthy
@@ -2163,6 +2333,11 @@ _CONFIG_CAPS = {
     # Four serve cells ({continuous, static} x {1, 8 ranks}), CPU smoke
     # sizes; runs after pipeline so deadline pressure sheds it first.
     "serve": 300,
+    # Five state-plane cells (sync/async save A/B + the save@2 ->
+    # {reshard, full}@4 restore trio); a tight sub-budget sheds the
+    # reshard trio so the headline ratio always lands. Runs LAST in the
+    # order: newest config, shed before everything graded.
+    "ckpt": 300,
 }
 
 _PROBE_TIMEOUT = 75
@@ -2399,7 +2574,7 @@ def main():
     results = {}
     order = ["resnet50", "transformer", "allreduce", "longctx", "hostplane",
              "bucket", "compress", "bridge", "reduce", "moe", "elastic",
-             "pipeline", "serve"]
+             "pipeline", "serve", "ckpt"]
     for name in order:
         cap = _cap(name)
         left = remaining() - 15  # reserve for final assembly
@@ -2450,5 +2625,7 @@ if __name__ == "__main__":
         _pipeline_exec_worker()
     elif os.environ.get("_BENCH_SERVE_WORKER") == "1":
         _serve_worker()
+    elif os.environ.get("_BENCH_CKPT_WORKER") == "1":
+        _ckpt_bench_worker()
     else:
         main()

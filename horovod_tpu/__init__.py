@@ -27,6 +27,7 @@ __version__ = "0.1.0"
 
 from .basics import basics as _basics
 from .exceptions import (  # noqa: F401
+    CheckpointError,
     HorovodInternalError,
     HostsUpdatedInterrupt,
     RankEvictedError,
@@ -167,6 +168,18 @@ lockdep_selftest = _basics.lockdep_selftest
 peer_tx_bytes = _basics.peer_tx_bytes
 op_backends = _basics.op_backends
 backend_uses = _basics.backend_uses
+
+
+def checkpoint_stats():
+    """This process's state-plane counters (horovod_tpu/checkpoint.py):
+    saves / commits / aborted_commits prove the crash-safe commit
+    protocol's accounting, ``bytes``/``bytes_read``/``fragments_fetched``
+    quantify the sharded write and reshard-on-read paths, and
+    ``snapshot_stall_ms`` vs ``write_ms`` is the async overlap the
+    ``bench.py ckpt`` A/B measures. See docs/checkpoint.md."""
+    from . import checkpoint as _checkpoint
+
+    return _checkpoint.checkpoint_stats()
 
 
 def compression_stats():

@@ -55,6 +55,29 @@ def _note_eviction(e):
         _worker.report_eviction(e.rank, _worker.notification_manager.epoch)
 
 
+def restore_from_checkpoint(tree_like, directory=None, step=None):
+    """Manifest-path restore for (re)joiners and promoted spares: resolve
+    the step LOCALLY (``coordinate=False`` — a joiner reaches this while
+    veterans sit in ``state.sync()``, so a collective here would deadlock)
+    and fetch only the shard fragments this rank's target shardings need
+    (checkpoint.py restore-with-reshard).
+
+    ``step=None`` prefers the driver-published last committed step (it
+    rides every epoch assignment — ``runner.elastic.worker
+    .last_committed_step``) over ``latest_step()`` on the directory: the
+    driver's number can never name a checkpoint another rank is still
+    committing. ``directory=None`` falls back to ``HVD_CKPT_DIR``.
+    Returns (tree, step) or (None, None) when nothing is committed yet.
+    """
+    from . import checkpoint as _checkpoint
+    from .runner.elastic import worker as _worker
+
+    if step is None and _worker.is_elastic():
+        step = _worker.last_committed_step()
+    return _checkpoint.restore(directory, tree_like, step=step,
+                               coordinate=False)
+
+
 class State:
     """Base elastic state. Subclasses implement save/restore/sync."""
 

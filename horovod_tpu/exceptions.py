@@ -40,3 +40,14 @@ class HostsUpdatedInterrupt(RuntimeError):
 
 class HorovodVersionMismatchError(ImportError):
     """Native library and Python package versions disagree."""
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint could not be committed or restored intact.
+
+    Raised by :mod:`horovod_tpu.checkpoint` whenever the sharded format's
+    invariants fail — a torn ``MANIFEST.json``, a missing rank directory
+    or shard file, a checksum mismatch, or shard coverage that does not
+    tile a tensor's global shape. The message always names the offending
+    tensor/shard: a partial restore must be loud, never silently wrong.
+    """

@@ -500,6 +500,40 @@ SERVE_ITL_SECONDS = histogram(
     "hvd_serve_itl_seconds",
     "Per-request mean inter-token latency over its decode life",
     buckets=(.001, .0025, .005, .01, .025, .05, .1, .25, .5, 1, 2.5))
+CKPT_SAVES = counter(
+    "hvd_ckpt_saves",
+    "checkpoint.save() calls entered on this rank")
+CKPT_COMMITS = counter(
+    "hvd_ckpt_commits",
+    "Checkpoints durably committed (MANIFEST fsynced + staging dir "
+    "atomically renamed — docs/checkpoint.md commit protocol)")
+CKPT_ABORTED_COMMITS = counter(
+    "hvd_ckpt_aborted_commits",
+    "Saves that died before the rename (crash/eviction mid-save; the "
+    "previous checkpoint stays latest)")
+CKPT_BYTES_WRITTEN = counter(
+    "hvd_ckpt_bytes_written",
+    "Shard bytes this rank wrote (its own addressable shards only)")
+CKPT_BYTES_READ = counter(
+    "hvd_ckpt_bytes_read",
+    "Shard-file bytes this rank fetched during restore")
+CKPT_FRAGMENTS = counter(
+    "hvd_ckpt_fragments",
+    "Shard files read during restore-with-reshard assembly (fetch-only-"
+    "your-shard: far below world_size x leaves on a resized restore)")
+CKPT_RESTORES = counter(
+    "hvd_ckpt_restores",
+    "checkpoint.restore() calls that returned a tree")
+CKPT_SNAPSHOT_STALL_SECONDS = gauge(
+    "hvd_ckpt_snapshot_stall_seconds",
+    "Last device->host snapshot stall — the ONLY step-blocking part of "
+    "an async save (span: ckpt.snapshot_stall)")
+CKPT_WRITE_SECONDS = gauge(
+    "hvd_ckpt_write_seconds",
+    "Last serialize+IO+commit time (overlapped with compute when async)")
+CKPT_LAST_COMMITTED_STEP = gauge(
+    "hvd_ckpt_last_committed_step",
+    "Step of the newest checkpoint this rank committed")
 
 
 def sample_core_stats(hvd=None):

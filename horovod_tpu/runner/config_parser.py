@@ -36,6 +36,9 @@ YAML schema (any subset):
       mode: continuous
       autoscale: true
       autoscale-high: 8
+    checkpoint:
+      dir: /ckpt/run1
+      async: true
 """
 
 # arg attribute name → (env var, transform-to-env)
@@ -88,6 +91,11 @@ ARG_TO_ENV = {
     "serve_autoscale": ("HVD_SERVE_AUTOSCALE", lambda v: "1" if v else "0"),
     "serve_autoscale_high": ("HVD_SERVE_AUTOSCALE_HIGH",
                              lambda v: str(int(v))),
+    # State plane (horovod_tpu/checkpoint.py): default checkpoint
+    # directory and whether save() commits on the background writer
+    # thread (docs/checkpoint.md).
+    "ckpt_dir": ("HVD_CKPT_DIR", str),
+    "ckpt_async": ("HVD_CKPT_ASYNC", lambda v: "1" if v else "0"),
 }
 
 _FILE_SECTIONS = {
@@ -124,6 +132,8 @@ _FILE_SECTIONS = {
               "mode": "serve_mode",
               "autoscale": "serve_autoscale",
               "autoscale-high": "serve_autoscale_high"},
+    "checkpoint": {"dir": "ckpt_dir",
+                   "async": "ckpt_async"},
 }
 
 

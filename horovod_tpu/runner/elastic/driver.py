@@ -67,7 +67,8 @@ class ElasticDriver:
         self._target_np = 0          # 0 = no autoscale cap
         self.stats = {"promotions": 0, "incremental_epochs": 0,
                       "full_epochs": 0, "driver_evictions": 0,
-                      "autoscale_events": 0, "target_np": 0}
+                      "autoscale_events": 0, "target_np": 0,
+                      "last_ckpt_step": -1}
         self._spares = set()        # wids currently parked as hot spares
         self._active_ranks = {}     # wid -> rank in the CURRENT epoch
         self._rank_hosts = {}       # rank -> hostname in the CURRENT epoch
@@ -291,6 +292,8 @@ class ElasticDriver:
                  "cross_rank": s.cross_rank, "cross_size": s.cross_size,
                  "controller": ctrl, "jax_coord": jax_coord,
                  "scope": f"svc-ep{self.epoch}"}
+            if self.stats["last_ckpt_step"] >= 0:
+                a["ckpt_step"] = self.stats["last_ckpt_step"]
             if rdv_routable:
                 a["rdv"] = rdv_routable
             self.rdv.put(f"/assign-{self.epoch}/{w.id}",
@@ -375,6 +378,25 @@ class ElasticDriver:
                 self._publish_stats()
                 dirty = True
         return dirty
+
+    def _check_ckpt_commits(self):
+        """Consume /ctl/ckpt commit reports (pushed by the checkpoint set
+        root after every durable commit — checkpoint._report_commit) and
+        track the newest committed step. It is republished in
+        /ctl/elastic_stats (→ hvd.elastic_stats()['last_ckpt_step']) and
+        rides every subsequent epoch's assignments, so a promoted spare
+        restores via the manifest path without a collective."""
+        newest = self.stats["last_ckpt_step"]
+        for path, val in self.rdv.scan("/ctl/ckpt/").items():
+            self.rdv.delete(path)  # consume: keep the KV bounded
+            try:
+                newest = max(newest, int(val.decode()))
+            except ValueError:
+                continue
+        if newest != self.stats["last_ckpt_step"]:
+            self.stats["last_ckpt_step"] = newest
+            self._log(f"checkpoint committed @ step {newest}")
+            self._publish_stats()
 
     def _publish_stats(self):
         """Publish the driver-side elastic counters to the KV store;
@@ -491,6 +513,9 @@ class ElasticDriver:
                     self._reset_handled.add(key)
                     self._log(f"reset requested by {wid} (epoch {req_epoch})")
                     membership_dirty = True
+
+            # Checkpoint-commit reports feed last_ckpt_step (state plane).
+            self._check_ckpt_commits()
 
             if not self._success_seen:
                 # Worker-pushed evictions: a surviving peer caught

@@ -104,6 +104,39 @@ def report_serve_load(queue_depth, batch_fill, kv_occupancy=0.0):
         pass
 
 
+def report_ckpt_commit(step):
+    """Publish the last durably committed checkpoint step to the driver
+    (/ctl/ckpt/<wid>). The set root calls this after every commit
+    (checkpoint.py); the driver consumes the keys, tracks the max, and
+    republishes it both in /ctl/elastic_stats (→ hvd.elastic_stats()
+    ['last_ckpt_step']) and in every subsequent epoch's assignments — so
+    a promoted spare resolves its restore step WITHOUT a collective
+    (checkpoint.restore coordinate=False + last_committed_step()). Best
+    effort like report_eviction: a lost report just means joiners fall
+    back to latest_step() on the shared directory."""
+    try:
+        http_server.put_kv(
+            _rdv_addr(), "ctl", f"ckpt/{_worker_id()}",
+            str(int(step)).encode(), secret_key=_rdv_secret())
+    except Exception:
+        pass
+
+
+def last_committed_step():
+    """The newest checkpoint step the driver has confirmed committed, or
+    None. Reads the epoch assignment first (HVD_CKPT_STEP, no network),
+    then falls back to the driver stats snapshot — for (re)joiners and
+    promoted spares picking their manifest-path restore step."""
+    v = os.environ.get("HVD_CKPT_STEP")
+    if v not in (None, ""):
+        try:
+            return int(v)
+        except ValueError:
+            pass
+    s = fetch_driver_stats().get("last_ckpt_step", -1)
+    return int(s) if int(s) >= 0 else None
+
+
 _driver_stats_cache = {}
 _driver_stats_ts = 0.0
 _DRIVER_STATS_TTL_S = 2.0
@@ -151,6 +184,10 @@ def apply_assignment(a):
     os.environ["HVD_CROSS_RANK"] = str(a["cross_rank"])
     os.environ["HVD_CROSS_SIZE"] = str(a["cross_size"])
     os.environ["HVD_CONTROLLER_ADDR"] = a["controller"]
+    # Last committed checkpoint step rides every assignment so a promoted
+    # spare knows where to restore from before it runs any collective.
+    if a.get("ckpt_step") is not None:
+        os.environ["HVD_CKPT_STEP"] = str(a["ckpt_step"])
     if a.get("scope"):
         os.environ["HVD_ENDPOINT_SCOPE"] = a["scope"]
     if a.get("rdv"):

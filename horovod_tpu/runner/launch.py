@@ -209,6 +209,17 @@ def parse_args(argv=None):
                    help="serving: queue depth above which the autoscaler "
                         "wants another rank (HVD_SERVE_AUTOSCALE_HIGH; "
                         "hysteresis band bottom is fixed at depth<=1)")
+    # state plane (docs/checkpoint.md)
+    p.add_argument("--ckpt-dir", dest="ckpt_dir", default=None,
+                   help="checkpoint: default directory for "
+                        "hvd.checkpoint.save/restore when the call "
+                        "passes none (HVD_CKPT_DIR; docs/checkpoint.md)")
+    p.add_argument("--ckpt-async", dest="ckpt_async",
+                   action="store_true", default=None,
+                   help="checkpoint: commit saves on the background "
+                        "writer thread — the step only pays the "
+                        "device-to-host snapshot stall (HVD_CKPT_ASYNC; "
+                        "must agree across ranks)")
     p.add_argument("--check-build", action="store_true",
                    help="print framework/native-layer availability and "
                         "exit (reference: horovodrun --check-build)")

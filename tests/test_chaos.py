@@ -33,7 +33,7 @@ def _chaos_env(np_):
 
 
 def _run_chaos(tmp_path, np_, fault, extra_env=None, hot_spares=0,
-               timeout=120, iters=8):
+               timeout=120, iters=8, worker=WORKER):
     hosts_file = tmp_path / "hosts.txt"
     hosts_file.write_text(f"localhost:{np_ + hot_spares}\n")
     log_file = tmp_path / "final.log"
@@ -59,7 +59,7 @@ def _run_chaos(tmp_path, np_, fault, extra_env=None, hot_spares=0,
            "--verbose"]
     if hot_spares:
         cmd += ["--hot-spares", str(hot_spares)]
-    cmd += [sys.executable, WORKER]
+    cmd += [sys.executable, worker]
     t0 = time.monotonic()
     proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True)
@@ -95,6 +95,38 @@ def test_chaos_kill_smoke(tmp_path):
     rc, log, out, marker, _ = _run_chaos(tmp_path, 4, "kill")
     _assert_recovered(rc, log, out, marker, 4)
     assert "RankEvictedError" in out or "FAILED" in out, out
+
+
+def test_chaos_kill_writer_mid_save(tmp_path):
+    """ISSUE 15 crash-window cell: SIGKILL the checkpoint WRITER (rank 0,
+    the set root) after its shards are durable but BEFORE the commit —
+    the window that used to wedge the other ranks in the
+    ``ckpt.shards.<step>`` barrier forever. Survivors must surface RankEvictedError out of the commit
+    barrier (the PR 8 liveness/eviction path), re-rendezvous, and every
+    finisher must restore the last COMMITTED step (1) — the torn step-2
+    staging dir can never be resolvable as latest."""
+    ckdir = tmp_path / "ck"
+    rc, log, out, marker, _ = _run_chaos(
+        tmp_path, 4, "ckpt-writer", timeout=150, iters=6,
+        extra_env={"CKPT_DIR": str(ckdir)},
+        worker=os.path.join(os.path.dirname(__file__), "workers",
+                            "ckpt_chaos_worker.py"))
+    assert rc == 0, f"job failed rc={rc}\n{out}"
+    assert marker.exists(), f"writer crash was never injected\n{out}"
+    finals = [l for l in log.splitlines() if l.startswith("final")]
+    assert len(finals) == 4, f"expected 4 finishers:\n{log}\n{out}"
+    assert all("iter=6" in l and "parity=ok" in l for l in finals), log
+    # Every finisher resolved the previous committed step on recovery.
+    assert all("ckpt=1" in l for l in finals), log
+    # A SIGKILLed writer surfaces on the dead control socket (the driver
+    # names the rc=-9 failure) or, if the socket lingers, the liveness
+    # timeout — either way the survivors must NOT hang in the barrier.
+    assert ("RankEvictedError" in out or "evicting" in out
+            or "liveness stale" in out or "FAILED" in out), \
+        f"writer death never detected:\n{out}"
+    # The aborted attempt's staging leftovers never count as a step.
+    import horovod_tpu.checkpoint as _ck
+    assert _ck.latest_step(ckdir) == 2  # the RETRIED step-2 commit
 
 
 @pytest.mark.chaos

@@ -1,15 +1,13 @@
-"""Pins for hvd.checkpoint.save with TP-sharded train state (ISSUE 8
-satellite / ROADMAP item 5 prep): what the orbax-backed save/restore
-actually does today, BEFORE any sharded-checkpoint refactor.
+"""The sharded state plane (ISSUE 15; docs/checkpoint.md): per-rank
+shard writes, two-barrier atomic commit, restore-with-reshard across
+world sizes, torn-checkpoint loudness, and the legacy orbax read path.
 
-Today's contract (tp_ckpt_worker.py asserts it rank-side):
-
-- Fully-addressable sharded leaves (model axis within one process) are
-  gathered by the root's host pull and written as FULL arrays; restore
-  hands back plain replicated numpy — sharding is not round-tripped.
-- Non-fully-addressable leaves (model axis spanning processes) make
-  save raise on the root before anything hits disk — a loud failure,
-  not a silently-wrong partial checkpoint.
+These update the PR 7 pins: fully-addressable sharded leaves now
+round-trip their sharding, and a cross-process sharded save — which the
+orbax-backed revision pinned as raising loudly — now SUCCEEDS with each
+rank writing only its own addressable shards (tp_ckpt_worker.py asserts
+both rank-side). The kill-the-writer-mid-save crash cell lives in
+tests/test_chaos.py next to the rest of the fault matrix.
 """
 
 import pytest
@@ -17,19 +15,96 @@ import pytest
 pytest.importorskip("jax")
 pytest.importorskip("orbax.checkpoint")
 
-from .util import run_worker_job
+from .util import run_single, run_worker_job
 
 
-def test_tp_sharded_save_gathers_full_arrays(tmp_path):
+def test_tp_sharded_save_roundtrips_sharding(tmp_path):
+    """Single process, 8-device model axis: restore into a numpy like
+    assembles the full array; restore into a sharded like round-trips
+    the TP layout (the degenerate N == M reshard)."""
     run_worker_job(1, "tp_ckpt_worker.py", timeout=180, extra_env={
         "CKPT_MODE": "local",
         "CKPT_DIR": str(tmp_path / "ck"),
     })
 
 
-def test_cross_process_sharded_save_fails_loudly(tmp_path):
+def test_cross_process_sharded_save_succeeds(tmp_path):
+    """Model axis spanning 2 processes: the non-fully-addressable case
+    the orbax revision refused — now each rank writes its own shards and
+    restore hands them back bit-exact with no full-array gather."""
     run_worker_job(2, "tp_ckpt_worker.py", timeout=240, jax_coord=True,
                    extra_env={
                        "CKPT_MODE": "global",
                        "CKPT_DIR": str(tmp_path / "ck"),
                    })
+
+
+def _reshard(tmp_path, n, m):
+    """Save at world size n, restore at world size m, same 8-device CPU
+    mesh both times (so the per-process shard boundaries really move)."""
+    ckdir = str(tmp_path / "ck")
+    run_worker_job(n, "reshard_ckpt_worker.py", timeout=240,
+                   jax_coord=n > 1,
+                   extra_env={"CKPT_PHASE": "save", "CKPT_DIR": ckdir})
+    run_worker_job(m, "reshard_ckpt_worker.py", timeout=240,
+                   jax_coord=m > 1,
+                   extra_env={"CKPT_PHASE": "restore", "CKPT_DIR": ckdir})
+
+
+@pytest.mark.parametrize("n,m", [(1, 4), (4, 1)])
+def test_restore_with_reshard(tmp_path, n, m):
+    """The headline elastic resize, both directions: N writer processes,
+    M reader processes, bit-exact across mixed dtypes, TP-sharded AND
+    replicated leaves (reshard_ckpt_worker.py asserts rank-side)."""
+    _reshard(tmp_path, n, m)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n,m", [(2, 4), (4, 2), (1, 2), (2, 1)])
+def test_restore_with_reshard_matrix(tmp_path, n, m):
+    """The rest of the {1,2,4} -> {4,2,1} resize matrix."""
+    _reshard(tmp_path, n, m)
+
+
+def test_torn_checkpoint_fails_loudly(tmp_path):
+    """Truncated manifest, wrong format tag, bit-flipped shard, missing
+    rank dir, tree mismatch: every corruption raises a CheckpointError
+    naming the offending piece (torn_ckpt_worker.py)."""
+    run_single("torn_ckpt_worker.py", timeout=180, extra_env={
+        "CKPT_DIR": str(tmp_path / "ck"),
+        "JAX_PLATFORMS": "cpu",
+    })
+
+
+def test_orbax_backcompat_restore(tmp_path):
+    """Checkpoints written by the pre-sharded orbax revisions still
+    resolve and restore; a sharded save alongside shadows them as
+    latest (legacy_ckpt_worker.py)."""
+    run_single("legacy_ckpt_worker.py", timeout=240, extra_env={
+        "CKPT_DIR": str(tmp_path / "ck"),
+        "JAX_PLATFORMS": "cpu",
+    })
+
+
+def test_latest_step_ignores_uncommitted(tmp_path):
+    """latest_step resolves only COMMITTED steps: ``.tmp`` staging dirs,
+    bare integer dirs without a commit marker, non-integer names, and
+    plain files are all ignored; the sharded MANIFEST.json and both
+    legacy orbax ``_METADATA`` placements count."""
+    from horovod_tpu import checkpoint
+
+    d = tmp_path / "ck"
+    (d / "7.tmp" / "rank_0").mkdir(parents=True)  # crashed writer staging
+    (d / "5").mkdir()                             # no commit marker
+    (d / "junk").mkdir()                          # non-integer name
+    (d / "8").write_text("x")                     # a FILE, not a step dir
+    (d / "3").mkdir()
+    (d / "3" / "MANIFEST.json").write_text("{}")
+    assert checkpoint.latest_step(d) == 3
+    (d / "4").mkdir()
+    (d / "4" / "_METADATA").write_text("")        # legacy orbax
+    assert checkpoint.latest_step(d) == 4
+    (d / "6" / "default").mkdir(parents=True)
+    (d / "6" / "default" / "_METADATA").write_text("")  # older nesting
+    assert checkpoint.latest_step(d) == 6
+    assert checkpoint.latest_step(d / "absent") is None

@@ -1,0 +1,100 @@
+"""Chaos worker: SIGKILL the checkpoint WRITER mid-save (ISSUE 15
+crash-window satellite).
+
+Flow (elastic job, 4 ranks, HVD_PEER_TIMEOUT_MS armed by the test):
+
+1. iter 1 — every rank saves step 1 (sync). Committed.
+2. iter 3 — the current writer (rank 0, the set root) arms
+   HVD_CKPT_TEST_CRASH=2 and writes the marker; checkpoint.py's chaos
+   hook then SIGKILLs it AFTER its shards are durable but BEFORE the
+   shards barrier — exactly the window that used to wedge survivors in
+   the ``ckpt.shards.<step>`` barrier forever. Survivors must get RankEvictedError out
+   of the barrier via the PR 8 liveness/eviction path, roll back, and
+   re-rendezvous.
+3. On every (re)entry into the elastic fn, ranks restore via the
+   manifest path (elastic.restore_from_checkpoint — coordinate-free, so
+   joiners can run it): after the fault this must resolve step 1, the
+   last COMMITTED step, with step 1's exact values — the torn step-2
+   staging dir must never be resolvable as latest. The restored step
+   also catches the replacement writer up, proving the driver's
+   ckpt_step assignment plumbing.
+4. The retried save of step 2 succeeds (the marker keeps the new writer
+   from re-arming), the loop finishes, and every finisher logs
+   ``final rank=R size=S iter=I ckpt=1 parity=ok``.
+"""
+
+import os
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint, elastic
+
+hvd.init()
+
+ITERS = int(os.environ.get("TEST_ITERS", "6"))
+SLEEP = float(os.environ.get("TEST_SLEEP", "0.15"))
+MARKER = os.environ["TEST_MARKER"]
+CKDIR = os.environ["CKPT_DIR"]
+WID = os.environ.get("HVD_WORKER_ID", "?")
+SAVE_ITER, CRASH_ITER = 1, 3
+
+last_restored = [None]
+state = elastic.ObjectState(iteration=0)
+
+
+def _tree(step):
+    return {"w": np.full(4, float(step), np.float32),
+            "iteration": np.asarray(int(state.iteration), np.int64)}
+
+
+@elastic.run
+def train(state):
+    like = {"w": np.zeros(4, np.float32),
+            "iteration": np.asarray(0, np.int64)}
+    out, st = elastic.restore_from_checkpoint(like, directory=CKDIR)
+    last_restored[0] = st
+    if st is not None:
+        # Bit-exact: step s was saved with w == s everywhere.
+        assert np.array_equal(out["w"],
+                              np.full(4, float(st), np.float32)), \
+            (st, out["w"])
+        # Manifest-path catch-up: a freshly promoted/respawned rank 0
+        # adopts the checkpoint's progress BEFORE state.sync() broadcasts
+        # its dict, so the fleet never rewinds past the committed step.
+        state.iteration = max(int(state.iteration), int(out["iteration"]))
+    while state.iteration < ITERS:
+        it = int(state.iteration)
+        if it == SAVE_ITER:
+            checkpoint.save(CKDIR, 1, _tree(1))
+        if it == CRASH_ITER:
+            if not os.path.exists(MARKER) and hvd.rank() == 0:
+                # Arm the writer-crash hook ONCE: checkpoint.py SIGKILLs
+                # this process mid-save of step 2, before the commit.
+                with open(MARKER, "w") as f:
+                    f.write(WID)
+                os.environ["HVD_CKPT_TEST_CRASH"] = "2"
+            elif os.path.exists(MARKER):
+                # Post-fault retry: the torn step-2 attempt must have
+                # left step 1 as the newest COMMITTED checkpoint.
+                assert checkpoint.latest_step(CKDIR) == 1, \
+                    checkpoint.latest_step(CKDIR)
+            checkpoint.save(CKDIR, 2, _tree(2))
+        out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                            name=f"it.{it}")
+        state.iteration += 1
+        state.commit()
+        time.sleep(SLEEP)
+    return hvd.rank(), hvd.size()
+
+
+rank, size = train(state)
+# Post-recovery parity: the repaired mesh must still reduce correctly.
+check = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum, name="parity")
+parity = "ok" if np.allclose(check, float(size)) else f"BAD({check[0]})"
+if os.environ.get("TEST_LOG"):
+    with open(os.environ["TEST_LOG"], "a") as f:
+        f.write(f"final rank={rank} size={size} iter={state.iteration} "
+                f"ckpt={last_restored[0]} parity={parity}\n")
+hvd.shutdown()

@@ -1,0 +1,46 @@
+"""Worker: legacy orbax back-compat read (ISSUE 15 satellite).
+
+Write a checkpoint with orbax directly — the exact layout the
+pre-sharded revisions of horovod_tpu.checkpoint produced (StandardSave
+into ``<dir>/<step>/`` with its ``_METADATA`` commit marker) — and
+assert the new module still resolves it via ``latest_step`` and
+restores it through the legacy orbax path, while a NEW save in the same
+directory commits in the sharded format and shadows it as latest.
+"""
+import os
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint
+
+hvd.init()
+ckdir = os.environ["CKPT_DIR"]
+
+import orbax.checkpoint as ocp  # noqa: E402
+
+tree = {"w": np.arange(12.0, dtype=np.float32).reshape(3, 4),
+        "step": np.asarray(7, np.int64)}
+with checkpoint._ckptr() as ck:
+    ck.save(os.path.join(ckdir, "3"), args=ocp.args.StandardSave(tree))
+
+# The orbax _METADATA marker counts as committed.
+assert checkpoint.latest_step(ckdir) == 3
+
+like = {"w": np.zeros((3, 4), np.float32), "step": np.asarray(0, np.int64)}
+out, step = checkpoint.restore(ckdir, like)
+assert step == 3, step
+assert np.array_equal(np.asarray(out["w"]), tree["w"]), out["w"]
+assert int(out["step"]) == 7, out["step"]
+
+# A sharded-format save alongside it becomes the new latest; the legacy
+# step stays readable by explicit step=.
+checkpoint.save(ckdir, 4, {"w": tree["w"] * 2.0, "step": tree["step"]})
+assert checkpoint.latest_step(ckdir) == 4
+out, step = checkpoint.restore(ckdir, like, step=3)
+assert step == 3 and np.array_equal(np.asarray(out["w"]), tree["w"])
+out, step = checkpoint.restore(ckdir, like)
+assert step == 4 and np.array_equal(out["w"], tree["w"] * 2.0)
+
+print("legacy-ckpt PASS", flush=True)
+hvd.shutdown()

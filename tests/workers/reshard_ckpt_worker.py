@@ -1,0 +1,94 @@
+"""Worker: restore-with-reshard across world sizes (ISSUE 15 satellite).
+
+Run once with CKPT_PHASE=save at world size N, then again with
+CKPT_PHASE=restore at world size M (N != M): the restore job reads the
+global manifest and assembles each rank's target shards from only the
+overlapping fragments — bit-exact, mixed dtypes, TP-sharded AND
+replicated leaves. The 8-device CPU mesh is the same in both jobs
+(force_cpu_platform(8 // np)), only the process count changes, so shard
+boundaries genuinely move between save and restore.
+
+The tree crosses the format's cases on purpose:
+- "tp"    f32 (8, 4)  P("model")   8-way sharded both sides
+- "tp16"  f16 (8, 6)  P("model")   half precision, bit-exact
+- "rep"   i32 (3, 5)  plain numpy  root-written single shard, restored
+                                   whole on host
+- "repf"  f32 (8, 4)  plain numpy at save, P("model") at restore — each
+                      device reads a SUB-REGION of the one stored
+                      fragment (boundaries genuinely misaligned)
+- "count" i64 ()      scalar       the empty-index edge case
+"""
+import os
+
+import numpy as np
+
+from horovod_tpu.jax.distributed import force_cpu_platform
+
+phase = os.environ["CKPT_PHASE"]
+np_ = int(os.environ.get("HVD_SIZE", "1"))
+assert 8 % np_ == 0, np_
+force_cpu_platform(8 // np_)
+
+import jax  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import checkpoint  # noqa: E402
+
+if np_ > 1:
+    from horovod_tpu.jax import distributed as jd
+
+    assert jd.initialize_from_env(), "no HVD_JAX_COORD_ADDR in env"
+
+hvd.init()
+r = hvd.rank()
+ckdir = os.environ["CKPT_DIR"]
+
+mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("model",))
+shd = NamedSharding(mesh, P("model"))
+
+TP = np.arange(32.0, dtype=np.float32).reshape(8, 4) * 1.5
+TP16 = (np.arange(48.0, dtype=np.float16) / 3.0).reshape(8, 6)
+REP = np.arange(15, dtype=np.int32).reshape(3, 5) * 7
+REPF = np.arange(32.0, dtype=np.float32).reshape(8, 4) - 11.0
+COUNT = np.asarray(12345, np.int64)
+
+
+def _mk(full):
+    return jax.make_array_from_callback(
+        full.shape, shd, lambda idx, _f=full: _f[idx])
+
+
+if phase == "save":
+    tree = {"tp": _mk(TP), "tp16": _mk(TP16), "rep": REP, "repf": REPF,
+            "count": COUNT}
+    checkpoint.save(ckdir, 2, tree)
+    assert checkpoint.latest_step(ckdir) == 2
+elif phase == "restore":
+    like = {
+        "tp": _mk(np.zeros_like(TP)),
+        "tp16": _mk(np.zeros_like(TP16)),
+        "rep": np.zeros_like(REP),
+        # Saved as ONE root-written fragment; the sharded like makes
+        # every device fetch only its sub-region of it.
+        "repf": _mk(np.zeros_like(REPF)),
+        "count": np.zeros_like(COUNT),
+    }
+    out, step = checkpoint.restore(ckdir, like)
+    assert step == 2, step
+    for name, want in (("tp", TP), ("tp16", TP16), ("repf", REPF)):
+        got = out[name]
+        assert isinstance(got, jax.Array), (name, type(got))
+        assert got.dtype == want.dtype, (name, got.dtype)
+        for sh in got.addressable_shards:
+            assert np.array_equal(np.asarray(sh.data), want[sh.index]), name
+    assert out["rep"].dtype == REP.dtype
+    assert np.array_equal(out["rep"], REP)
+    assert out["count"].dtype == np.int64 and int(out["count"]) == 12345
+    st = hvd.checkpoint_stats()
+    assert st["restores"] == 1 and st["fragments_fetched"] > 0, st
+else:
+    raise SystemExit(f"unknown CKPT_PHASE {phase!r}")
+
+print(f"rank {r}: reshard-ckpt[{phase}@{np_}] PASS", flush=True)
+hvd.shutdown()

@@ -1,0 +1,104 @@
+"""Worker: torn-checkpoint fuzz (ISSUE 15 satellite).
+
+Save a TP-sharded checkpoint (async, to exercise the writer thread +
+wait() path), then corrupt it in every way a crashed writer or bad disk
+could, and assert each restore fails LOUDLY with a CheckpointError
+naming the offending piece — a partial restore must never be silently
+wrong. Each corruption is undone before the next so the cases are
+independent; the last one (deleted rank dir) is destructive and runs
+last.
+"""
+import json
+import os
+import shutil
+
+import numpy as np
+
+from horovod_tpu.jax.distributed import force_cpu_platform
+
+force_cpu_platform(8)
+
+import jax  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import checkpoint  # noqa: E402
+from horovod_tpu.exceptions import CheckpointError  # noqa: E402
+
+hvd.init()
+ckdir = os.environ["CKPT_DIR"]
+
+mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("model",))
+full = np.arange(64.0, dtype=np.float32).reshape(8, 8)
+w = jax.device_put(full, NamedSharding(mesh, P("model")))
+checkpoint.save(ckdir, 5, {"w": w, "b": np.ones(3, np.float32)},
+                async_=True)
+checkpoint.wait()
+st = hvd.checkpoint_stats()
+assert st["saves"] == 1 and st["commits"] == 1, st
+
+like = {"w": np.zeros((8, 8), np.float32), "b": np.zeros(3, np.float32)}
+out, step = checkpoint.restore(ckdir, like)
+assert step == 5 and np.array_equal(out["w"], full), step
+
+step_dir = os.path.join(ckdir, "5")
+mpath = os.path.join(step_dir, checkpoint.MANIFEST)
+with open(mpath) as f:
+    manifest_text = f.read()
+
+
+def expect(frag):
+    try:
+        checkpoint.restore(ckdir, like, step=5)
+    except CheckpointError as e:
+        assert frag in str(e), (frag, str(e))
+    else:
+        raise AssertionError(f"restore survived corruption ({frag!r})")
+
+
+# 1. Truncated MANIFEST.json — the classic torn write.
+with open(mpath, "w") as f:
+    f.write(manifest_text[: len(manifest_text) // 2])
+expect("torn manifest")
+
+# 2. Wrong format tag — a future/foreign layout must not half-parse.
+with open(mpath, "w") as f:
+    json.dump({"format": "bogus-v9"}, f)
+expect("unknown format")
+with open(mpath, "w") as f:
+    f.write(manifest_text)
+
+# 3. Flipped byte in a shard payload — crc must catch it and name it.
+fpath = os.path.join(step_dir, "rank_0", "shard_0000.npy")
+with open(fpath, "rb") as f:
+    payload = f.read()
+with open(fpath, "wb") as f:
+    f.write(payload[:-1] + bytes([payload[-1] ^ 0xFF]))
+expect("checksum mismatch in shard rank_0/shard_0000.npy")
+with open(fpath, "wb") as f:
+    f.write(payload)
+
+# 4. tree_like asking for a tensor the checkpoint never had.
+try:
+    checkpoint.restore(ckdir, dict(like, extra=np.zeros(2)), step=5)
+except CheckpointError as e:
+    assert "extra" in str(e) and "no tensor" in str(e), str(e)
+else:
+    raise AssertionError("restore survived a tree mismatch")
+
+# 5. Deleted rank dir — the error names the missing shard AND tensor.
+shutil.rmtree(os.path.join(step_dir, "rank_0"))
+expect("missing shard rank_0/")
+
+# 6. No MANIFEST at all: the dir no longer counts as committed anywhere.
+os.remove(mpath)
+assert checkpoint.latest_step(ckdir) is None
+try:
+    checkpoint.restore(ckdir, like, step=5)
+except CheckpointError as e:
+    assert "no committed checkpoint" in str(e), str(e)
+else:
+    raise AssertionError("restore survived a missing manifest")
+
+print("torn-ckpt PASS", flush=True)
+hvd.shutdown()

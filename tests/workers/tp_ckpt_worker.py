@@ -1,15 +1,17 @@
-"""Worker: what hvd.checkpoint.save does with a TP-sharded train state
-(ISSUE 8 satellite; ROADMAP item 5 prep). Two modes:
+"""Worker: hvd.checkpoint.save with a TP-sharded train state under the
+sharded format (ISSUE 15 tentpole; updates the PR 7 pins). Two modes:
 
 - CKPT_MODE=local: single process, params sharded over a model axis of
-  local devices. Pinned behavior: the root's host pull (checkpoint.py
-  _to_host) GATHERS each fully-addressable sharded leaf, so the written
-  checkpoint holds FULL arrays; restore returns plain replicated host
-  arrays — sharding metadata is NOT round-tripped.
-- CKPT_MODE=global: the model axis spans processes, so the root holds
-  only its own shards. Pinned behavior: save FAILS LOUDLY on the root's
-  host pull (np.asarray of a non-fully-addressable jax.Array) before
-  anything is written — not a silently-truncated checkpoint.
+  local devices. Every shard is addressable, so one rank dir holds the
+  whole state; restore into a plain-numpy like assembles full host
+  arrays, restore into a sharded like ROUND-TRIPS the sharding (the
+  reshard path, degenerate N==M case).
+- CKPT_MODE=global: the model axis spans processes. The PR 7 pin made
+  save fail loudly here; the sharded state plane's whole point is that
+  it now SUCCEEDS — each rank writes only its own addressable shards,
+  the root commits the global manifest, and restore hands every rank
+  exactly its shards back, bit-exact, with no full-array gather on any
+  host.
 """
 import os
 
@@ -48,27 +50,36 @@ if mode == "local":
             "b": np.zeros(4, np.float32)}
     out, step = checkpoint.restore(ckdir, like)
     assert step == 1, step
-    # The sharded leaf was gathered: the checkpoint holds the FULL array.
-    assert np.allclose(out["w"], full), out["w"]
-    # ...and comes back as a plain host array — the TP layout is gone.
-    # A later refactor that round-trips shardings should break THIS line.
+    # Plain-numpy like: the shard fragments assemble to the FULL array.
+    assert np.array_equal(out["w"], full), out["w"]
     assert isinstance(out["w"], np.ndarray), type(out["w"])
+    # Sharded like: the TP layout round-trips (what the PR 7 pin said a
+    # sharded-checkpoint refactor should change — it did).
+    wl = jax.device_put(np.zeros((8, 4), np.float32), sharding)
+    out2, _ = checkpoint.restore(ckdir, {"w": wl, "b": like["b"]})
+    assert isinstance(out2["w"], jax.Array), type(out2["w"])
+    assert out2["w"].sharding == sharding
+    assert np.array_equal(np.asarray(out2["w"]), full)
 elif mode == "global":
     w = jax.make_array_from_callback(full.shape, sharding,
                                      lambda idx: full[idx])
     assert not w.is_fully_addressable
-    if r == 0:
-        err = None
-        try:
-            checkpoint.save(ckdir, 1, {"w": w})
-        except Exception as e:  # noqa: BLE001 — the pin IS the exception
-            err = e
-        assert err is not None, \
-            "save silently accepted a non-addressable sharded state"
-        assert "addressable" in str(err).lower(), err
-        # Failed BEFORE writing: no half checkpoint on disk.
-        assert checkpoint.latest_step(ckdir) is None
-    hvd.barrier()
+    # PR 7 pinned save() raising here; the sharded format writes it.
+    tree = {"w": w, "b": np.full(4, float(r + 1), np.float32)}
+    checkpoint.save(ckdir, 1, tree)
+    assert checkpoint.latest_step(ckdir) == 1
+    # Each rank wrote ONLY its own shards into its own rank dir.
+    assert os.path.isdir(os.path.join(ckdir, "1", f"rank_{r}"))
+    like_w = jax.make_array_from_callback(
+        full.shape, sharding, lambda idx: np.zeros_like(full[idx]))
+    out, step = checkpoint.restore(
+        ckdir, {"w": like_w, "b": np.zeros(4, np.float32)})
+    assert step == 1, step
+    for sh in out["w"].addressable_shards:
+        assert np.array_equal(np.asarray(sh.data), full[sh.index])
+    # Unsharded leaves keep the restore-returns-the-root's-values
+    # contract: rank 0 wrote b.
+    assert np.allclose(out["b"], 1.0), out["b"]
 else:
     raise SystemExit(f"unknown CKPT_MODE {mode!r}")
 
