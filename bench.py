@@ -1968,63 +1968,76 @@ def _elastic_worker():
 # --------------------------------------------------------------------------
 
 def _bench_serve():
-    """Serving plane (ISSUE 14 acceptance): the continuous-batching
+    """Serving plane (ISSUE 14 + 16 acceptance): the continuous-batching
     decode loop under synthetic Poisson load at 1 and 8 ranks (8 = TP
-    mesh over forced host devices, KV cache sharded on heads), with the
-    continuous-vs-static A/B at equal offered load. Each cell is its own
-    subprocess (8-rank forces host devices before importing jax, which
-    must not leak to siblings). CPU smoke sizes per the 512 MB streaming
-    precedent: a tiny float32 model — the measured quantity is the
-    SCHEDULING win (batch-fill recovery), which is model-size
+    mesh over forced host devices, KV cache sharded on heads), with
+    three A/Bs at equal offered load:
+
+    1. continuous vs static scheduling (ISSUE 14),
+    2. prefix cache on vs off over shared-prefix traffic (ISSUE 16:
+       warm admissions must hit > 0.8 of prompt tokens and TTFT p50
+       must collapse — the shared prefill is simply skipped),
+    3. speculative decoding on vs off at batch 1 (ISSUE 16: > 1.5x
+       tok/s on self-similar output with the SAME greedy chains — the
+       spec path is bit-identical, it only batches the steps).
+
+    Each cell is its own subprocess (8-rank forces host devices before
+    importing jax, which must not leak to siblings). CPU smoke sizes per
+    the 512 MB streaming precedent: a tiny float32 model — the measured
+    quantities are scheduling/step-count wins, which are model-size
     independent; tok/s magnitudes are not TPU claims. Emits tok/s,
-    p50/p99 TTFT and inter-token latency, and the batch-fill /
-    KV-occupancy gauges per cell; asserts continuous strictly beats
-    static tok/s wherever both cells ran."""
+    p50/p99 TTFT and inter-token latency, the batch-fill / KV-occupancy
+    gauges, and the prefix-hit / spec-acceptance counters per cell."""
     import tempfile
+
+    def _cell(tag, cell_env, timeout=60):
+        fd, out_path = tempfile.mkstemp(prefix="hvd_bench_serve_")
+        os.close(fd)
+        try:
+            env = dict(os.environ)
+            env["PYTHONPATH"] = _repo_pythonpath(
+                os.environ.get("PYTHONPATH"))
+            env["_BENCH_SERVE_WORKER"] = "1"
+            env["_BENCH_SERVE_OUT"] = out_path
+            env["JAX_PLATFORMS"] = "cpu"
+            env.update(cell_env)
+            rc, _ = _run_subprocess(
+                [sys.executable, os.path.abspath(__file__)], env, timeout)
+            data = None
+            if rc == 0:
+                try:
+                    with open(out_path) as f:
+                        data = json.load(f)
+                except Exception:
+                    data = None
+            if data is None:
+                data = {"error": f"serve child {tag} exited rc={rc} "
+                                 f"with no JSON"}
+            return data
+        finally:
+            try:
+                os.unlink(out_path)
+            except OSError:
+                pass
 
     runs = {}
     for ranks in (1, 8):
         for mode in ("continuous", "static"):
-            fd, out_path = tempfile.mkstemp(prefix="hvd_bench_serve_")
-            os.close(fd)
-            try:
-                env = dict(os.environ)
-                env["PYTHONPATH"] = _repo_pythonpath(
-                    os.environ.get("PYTHONPATH"))
-                env["_BENCH_SERVE_WORKER"] = "1"
-                env["_BENCH_SERVE_OUT"] = out_path
-                env["_BENCH_SERVE_RANKS"] = str(ranks)
-                env["_BENCH_SERVE_MODE"] = mode
-                env["JAX_PLATFORMS"] = "cpu"
-                if ranks > 1:
-                    env["XLA_FLAGS"] = (
-                        env.get("XLA_FLAGS", "") +
-                        " --xla_force_host_platform_device_count=8"
-                    ).strip()
-                rc, _ = _run_subprocess(
-                    [sys.executable, os.path.abspath(__file__)], env,
-                    60 if ranks == 1 else 120)
-                data = None
-                if rc == 0:
-                    try:
-                        with open(out_path) as f:
-                            data = json.load(f)
-                    except Exception:
-                        data = None
-                if data is None:
-                    data = {"error": f"serve child ({mode}, {ranks}r) "
-                                     f"exited rc={rc} with no JSON"}
-                runs[f"{mode}_{ranks}r"] = data
-            finally:
-                try:
-                    os.unlink(out_path)
-                except OSError:
-                    pass
+            env = {"_BENCH_SERVE_RANKS": str(ranks),
+                   "_BENCH_SERVE_MODE": mode}
+            if ranks > 1:
+                env["XLA_FLAGS"] = (
+                    os.environ.get("XLA_FLAGS", "") +
+                    " --xla_force_host_platform_device_count=8").strip()
+            runs[f"{mode}_{ranks}r"] = _cell(
+                f"({mode}, {ranks}r)", env, 60 if ranks == 1 else 120)
+    for cell in ("prefix_on", "prefix_off", "spec_on", "spec_off"):
+        runs[cell] = _cell(cell, {"_BENCH_SERVE_CELL": cell})
 
     c1, s1 = runs["continuous_1r"], runs["static_1r"]
     assert "error" not in c1, c1
     assert "error" not in s1, s1
-    # The acceptance A/B: equal offered load (same seed, same arrival
+    # The ISSUE 14 A/B: equal offered load (same seed, same arrival
     # process), continuous strictly higher tok/s. Static drains the
     # whole batch before admitting, so its batch fill decays as short
     # requests finish — exactly what the gauges show.
@@ -2033,12 +2046,43 @@ def _bench_serve():
     c8, s8 = runs["continuous_8r"], runs["static_8r"]
     if "error" not in c8 and "error" not in s8:
         assert c8["tok_s"] > s8["tok_s"], (c8["tok_s"], s8["tok_s"])
+
+    # ISSUE 16 prefix A/B: shared-prefix traffic, cache on vs off.
+    pon, poff = runs["prefix_on"], runs["prefix_off"]
+    assert "error" not in pon, pon
+    assert "error" not in poff, poff
+    assert pon["prefix_hit_ratio"] > 0.8, pon
+    assert pon["ttft_p50_ms"] < 0.5 * poff["ttft_p50_ms"], (
+        pon["ttft_p50_ms"], poff["ttft_p50_ms"])
+    # kill switch: the off cell must behave exactly like PR 14 — no
+    # hits, no evictions, no chunk fills.
+    assert poff["prefix_hit_ratio"] == 0.0, poff
+    assert poff["prefix_evictions"] == 0 and poff["chunk_fills"] == 0, poff
+
+    # ISSUE 16 spec A/B: batch-1 self-similar decode, draft-8 vs plain.
+    son, soff = runs["spec_on"], runs["spec_off"]
+    assert "error" not in son, son
+    assert "error" not in soff, soff
+    assert son["chain_digest"] == soff["chain_digest"], (
+        "speculative chains diverged from plain greedy")
+    assert son["spec_accepted_per_step"] > 0, son
+    assert soff["spec_steps"] == 0, soff
+    spec_x = son["tok_s"] / soff["tok_s"]
+    assert spec_x > 1.5, (son["tok_s"], soff["tok_s"])
+
     d = {"metric": "serve_continuous_vs_static_throughput",
          "value": round(c1["tok_s"] / s1["tok_s"], 3),
          "unit": "x (continuous tok/s / static tok/s, equal Poisson "
                  "load, 1 rank; CPU smoke sizes)",
          "tok_s_continuous_1r": c1["tok_s"],
          "tok_s_static_1r": s1["tok_s"],
+         "prefix_hit_ratio": pon["prefix_hit_ratio"],
+         "prefix_ttft_p50_ms_on": pon["ttft_p50_ms"],
+         "prefix_ttft_p50_ms_off": poff["ttft_p50_ms"],
+         "prefix_ttft_collapse": round(
+             poff["ttft_p50_ms"] / max(pon["ttft_p50_ms"], 1e-9), 2),
+         "spec_speedup": round(spec_x, 3),
+         "spec_accepted_per_step": son["spec_accepted_per_step"],
          "runs": runs,
          "cpu_cores": len(os.sched_getaffinity(0)),
          "vs_baseline": 1.0}
@@ -2046,18 +2090,25 @@ def _bench_serve():
 
 
 def _serve_worker():
-    """One serve-bench cell (_BENCH_SERVE_WORKER): Poisson load through
-    ServeLoop at _BENCH_SERVE_RANKS ranks in _BENCH_SERVE_MODE, summary
-    JSON to _BENCH_SERVE_OUT. Errors are written as JSON, not raised —
-    the parent carries them as an environment note."""
+    """One serve-bench cell (_BENCH_SERVE_WORKER): synthetic load through
+    ServeLoop, summary JSON to _BENCH_SERVE_OUT. _BENCH_SERVE_CELL picks
+    the ISSUE 16 cells (prefix_on/off over shared-prefix traffic,
+    spec_on/off at batch 1); default is the ISSUE 14 continuous/static
+    cell at _BENCH_SERVE_RANKS ranks in _BENCH_SERVE_MODE. Errors are
+    written as JSON, not raised — the parent carries them as an
+    environment note."""
+    import hashlib
+
     out = {}
     try:
         import jax
 
         from horovod_tpu.models import transformer as tfm
         from horovod_tpu.serving import kv_cache
-        from horovod_tpu.serving.loop import ServeLoop, poisson_requests
+        from horovod_tpu.serving.loop import (ServeLoop, poisson_requests,
+                                              shared_prefix_requests)
 
+        cell = os.environ.get("_BENCH_SERVE_CELL", "")
         ranks = int(os.environ.get("_BENCH_SERVE_RANKS", "1"))
         mode = os.environ.get("_BENCH_SERVE_MODE", "continuous")
         mesh = None
@@ -2071,20 +2122,60 @@ def _serve_worker():
         cfg = tfm.TransformerConfig(
             vocab_size=256, d_model=64, n_heads=8, n_layers=2, d_ff=128,
             max_seq_len=96, dtype="float32")
-        params = tfm.init_params(jax.random.PRNGKey(0), cfg)
-        geo = kv_cache.geometry(n_pages=96, page_size=8, max_context=96)
-        n_req = int(os.environ.get("BENCH_SERVE_REQUESTS",
-                                   "32" if ranks == 1 else "12"))
-        rng = np.random.default_rng(11)
-        reqs = poisson_requests(n_req, rate=200.0, rng=rng,
-                                prompt_len=(4, 12), max_new=(2, 32),
-                                vocab=cfg.vocab_size)
-        sl = ServeLoop(params, cfg, geo=geo, mesh=mesh, max_batch=4,
-                       mode=mode)
+        if cell.startswith("prefix"):
+            # Shared-prefix traffic (one 80-token system prompt, short
+            # unique tails, short answers) arriving faster than cold
+            # prefills can drain: with the cache off TTFT is queueing
+            # behind everyone else's shared prefill; with it on, warm
+            # admissions chunk-fill only their tails.
+            params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+            geo = kv_cache.geometry(n_pages=160, page_size=8,
+                                    max_context=96)
+            rng = np.random.default_rng(11)
+            reqs = shared_prefix_requests(32, rate=1000.0, rng=rng,
+                                          prefix_len=80, tail_len=(2, 8),
+                                          max_new=(2, 6),
+                                          vocab=cfg.vocab_size)
+            sl = ServeLoop(params, cfg, geo=geo, max_batch=4,
+                           prefix_cache=(cell == "prefix_on"))
+        elif cell.startswith("spec"):
+            # Batch-1 decode on a positionally-invariant model (zeroed
+            # pos_embed): greedy output settles into exact repetition —
+            # the regime prompt-lookup self-drafting targets (templated/
+            # code-like text). k=8 drafts per target step.
+            params = tfm.init_params(jax.random.PRNGKey(7), cfg)
+            params["pos_embed"] = params["pos_embed"] * 0.0
+            geo = kv_cache.geometry(n_pages=96, page_size=8,
+                                    max_context=96)
+            rng = np.random.default_rng(11)
+            reqs = poisson_requests(6, rate=1e6, rng=rng,
+                                    prompt_len=(4, 12), max_new=(64, 64),
+                                    vocab=cfg.vocab_size)
+            sl = ServeLoop(params, cfg, geo=geo, max_batch=1,
+                           prefix_cache=False,
+                           spec_tokens=8 if cell == "spec_on" else 0)
+        else:
+            params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+            geo = kv_cache.geometry(n_pages=96, page_size=8,
+                                    max_context=96)
+            n_req = int(os.environ.get("BENCH_SERVE_REQUESTS",
+                                       "32" if ranks == 1 else "12"))
+            rng = np.random.default_rng(11)
+            reqs = poisson_requests(n_req, rate=200.0, rng=rng,
+                                    prompt_len=(4, 12), max_new=(2, 32),
+                                    vocab=cfg.vocab_size)
+            sl = ServeLoop(params, cfg, geo=geo, mesh=mesh, max_batch=4,
+                           mode=mode)
+        n_req = len(reqs)
         sl.warmup()  # compile outside the measured window
         summary, finished = sl.run(reqs)
         assert len(finished) == n_req, (len(finished), n_req)
         summary["n_ranks"] = ranks
+        # The greedy chains, digested: the spec on/off pair must match
+        # bit for bit (speculation changes the step count, not a token).
+        chains = sorted((r.rid, tuple(r.generated)) for r in finished)
+        summary["chain_digest"] = hashlib.sha256(
+            repr(chains).encode()).hexdigest()[:16]
         out = summary
     except Exception as e:  # noqa: BLE001 — carried, not fatal
         out = {"error": f"{type(e).__name__}: {e}"}

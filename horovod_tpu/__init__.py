@@ -182,6 +182,20 @@ def checkpoint_stats():
     return _checkpoint.checkpoint_stats()
 
 
+def serve_stats():
+    """The latest serve-loop boundary snapshot
+    (horovod_tpu/serving/loop.py): queue depth / batch fill / KV
+    occupancy gauges plus the serving-v2 counters — prefix-cache hit
+    ratio, evictions and live radix-tree size, speculative
+    accepted-tokens-per-step and rejections, and the batched/chunked
+    prefill path counts. Empty until a ServeLoop has run a boundary;
+    kill switches (HVD_SERVE_PREFIX_CACHE=0, spec_tokens=0) show as
+    zero activity here. See docs/serving.md."""
+    from .serving import loop as _serve_loop
+
+    return _serve_loop.serve_stats()
+
+
 def compression_stats():
     """One merged view of every compression surface: the core wire codecs
     (int8 error-feedback ring / top-k allgather — compress_stats()) plus

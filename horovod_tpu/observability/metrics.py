@@ -500,6 +500,24 @@ SERVE_ITL_SECONDS = histogram(
     "hvd_serve_itl_seconds",
     "Per-request mean inter-token latency over its decode life",
     buckets=(.001, .0025, .005, .01, .025, .05, .1, .25, .5, 1, 2.5))
+SERVE_PREFIX_HIT_RATIO = gauge(
+    "hvd_serve_prefix_hit_ratio",
+    "Fraction of admitted prompt tokens served from radix-tree-cached "
+    "KV pages instead of prefill (shared-prefix reuse — docs/serving.md; "
+    "stays untouched with HVD_SERVE_PREFIX_CACHE=0)")
+SERVE_SPEC_ACCEPTED_PER_STEP = gauge(
+    "hvd_serve_spec_accepted_per_step",
+    "Mean accepted draft tokens per speculative step (0..draft_k; the "
+    "speedup lever — each accepted token is a decode step the target "
+    "model skipped; stays untouched with spec_tokens=0)")
+SERVE_PREFIX_EVICTIONS = counter(
+    "hvd_serve_prefix_evictions",
+    "Cached prefix pages LRU-evicted back to the pool under page "
+    "pressure (only pages no live request shares are ever evicted)")
+SERVE_SPEC_REJECTED = counter(
+    "hvd_serve_spec_rejected",
+    "Draft tokens the target model rejected (their K/V is dead until "
+    "overwritten — pure block-table truncation, no copy)")
 CKPT_SAVES = counter(
     "hvd_ckpt_saves",
     "checkpoint.save() calls entered on this rank")

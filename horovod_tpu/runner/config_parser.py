@@ -91,6 +91,9 @@ ARG_TO_ENV = {
     "serve_autoscale": ("HVD_SERVE_AUTOSCALE", lambda v: "1" if v else "0"),
     "serve_autoscale_high": ("HVD_SERVE_AUTOSCALE_HIGH",
                              lambda v: str(int(v))),
+    "serve_prefix_cache": ("HVD_SERVE_PREFIX_CACHE",
+                           lambda v: str(int(v))),
+    "serve_spec_tokens": ("HVD_SERVE_SPEC_TOKENS", lambda v: str(int(v))),
     # State plane (horovod_tpu/checkpoint.py): default checkpoint
     # directory and whether save() commits on the background writer
     # thread (docs/checkpoint.md).
@@ -131,7 +134,9 @@ _FILE_SECTIONS = {
               "max-batch": "serve_max_batch",
               "mode": "serve_mode",
               "autoscale": "serve_autoscale",
-              "autoscale-high": "serve_autoscale_high"},
+              "autoscale-high": "serve_autoscale_high",
+              "prefix-cache": "serve_prefix_cache",
+              "spec-tokens": "serve_spec_tokens"},
     "checkpoint": {"dir": "ckpt_dir",
                    "async": "ckpt_async"},
 }

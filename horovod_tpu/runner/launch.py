@@ -209,6 +209,20 @@ def parse_args(argv=None):
                    help="serving: queue depth above which the autoscaler "
                         "wants another rank (HVD_SERVE_AUTOSCALE_HIGH; "
                         "hysteresis band bottom is fixed at depth<=1)")
+    p.add_argument("--serve-prefix-cache", dest="serve_prefix_cache",
+                   type=int, choices=[0, 1], default=None,
+                   help="serving: radix-tree shared-prefix KV reuse — "
+                        "identical page-aligned prompt prefixes share "
+                        "physical pages and skip their prefill "
+                        "(HVD_SERVE_PREFIX_CACHE; default 1, 0 restores "
+                        "the uncached path — docs/serving.md)")
+    p.add_argument("--serve-spec-tokens", dest="serve_spec_tokens",
+                   type=int, default=None,
+                   help="serving: speculative-decoding draft length k — "
+                        "each step drafts k tokens and scores them in one "
+                        "batched target pass, emitting 1..k+1 tokens "
+                        "bit-identical to greedy (HVD_SERVE_SPEC_TOKENS; "
+                        "default 0 = off — docs/serving.md)")
     # state plane (docs/checkpoint.md)
     p.add_argument("--ckpt-dir", dest="ckpt_dir", default=None,
                    help="checkpoint: default directory for "

@@ -3,20 +3,23 @@ cache, with queue-depth autoscaling through the elastic driver.
 
 Layout (docs/serving.md is the architecture doc):
 
-- :mod:`.scheduler` — jax-free continuous batcher + page allocator
-- :mod:`.autoscale` — jax-free queue-depth policy for the driver
-- :mod:`.kv_cache`  — paged K/V arrays, heads sharded on the TP axis
-- :mod:`.engine`    — jit'd prefill / decode_step with block tables
-- :mod:`.loop`      — the serve loop: Poisson load, latency spans, gauges
+- :mod:`.scheduler`    — jax-free continuous batcher + refcounted pages
+- :mod:`.autoscale`    — jax-free queue-depth policy for the driver
+- :mod:`.prefix_cache` — jax-free radix tree of shared page-aligned prefixes
+- :mod:`.speculate`    — jax-free drafters + the spec accept/reject rule
+- :mod:`.kv_cache`     — paged K/V arrays, heads sharded on the TP axis
+- :mod:`.engine`       — jit'd prefill / decode / chunk steps with block tables
+- :mod:`.loop`         — the serve loop: Poisson load, latency spans, gauges
 
-Lazy submodule access keeps the jax-free halves (scheduler, autoscale)
-importable — by the elastic driver and by the pure-numpy tests — without
-pulling jax into the process.
+Lazy submodule access keeps the jax-free halves (scheduler, autoscale,
+prefix_cache, speculate) importable — by the elastic driver and by the
+pure-numpy tests — without pulling jax into the process.
 """
 
 import importlib
 
-_SUBMODULES = ("scheduler", "autoscale", "kv_cache", "engine", "loop")
+_SUBMODULES = ("scheduler", "autoscale", "prefix_cache", "speculate",
+               "kv_cache", "engine", "loop")
 
 
 def __getattr__(name):

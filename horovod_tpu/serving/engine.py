@@ -195,6 +195,148 @@ def make_decode_step(cfg, geo, mesh=None, max_batch=8):
     return jax.jit(decode, donate_argnums=(1,))
 
 
+def _chunk_forward(params, cache, tokens, positions, block_tables,
+                   active, *, cfg, geo, mesh):
+    """Shared body for every multi-token paged step: embed a [B, Q]
+    token window starting at each slot's ``positions[b]``, scatter its
+    K/V through the block tables, attend over the gathered pages under
+    a ``kv_pos <= position`` mask. Within-window causality falls out of
+    the same mask because the window's own K/V is written BEFORE the
+    gather — position p sees cached history plus window positions
+    <= p. Returns (ck, cv, x[B, Q, D] after final_ln)."""
+    dt = cfg.compute_dtype
+    q_len = tokens.shape[1]
+    max_kv = geo.max_kv
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    pos = positions[:, None] + jnp.arange(q_len)[None, :]    # [B, Q]
+    pe = jnp.clip(pos, 0, cfg.max_seq_len - 1)
+    x = (params["embed"].astype(dt)[tokens]
+         + params["pos_embed"].astype(dt)[pe])               # [B, Q, D]
+    ck, cv = cache["k"], cache["v"]
+    blk = jnp.minimum(pos // geo.page_size, geo.max_blocks - 1)
+    valid = (pos < max_kv) & active[:, None]
+    page_ids = jnp.take_along_axis(block_tables, blk, axis=1)
+    page_ids = jnp.where(valid, page_ids, 0)                 # trash route
+    slot_w = jnp.where(valid, pos % geo.page_size, 0)
+    kv_mask = (jnp.arange(max_kv)[None, None, :]
+               <= pos[:, :, None])                           # [B, Q, KV]
+    for li, layer in enumerate(params["layers"]):
+        h = _layer_norm(x, layer["ln1"])
+        q, k, v = _qkv(h, layer, cfg)                        # [B, Q, H, dh]
+        ck = ck.at[li, page_ids, slot_w].set(k)
+        cv = cv.at[li, page_ids, slot_w].set(v)
+        kp = ck[li][block_tables].reshape(
+            -1, max_kv, cfg.n_heads, cfg.head_dim)
+        vp = cv[li][block_tables].reshape(
+            -1, max_kv, cfg.n_heads, cfg.head_dim)
+        logits = jnp.einsum("bshk,bthk->bhst", q, kp) * scale
+        logits = jnp.where(kv_mask[:, None, :, :], logits,
+                           jnp.finfo(dt).min)
+        probs = jax.nn.softmax(logits.astype(jnp.float32),
+                               -1).astype(dt)
+        ctx = jnp.einsum("bhst,bthk->bshk", probs, vp)
+        x = x + jnp.einsum("bshk,hkd->bsd", ctx,
+                           layer["wo"].astype(dt))
+        x = _ffn_block(x, layer, cfg)
+    return ck, cv, _layer_norm(x, params["final_ln"])
+
+
+def make_chunk_step(cfg, geo, mesh=None, q_len=None):
+    """Compiled ``(params, cache, tokens, positions, block_tables,
+    active) -> (cache, logits)`` — a ``q_len``-token window for every
+    slot, the generalization of :func:`make_decode_step` to q_len > 1.
+
+    tokens: [B, q_len] int32; positions: [B] int32 (the index
+    ``tokens[b, 0]`` is written at); block_tables: [B, max_blocks];
+    active: [B] bool. Returns logits for EVERY window position
+    [B, q_len, vocab] (float32) — the caller picks the rows it trusts.
+
+    Two serving paths compile this one program (with their own shapes):
+
+    - **chunked prefill** (B=1, q_len=prefill_chunk): a cache-miss
+      suffix fills chunk-by-chunk across decode boundaries instead of
+      monopolizing one with a full-width prefill. The chunk's live
+      score footprint [q_len, max_kv] is exactly what
+      ``transformer.resolve_attn`` tiers on — q_len is the knob that
+      walks this step from gather territory toward the flash
+      crossover, and the inline math below is the gather-tier kernel
+      (the einsum ``_attention`` parity path; on-TPU flash tiling of
+      the same mask is a drop-in behind the same signature).
+    - **speculative scoring** (B=max_batch, q_len=draft_k+1): one
+      batched target pass scores ``[last_token, d_1..d_k]`` per slot;
+      accept/reject happens host-side (:mod:`.speculate`).
+
+    Writes for positions past ``max_kv`` or on inactive slots route to
+    trash page 0, so padded draft lanes and short final chunks are
+    branch-free.
+    """
+    _check_decode_impl(cfg, geo, mesh)
+    q_len = geo.page_size if q_len is None else int(q_len)
+    if q_len < 1:
+        raise ValueError(f"chunk q_len must be >= 1, got {q_len}")
+    if geo.max_kv > cfg.max_seq_len:
+        raise ValueError(
+            f"cache width {geo.max_kv} exceeds the model's max_seq_len "
+            f"{cfg.max_seq_len} (pos_embed rows); shrink the geometry")
+    # Consulted for the same reason decode pins "gather": the chunk's
+    # REAL (q_len, kv_len, causal) footprint decides the kernel tier.
+    tfm.resolve_attn(cfg, q_len, mesh, kv_len=geo.max_kv, causal=True)
+    kv_spec = kv_cache.spec(cfg)
+
+    def chunk(params, cache, tokens, positions, block_tables, active):
+        ck, cv, x = _chunk_forward(params, cache, tokens, positions,
+                                   block_tables, active,
+                                   cfg=cfg, geo=geo, mesh=mesh)
+        logits = jnp.einsum("bsd,vd->bsv", x,
+                            params["embed"].astype(cfg.compute_dtype))
+        ck = _constrain(ck, mesh, kv_spec)
+        cv = _constrain(cv, mesh, kv_spec)
+        return {"k": ck, "v": cv}, logits.astype(jnp.float32)
+
+    return jax.jit(chunk, donate_argnums=(1,))
+
+
+def make_batched_prefill(cfg, geo, mesh=None, prefill_pad=None):
+    """Compiled ``(params, cache, tokens, lengths, block_tables,
+    active) -> (cache, logits)`` — ALL same-boundary admissions'
+    prompts in one padded call instead of one jit dispatch each.
+
+    tokens: [B, prefill_pad] int32 (zero-padded per row); lengths: [B]
+    int32 real token counts; block_tables: [B, max_blocks]; active: [B]
+    bool (padding rows route to trash page 0). Returns each row's last
+    REAL position's next-token logits [B, vocab] (float32) — identical
+    math to :func:`make_prefill` row by row, because both write the
+    window's K/V first and attend under the same causal mask
+    (tests/test_serving.py pins the parity).
+    """
+    pad = geo.max_kv if prefill_pad is None else int(prefill_pad)
+    if pad % geo.page_size != 0:
+        raise ValueError(f"prefill_pad {pad} must be a multiple of "
+                         f"page_size {geo.page_size}")
+    if pad > cfg.max_seq_len:
+        raise ValueError(
+            f"prefill_pad {pad} exceeds the model's max_seq_len "
+            f"{cfg.max_seq_len} (pos_embed rows); shrink the cache "
+            f"geometry or raise max_seq_len")
+    _check_decode_impl(cfg, geo, mesh)
+    kv_spec = kv_cache.spec(cfg)
+
+    def bprefill(params, cache, tokens, lengths, block_tables, active):
+        positions = jnp.zeros(tokens.shape[:1], jnp.int32)
+        ck, cv, x = _chunk_forward(params, cache, tokens, positions,
+                                   block_tables, active,
+                                   cfg=cfg, geo=geo, mesh=mesh)
+        last = jnp.take_along_axis(
+            x, jnp.clip(lengths - 1, 0, pad - 1)[:, None, None], axis=1)
+        logits = jnp.einsum("bsd,vd->bsv", last,
+                            params["embed"].astype(cfg.compute_dtype))
+        ck = _constrain(ck, mesh, kv_spec)
+        cv = _constrain(cv, mesh, kv_spec)
+        return {"k": ck, "v": cv}, logits[:, 0].astype(jnp.float32)
+
+    return jax.jit(bprefill, donate_argnums=(1,))
+
+
 @functools.partial(jax.jit, static_argnums=())
 def greedy(logits):
     """Greedy next token per row (float32 logits [.., vocab])."""

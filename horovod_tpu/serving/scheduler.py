@@ -33,10 +33,12 @@ in :mod:`.engine` executes):
   always finish.
 
 Page accounting contract (tests/test_serving_scheduler.py pins these):
-``free + sum(owned) == n_pages - 1`` at every boundary (page 0 is the
-engine's trash page for masked writes and is never handed out), a page
-is never owned twice, and ``free()`` of a page not currently owned
-raises instead of corrupting the pool.
+``free + distinct-owned == n_pages - 1`` at every boundary (page 0 is
+the engine's trash page for masked writes and is never handed out), a
+page's refcount equals the number of holders referencing it (requests
+plus at most one prefix-cache reference), and ``free()``/``share()`` of
+a page not currently owned raise BEFORE mutation instead of corrupting
+the pool.
 """
 
 import collections
@@ -57,6 +59,8 @@ def _int(raw, default):
 DEFAULT_PAGE_SIZE = 16
 DEFAULT_KV_PAGES = 256
 DEFAULT_MAX_BATCH = 8
+DEFAULT_PREFIX_CACHE = 1   # radix-tree shared-prefix KV reuse (ISSUE 16)
+DEFAULT_SPEC_TOKENS = 0    # speculative decoding draft-k (0 = off)
 
 
 def serve_knobs():
@@ -71,6 +75,10 @@ def serve_knobs():
         "max_batch": _int(os.environ.get("HVD_SERVE_MAX_BATCH", ""),
                           DEFAULT_MAX_BATCH),
         "mode": mode,
+        "prefix_cache": _int(os.environ.get("HVD_SERVE_PREFIX_CACHE", ""),
+                             DEFAULT_PREFIX_CACHE),
+        "spec_tokens": _int(os.environ.get("HVD_SERVE_SPEC_TOKENS", ""),
+                            DEFAULT_SPEC_TOKENS),
     }
 
 
@@ -79,11 +87,20 @@ class PageError(RuntimeError):
 
 
 class PageAllocator:
-    """Fixed pool of KV pages with a free list and strict ownership.
+    """Fixed pool of KV pages with a free list and refcounted ownership.
 
     Page 0 is reserved as the engine's trash page (inactive batch slots
     route their cache writes there) and is never allocated. ``alloc`` is
     all-or-nothing so a half-admitted request can never leak pages.
+
+    Sharing is copy-on-write in the degenerate (and only) case paged
+    prefix reuse needs: pages are shared exclusively at page-aligned
+    *prefix* boundaries, and a request only ever writes K/V at positions
+    >= its own context length — which always land in pages it owns
+    exclusively. So "copy" never actually happens; ``share`` bumps a
+    refcount and ``free`` decrements it, returning the page to the pool
+    only when the last reference drops. Double-free and
+    refcount-underflow raise :class:`PageError` BEFORE any mutation.
     """
 
     def __init__(self, n_pages, page_size):
@@ -95,7 +112,7 @@ class PageAllocator:
         self.n_pages = int(n_pages)
         self.page_size = int(page_size)
         self._free = collections.deque(range(1, self.n_pages))
-        self._owned = set()
+        self._ref = {}             # page -> refcount (>= 1 while owned)
 
     @property
     def usable_pages(self):
@@ -106,36 +123,61 @@ class PageAllocator:
         return len(self._free)
 
     def used_pages(self):
-        return len(self._owned)
+        """Distinct pages currently owned (each counted once however
+        many references it has — physical pool pressure)."""
+        return len(self._ref)
+
+    def refcount(self, page):
+        """Current reference count of `page` (0 when free/unallocated)."""
+        return self._ref.get(page, 0)
 
     def occupancy(self):
         """Fraction of usable pages currently owned — the
         SERVE_KV_OCCUPANCY gauge."""
-        return len(self._owned) / max(1, self.usable_pages)
+        return len(self._ref) / max(1, self.usable_pages)
 
     def alloc(self, n):
-        """Take `n` pages or none. Returns the page list, or None when
-        the pool cannot cover the request."""
+        """Take `n` pages or none. Returns the page list (each at
+        refcount 1), or None when the pool cannot cover the request."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         if n > len(self._free):
             return None
         pages = [self._free.popleft() for _ in range(n)]
-        self._owned.update(pages)
+        for p in pages:
+            self._ref[p] = 1
         return pages
 
-    def free(self, pages):
-        """Return pages to the pool. A page not currently owned (double
-        free, or a number that was never allocated) raises PageError
-        BEFORE any state changes — the pool stays consistent."""
+    def share(self, pages):
+        """Take an additional reference on already-owned pages (a
+        prefix-cache hit forking a cached prefix into a new request).
+        Sharing a page that is not currently owned raises PageError
+        BEFORE any refcount changes."""
         pages = list(pages)
         for p in pages:
-            if p not in self._owned:
-                raise PageError(f"free of unowned KV page {p} (double "
-                                f"free or foreign page)")
+            if p not in self._ref:
+                raise PageError(f"share of unowned KV page {p} (stale "
+                                f"prefix-cache entry or foreign page)")
         for p in pages:
-            self._owned.discard(p)
-            self._free.append(p)
+            self._ref[p] += 1
+
+    def free(self, pages):
+        """Drop one reference per page; a page returns to the pool only
+        at refcount 0. A page not currently owned (double free,
+        refcount underflow, or a number that was never allocated) raises
+        PageError BEFORE any state changes — the pool stays consistent."""
+        pages = list(pages)
+        counts = collections.Counter(pages)
+        for p, n in counts.items():
+            if self._ref.get(p, 0) < n:
+                raise PageError(f"free of unowned KV page {p} (double "
+                                f"free, refcount underflow, or foreign "
+                                f"page)")
+        for p in pages:
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                del self._ref[p]
+                self._free.append(p)
 
 
 _WAITING, _RUNNING, _DONE = "waiting", "running", "done"
@@ -162,6 +204,7 @@ class Request:
     finish_reason: str = ""
     preemptions: int = 0
     admit_seq: int = -1         # admission order (preemption picks max)
+    cached_tokens: int = 0      # prompt tokens covered by a prefix hit
 
     @property
     def prompt_len(self):
@@ -191,19 +234,35 @@ class ContinuousBatcher:
     """
 
     def __init__(self, allocator, max_batch=DEFAULT_MAX_BATCH,
-                 mode="continuous"):
+                 mode="continuous", prefix_cache=None, spec_tokens=0):
         if mode not in ("continuous", "static"):
             raise ValueError(f"serve mode must be 'continuous' or "
                              f"'static', got {mode!r}")
+        if spec_tokens < 0:
+            raise ValueError(f"spec_tokens must be >= 0, got "
+                             f"{spec_tokens}")
         self.alloc = allocator
         self.max_batch = int(max_batch)
         self.mode = mode
+        self.prefix = prefix_cache  # PrefixCache or None (reuse off)
+        self.spec_tokens = int(spec_tokens)
         self.waiting = collections.deque()
         self.running = {}          # slot -> Request
         self.done = []
         self._admit_seq = 0
         self.stats = {"admissions": 0, "evictions": 0, "preemptions": 0,
-                      "tokens": 0}
+                      "tokens": 0, "prefix_hit_tokens": 0,
+                      "prefix_prompt_tokens": 0, "spec_steps": 0,
+                      "spec_accepted": 0, "spec_rejected": 0}
+
+    @property
+    def _lookahead(self):
+        """Token positions a request must own pages for beyond its
+        current context before the next step: 1 for the plain decode
+        write, plus draft-k when speculating (a spec step writes K/V for
+        the last token AND all k drafts before accept/reject resolves,
+        so page growth must reserve the whole window up front)."""
+        return 1 + self.spec_tokens
 
     # -- gauges -----------------------------------------------------------
 
@@ -228,25 +287,34 @@ class ContinuousBatcher:
     # -- token boundary ---------------------------------------------------
 
     def on_tokens(self, tokens_by_slot, now=0.0):
-        """Record one decode step's outputs (slot -> token id), then run
-        the boundary: evict finished, grow pages (preempting if starved),
-        admit. Returns the list of requests evicted as DONE this
-        boundary."""
+        """Record one decode step's outputs (slot -> token id, or slot ->
+        token id LIST when a speculative step emitted several accepted
+        tokens at once), then run the boundary: evict finished, grow
+        pages (preempting if starved), admit. A list is consumed in
+        order and truncated at the first EOS / max-tokens hit — trailing
+        accepted drafts past a finish are dropped, exactly as if they
+        were never accepted (rejection IS just not appending: the block
+        table simply never extends over the stale K/V). Returns the list
+        of requests evicted as DONE this boundary."""
         finished = []
-        for slot, tok in tokens_by_slot.items():
+        for slot, toks in tokens_by_slot.items():
             req = self.running.get(slot)
             if req is None:
                 continue
-            req.generated.append(tok)
-            self.stats["tokens"] += 1
-            if req.first_token_t == 0.0:
-                req.first_token_t = now
-            if tok == req.eos_id:
-                req.finish_reason = "eos"
-            elif len(req.generated) >= req.max_new_tokens:
-                req.finish_reason = "max_tokens"
-            if req.finish_reason:
-                finished.append(self._finish(req, now))
+            if isinstance(toks, int):
+                toks = [toks]
+            for tok in toks:
+                req.generated.append(tok)
+                self.stats["tokens"] += 1
+                if req.first_token_t == 0.0:
+                    req.first_token_t = now
+                if tok == req.eos_id:
+                    req.finish_reason = "eos"
+                elif len(req.generated) >= req.max_new_tokens:
+                    req.finish_reason = "max_tokens"
+                if req.finish_reason:
+                    finished.append(self._finish(req, now))
+                    break
         self._grow_pages(now)
         self.admit(now)
         return finished
@@ -262,17 +330,29 @@ class ContinuousBatcher:
         self.stats["evictions"] += 1
         return req
 
+    def _take_pages(self, n):
+        """alloc(n), reclaiming LRU unreferenced prefix-cache pages
+        first when the pool alone cannot cover it. Cached prefixes are
+        opportunistic — live requests always outrank them."""
+        got = self.alloc.alloc(n)
+        if got is None and self.prefix is not None:
+            self.prefix.evict(n - self.alloc.free_pages())
+            got = self.alloc.alloc(n)
+        return got
+
     def _grow_pages(self, now):
-        """Every running request must own a page slot for its NEXT token
-        position before the next decode step. Requests crossing a page
-        boundary take one page; page starvation preempts the youngest
+        """Every running request must own page slots for its next
+        ``1 + spec_tokens`` token positions before the next step.
+        Requests crossing a page boundary take pages (evicting stale
+        prefix-cache pages first); page starvation preempts the youngest
         running request (freeing its pages) until the growth fits."""
         for slot in sorted(self.running):
             req = self.running.get(slot)
             if req is None:
                 continue  # preempted by an earlier growth this boundary
-            while len(req.pages) < req.pages_needed(self.alloc.page_size):
-                got = self.alloc.alloc(1)
+            while len(req.pages) < req.pages_needed(
+                    self.alloc.page_size, extra_tokens=self._lookahead):
+                got = self._take_pages(1)
                 if got is not None:
                     req.pages.extend(got)
                     continue
@@ -295,14 +375,20 @@ class ContinuousBatcher:
         req.pages = []
         req.slot = -1
         req.state = _WAITING
+        req.cached_tokens = 0   # re-resolved against the cache at readmit
         req.preemptions += 1
         self.stats["preemptions"] += 1
         self.waiting.appendleft(req)
 
     def admit(self, now=0.0):
         """Fill free slots from the waiting queue while the first
-        allocation fits. Returns newly admitted requests (they need a
-        prefill before the next decode step)."""
+        allocation fits. With a prefix cache attached, admission first
+        resolves the longest cached page-aligned strict prefix of the
+        prompt: those pages are SHARED (refcount bump, no copy — the
+        request never writes below its own context length) and only the
+        novel remainder is allocated. Returns newly admitted requests
+        (they need a prefill of their uncached suffix before the next
+        decode step)."""
         if self.mode == "static" and self.running:
             return []
         admitted = []
@@ -310,12 +396,22 @@ class ContinuousBatcher:
                       if s not in self.running]
         while self.waiting and free_slots:
             req = self.waiting[0]
-            need = req.pages_needed(self.alloc.page_size)
-            pages = self.alloc.alloc(need)
+            shared, cached = [], 0
+            if self.prefix is not None:
+                shared, cached = self.prefix.lookup(req.prompt)
+                # Pin the hit before any allocation can LRU-evict it:
+                # at refcount 2 these pages are invisible to evict().
+                self.alloc.share(shared)
+            need = req.pages_needed(self.alloc.page_size,
+                                    extra_tokens=self._lookahead)
+            pages = self._take_pages(need - len(shared))
             if pages is None:
+                if shared:
+                    self.alloc.free(shared)  # unpin the aborted hit
                 break  # head-of-line: keep arrival order, wait for pages
             self.waiting.popleft()
-            req.pages = pages
+            req.pages = shared + pages
+            req.cached_tokens = cached
             req.slot = free_slots.pop(0)
             req.state = _RUNNING
             req.admitted_t = now
@@ -323,8 +419,27 @@ class ContinuousBatcher:
             self._admit_seq += 1
             self.running[req.slot] = req
             self.stats["admissions"] += 1
+            if self.prefix is not None:
+                self.stats["prefix_hit_tokens"] += cached
+                self.stats["prefix_prompt_tokens"] += req.prompt_len
             admitted.append(req)
         return admitted
+
+    def register_prefilled(self, req):
+        """Publish a freshly prefilled request's full prompt pages into
+        the prefix cache (no-op without one). Called by the serve loop
+        once the prompt's K/V is actually materialized — registering at
+        admission would let a second request hit pages whose suffix was
+        never written."""
+        if self.prefix is not None and req.slot >= 0:
+            self.prefix.insert(req.prompt, req.pages)
+
+    def prefix_hit_ratio(self):
+        """Fraction of admitted prompt tokens served from cached pages —
+        the SERVE_PREFIX_HIT_RATIO gauge (0.0 until the first admission
+        with a cache attached)."""
+        total = self.stats["prefix_prompt_tokens"]
+        return self.stats["prefix_hit_tokens"] / total if total else 0.0
 
     def block_table(self, req, max_blocks):
         """The request's page list padded with trash page 0 to the

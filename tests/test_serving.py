@@ -41,6 +41,7 @@ from horovod_tpu.models import transformer as tfm  # noqa: E402
 from horovod_tpu.serving import engine, kv_cache  # noqa: E402
 from horovod_tpu.serving.loop import (ServeLoop,  # noqa: E402
                                       poisson_requests)
+from horovod_tpu.serving.scheduler import Request  # noqa: E402
 
 pytestmark = pytest.mark.serve
 
@@ -273,9 +274,238 @@ def test_serve_loop_rejects_oversized_prompt():
     geo = kv_cache.geometry(n_pages=8, page_size=4, max_context=16)
     params = tfm.init_params(jax.random.PRNGKey(0), cfg)
     sl = ServeLoop(params, cfg, geo=geo, max_batch=1)
-    from horovod_tpu.serving.scheduler import Request
     with pytest.raises(ValueError):
         sl.run([Request(rid=0, prompt=list(range(16)), max_new_tokens=4)])
+
+
+# ---------------------------------------------------------------------------
+# serving v2 (ISSUE 16): chunked/batched prefill, prefix cache, speculation
+# ---------------------------------------------------------------------------
+
+def test_chunk_step_parity_with_forward():
+    """The chunked prefill step (decode generalized to q_len > 1) writes
+    window K/V through the block table and matches the full forward at
+    every real position, including a ragged final chunk whose padding
+    writes land beyond every compared position."""
+    cfg = _cfg()
+    geo = kv_cache.geometry(n_pages=16, page_size=8, max_context=64)
+    params = tfm.init_params(jax.random.PRNGKey(7), cfg)
+    chunk = engine.make_chunk_step(cfg, geo, q_len=8)
+    cache = kv_cache.make_cache(cfg, geo)
+    rng = np.random.default_rng(11)
+    seq = [int(x) for x in rng.integers(0, cfg.vocab_size, size=20)]
+    bt = np.asarray([1, 2, 3] + [0] * (geo.max_blocks - 3), np.int32)[None]
+    ref_all = np.asarray(
+        tfm.forward(params, np.asarray([seq], np.int32), cfg)[0],
+        np.float32)
+    for start in (0, 8, 16):
+        end = min(start + 8, len(seq))
+        toks = np.zeros((1, 8), np.int32)
+        toks[0, :end - start] = seq[start:end]
+        cache, logits = chunk(params, cache, toks,
+                              np.asarray([start], np.int32), bt,
+                              np.ones(1, bool))
+        np.testing.assert_allclose(
+            np.asarray(logits[0, :end - start], np.float32),
+            ref_all[start:end], rtol=1e-4, atol=1e-5)
+
+
+def test_chunk_step_validated():
+    cfg = _cfg()
+    geo = kv_cache.geometry(n_pages=16, page_size=8, max_context=64)
+    with pytest.raises(ValueError):
+        engine.make_chunk_step(cfg, geo, q_len=0)
+    with pytest.raises(ValueError):   # cache wider than the pos table
+        engine.make_chunk_step(
+            cfg, kv_cache.geometry(32, 8, 128), q_len=8)
+
+
+def test_batched_prefill_parity():
+    """One padded call prefills rows of different lengths; each row's
+    last-real-position logits match its own full-forward reference."""
+    cfg = _cfg()
+    geo = kv_cache.geometry(n_pages=16, page_size=8, max_context=64)
+    params = tfm.init_params(jax.random.PRNGKey(8), cfg)
+    bp = engine.make_batched_prefill(cfg, geo)
+    cache = kv_cache.make_cache(cfg, geo)
+    rng = np.random.default_rng(12)
+    seqs = [[int(x) for x in rng.integers(0, cfg.vocab_size, size=n)]
+            for n in (5, 13, 9)]
+    B, mb, pad = 3, geo.max_blocks, geo.max_kv
+    toks = np.zeros((B, pad), np.int32)
+    lengths = np.ones(B, np.int32)
+    tables = np.zeros((B, mb), np.int32)
+    next_page = 1
+    for row, seq in enumerate(seqs):
+        toks[row, :len(seq)] = seq
+        lengths[row] = len(seq)
+        n_pages = -(-len(seq) // geo.page_size)
+        tables[row, :n_pages] = range(next_page, next_page + n_pages)
+        next_page += n_pages
+    cache, logits = bp(params, cache, toks, lengths, tables,
+                       np.ones(B, bool))
+    for row, seq in enumerate(seqs):
+        np.testing.assert_allclose(np.asarray(logits[row], np.float32),
+                                   _ref_logits(params, cfg, seq),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_batched_prefill_loop_parity_and_fallback_counters():
+    """Satellite: same-boundary admissions prefill in ONE batched call;
+    the counted per-request fallback produces identical chains."""
+    cfg = _cfg()
+    geo = kv_cache.geometry(n_pages=32, page_size=8, max_context=64)
+    params = tfm.init_params(jax.random.PRNGKey(6), cfg)
+
+    def _reqs():
+        rng = np.random.default_rng(17)
+        return _instant(poisson_requests(
+            8, rate=1e6, rng=rng, prompt_len=(2, 10), max_new=(2, 10),
+            vocab=cfg.vocab_size))
+
+    on = ServeLoop(params, cfg, geo=geo, max_batch=4, prefix_cache=False,
+                   batch_prefill=True)
+    s_on, f_on = on.run(_reqs())
+    off = ServeLoop(params, cfg, geo=geo, max_batch=4, prefix_cache=False,
+                    batch_prefill=False)
+    s_off, f_off = off.run(_reqs())
+    assert off.bprefill_fn is None
+    assert {r.rid: r.generated for r in f_on} \
+        == {r.rid: r.generated for r in f_off}
+    assert s_on["prefill_batch_calls"] >= 1 and s_on["prefill_batched"] >= 2
+    assert s_off["prefill_batch_calls"] == 0
+    assert s_off["prefill_single"] == 8
+
+
+def test_prefix_cache_warm_second_request_hits():
+    """A warm identical prefix admits with shared pages, chunk-fills
+    only the novel tail, and still generates the exact cache-off chain —
+    the cached K/V really is the prefill's K/V."""
+    cfg = _cfg()
+    geo = kv_cache.geometry(n_pages=32, page_size=8, max_context=64)
+    params = tfm.init_params(jax.random.PRNGKey(3), cfg)
+    rng = np.random.default_rng(21)
+    prefix = [int(x) for x in rng.integers(0, cfg.vocab_size, size=24)]
+    tails = [[int(x) for x in rng.integers(0, cfg.vocab_size, size=4)]
+             for _ in range(2)]
+
+    def _req(rid, tail):
+        return Request(rid=rid, prompt=prefix + list(tail),
+                       max_new_tokens=8)
+
+    off = ServeLoop(params, cfg, geo=geo, max_batch=2, prefix_cache=False)
+    _, ref0 = off.run(_instant([_req(0, tails[0])]))
+    _, ref1 = off.run(_instant([_req(1, tails[1])]))
+    sl = ServeLoop(params, cfg, geo=geo, max_batch=2, prefix_cache=True)
+    _, cold = sl.run(_instant([_req(0, tails[0])]))
+    assert cold[0].cached_tokens == 0            # nothing cached yet
+    _, warm = sl.run(_instant([_req(1, tails[1])]))
+    assert warm[0].cached_tokens == 24           # 3 shared pages
+    assert cold[0].generated == ref0[0].generated
+    assert warm[0].generated == ref1[0].generated
+    assert sl.batcher.stats["prefix_hit_tokens"] == 24
+    assert sl.loop_stats["chunk_fills"] >= 1     # only the tail was filled
+    import horovod_tpu as hvd
+    stats = hvd.serve_stats()
+    assert stats["prefix_cache"] is True
+    assert stats["prefix_hit_ratio"] > 0
+    assert stats["prefix_nodes"] >= 3
+
+
+class _OracleDrafter:
+    """Drafts the exact reference continuation — pins the accept-side
+    bookkeeping at (near-)full acceptance, no model luck involved."""
+
+    def __init__(self, finished):
+        self._chains = {tuple(r.prompt): list(r.generated)
+                        for r in finished}
+
+    def propose(self, context, k):
+        for prompt, chain in self._chains.items():
+            n = len(prompt)
+            if tuple(context[:n]) == prompt and len(context) >= n:
+                done = len(context) - n
+                return chain[done:done + k]
+        return []
+
+
+def test_spec_decode_bit_identical_to_greedy():
+    """The speculative path emits EXACTLY the plain greedy chain — with
+    the self-drafting NGramDrafter and with a full-acceptance oracle —
+    and the accept/reject counters add up."""
+    cfg = _cfg()
+    geo = kv_cache.geometry(n_pages=16, page_size=8, max_context=64)
+    params = tfm.init_params(jax.random.PRNGKey(5), cfg)
+    prompt = [1, 2, 3, 4] * 3
+
+    def _reqs():
+        return _instant([
+            Request(rid=0, prompt=list(prompt), max_new_tokens=20),
+            Request(rid=1, prompt=list(prompt[2:]), max_new_tokens=16)])
+
+    base = ServeLoop(params, cfg, geo=geo, max_batch=2,
+                     prefix_cache=False, spec_tokens=0)
+    _, ref = base.run(_reqs())
+    ref_chains = {r.rid: list(r.generated) for r in ref}
+
+    spec = ServeLoop(params, cfg, geo=geo, max_batch=2,
+                     prefix_cache=False, spec_tokens=3)
+    summary, got = spec.run(_reqs())
+    assert {r.rid: list(r.generated) for r in got} == ref_chains
+    assert summary["spec_steps"] > 0
+    st = spec.batcher.stats
+    # every spec step emits accepted + 1 bonus; decode-side tokens are
+    # total minus the two prefill-emitted first tokens.
+    assert st["spec_accepted"] + st["spec_steps"] == st["tokens"] - 2
+
+    oracle = ServeLoop(params, cfg, geo=geo, max_batch=2,
+                       prefix_cache=False, spec_tokens=3,
+                       drafter=_OracleDrafter(ref))
+    o_summary, o_got = oracle.run(_reqs())
+    assert {r.rid: list(r.generated) for r in o_got} == ref_chains
+    assert o_summary["spec_accepted_per_step"] > 2.0   # near-full accept
+    assert o_summary["spec_steps"] < summary["spec_steps"] \
+        or summary["spec_accepted_per_step"] == o_summary[
+            "spec_accepted_per_step"]
+
+
+def test_serve_kill_switches_restore_baseline(monkeypatch):
+    """HVD_SERVE_PREFIX_CACHE=0 + spec_tokens=0 is the PR 14 loop: no
+    prefix/spec engine is built and the four new SERVE_* metric families
+    record ZERO activity even with metrics enabled."""
+    from horovod_tpu.observability import metrics as _metrics
+    cfg = _cfg()
+    geo = kv_cache.geometry(n_pages=16, page_size=8, max_context=64)
+    params = tfm.init_params(jax.random.PRNGKey(2), cfg)
+    monkeypatch.setenv("HVD_SERVE_PREFIX_CACHE", "0")
+    monkeypatch.setenv("HVD_SERVE_SPEC_TOKENS", "0")
+    sl = ServeLoop(params, cfg, geo=geo, max_batch=2)   # env-driven
+    assert sl.prefix is None and sl.chunk_fn is None and sl.spec_fn is None
+    _metrics.REGISTRY.clear()
+    monkeypatch.setattr(_metrics, "_enabled", True)
+    try:
+        rng = np.random.default_rng(2)
+        summary, finished = sl.run(_instant(poisson_requests(
+            4, rate=1e6, rng=rng, prompt_len=(2, 6), max_new=(1, 6),
+            vocab=cfg.vocab_size)))
+        assert len(finished) == 4
+        for m in (_metrics.SERVE_PREFIX_HIT_RATIO,
+                  _metrics.SERVE_PREFIX_EVICTIONS,
+                  _metrics.SERVE_SPEC_ACCEPTED_PER_STEP,
+                  _metrics.SERVE_SPEC_REJECTED):
+            assert m.collect() == []                 # zero activity
+        assert _metrics.SERVE_BATCH_FILL.collect()   # baseline recorded
+        assert summary["prefix_hit_ratio"] == 0.0
+        assert summary["spec_steps"] == 0
+        assert summary["chunk_fills"] == 0
+    finally:
+        _metrics.REGISTRY.clear()
+    # the knobs plumb through when set the other way
+    monkeypatch.setenv("HVD_SERVE_PREFIX_CACHE", "1")
+    monkeypatch.setenv("HVD_SERVE_SPEC_TOKENS", "2")
+    sl2 = ServeLoop(params, cfg, geo=geo, max_batch=2)
+    assert sl2.prefix is not None and sl2.spec_tokens == 2
+    assert sl2.chunk_fn is not None and sl2.spec_fn is not None
 
 
 # ---------------------------------------------------------------------------

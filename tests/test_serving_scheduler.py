@@ -34,6 +34,8 @@ def _load(name):
 
 sched = _load("scheduler")
 autoscale = _load("autoscale")
+prefix_cache = _load("prefix_cache")
+speculate = _load("speculate")
 
 
 def _mk(n_pages=32, page_size=4, max_batch=4, mode="continuous"):
@@ -117,13 +119,17 @@ def test_allocator_occupancy():
 
 def test_serve_knobs_defaults(monkeypatch):
     for k in ("HVD_SERVE_PAGE_SIZE", "HVD_SERVE_KV_PAGES",
-              "HVD_SERVE_MAX_BATCH", "HVD_SERVE_MODE"):
+              "HVD_SERVE_MAX_BATCH", "HVD_SERVE_MODE",
+              "HVD_SERVE_PREFIX_CACHE", "HVD_SERVE_SPEC_TOKENS"):
         monkeypatch.delenv(k, raising=False)
     k = sched.serve_knobs()
     assert k == {"page_size": sched.DEFAULT_PAGE_SIZE,
                  "kv_pages": sched.DEFAULT_KV_PAGES,
                  "max_batch": sched.DEFAULT_MAX_BATCH,
-                 "mode": "continuous"}
+                 "mode": "continuous",
+                 "prefix_cache": sched.DEFAULT_PREFIX_CACHE,
+                 "spec_tokens": sched.DEFAULT_SPEC_TOKENS}
+    assert k["prefix_cache"] == 1 and k["spec_tokens"] == 0
 
 
 def test_serve_knobs_env_overrides(monkeypatch):
@@ -131,10 +137,13 @@ def test_serve_knobs_env_overrides(monkeypatch):
     monkeypatch.setenv("HVD_SERVE_KV_PAGES", "512")
     monkeypatch.setenv("HVD_SERVE_MAX_BATCH", "not-a-number")
     monkeypatch.setenv("HVD_SERVE_MODE", "static")
+    monkeypatch.setenv("HVD_SERVE_PREFIX_CACHE", "0")
+    monkeypatch.setenv("HVD_SERVE_SPEC_TOKENS", "4")
     k = sched.serve_knobs()
     assert k["page_size"] == 32 and k["kv_pages"] == 512
     assert k["max_batch"] == sched.DEFAULT_MAX_BATCH  # garbage -> default
     assert k["mode"] == "static"
+    assert k["prefix_cache"] == 0 and k["spec_tokens"] == 4
 
 
 # ---------------------------------------------------------------------------
@@ -311,6 +320,341 @@ def test_mode_validated():
     alloc = sched.PageAllocator(8, 4)
     with pytest.raises(ValueError):
         sched.ContinuousBatcher(alloc, 4, mode="dynamic")
+    with pytest.raises(ValueError):
+        sched.ContinuousBatcher(alloc, 4, spec_tokens=-1)
+
+
+# ---------------------------------------------------------------------------
+# refcounted PageAllocator (ISSUE 16 — copy-on-write sharing)
+# ---------------------------------------------------------------------------
+
+def _conserved_shared(b, cache=None):
+    """The refcounted contract: free + DISTINCT-owned == usable, and
+    every page's refcount equals its holder count (running requests
+    plus at most one prefix-cache reference)."""
+    import collections
+    holders = collections.Counter()
+    for r in b.running.values():
+        for p in r.pages:
+            holders[p] += 1
+    if cache is not None:
+        for p in cache.cached_pages():
+            holders[p] += 1
+    assert 0 not in holders, "trash page 0 held"
+    assert b.alloc.free_pages() + b.alloc.used_pages() \
+        == b.alloc.usable_pages
+    assert b.alloc.used_pages() == len(holders)
+    for p, n in holders.items():
+        assert b.alloc.refcount(p) == n, (p, n, b.alloc.refcount(p))
+
+
+def test_share_bumps_refcount_and_free_decrements():
+    a = sched.PageAllocator(8, 4)
+    pages = a.alloc(2)
+    a.share(pages)
+    assert [a.refcount(p) for p in pages] == [2, 2]
+    assert a.used_pages() == 2           # distinct pages, not references
+    a.free(pages)                        # one holder drops
+    assert [a.refcount(p) for p in pages] == [1, 1]
+    assert a.free_pages() == 5           # nothing returned to the pool yet
+    a.free(pages)                        # last holder drops
+    assert a.free_pages() == 7 and a.used_pages() == 0
+
+
+def test_share_unowned_raises_before_mutation():
+    a = sched.PageAllocator(8, 4)
+    pages = a.alloc(1)
+    with pytest.raises(sched.PageError):
+        a.share(pages + [5])             # 5 was never allocated
+    assert a.refcount(pages[0]) == 1     # the valid page was NOT bumped
+
+
+def test_refcount_underflow_raises_before_mutation():
+    a = sched.PageAllocator(8, 4)
+    (p,) = a.alloc(1)
+    a.share([p])                         # refcount 2
+    with pytest.raises(sched.PageError):
+        a.free([p, p, p])                # 3 drops > 2 refs, atomically
+    assert a.refcount(p) == 2            # untouched — checked BEFORE
+    a.free([p, p])                       # exactly the refcount is fine
+    assert a.refcount(p) == 0 and a.free_pages() == 7
+
+
+def test_cow_fork_free_conservation():
+    """A 'fork' (two holders of one prefix) then both frees, in either
+    order, conserves pages and never double-returns."""
+    a = sched.PageAllocator(10, 4)
+    shared = a.alloc(3)                  # the cached prefix
+    a.share(shared)                      # the forked request's reference
+    own = a.alloc(2)                     # its private suffix pages
+    assert a.used_pages() == 5
+    a.free(shared + own)                 # request exits
+    assert a.used_pages() == 3           # prefix still owned by the cache
+    assert a.free_pages() == 6
+    a.free(shared)                       # cache drops it too
+    assert a.free_pages() == 9 and a.used_pages() == 0
+
+
+# ---------------------------------------------------------------------------
+# PrefixCache (radix tree)
+# ---------------------------------------------------------------------------
+
+def _cache(n_pages=32, page_size=4):
+    a = sched.PageAllocator(n_pages, page_size)
+    return a, prefix_cache.PrefixCache(a)
+
+
+def test_prefix_insert_then_lookup_shares_pages():
+    a, pc = _cache()
+    pages = a.alloc(3)
+    prompt = list(range(10))             # 2 full pages + 2-token tail
+    assert pc.insert(prompt, pages) == 2   # only full pages are cached
+    assert a.refcount(pages[0]) == 2 and a.refcount(pages[2]) == 1
+    hit, n = pc.lookup(prompt)
+    assert hit == pages[:2] and n == 8
+    # lookup takes NO references — sharing is the caller's decision
+    assert a.refcount(pages[0]) == 2
+
+
+def test_prefix_lookup_is_strict():
+    """An exactly-page-aligned prompt must keep >= 1 novel token: the
+    match is capped one page short so the first-token logits always
+    come from a real prefill."""
+    a, pc = _cache(page_size=4)
+    pages = a.alloc(2)
+    pc.insert(list(range(8)), pages)
+    hit, n = pc.lookup(list(range(8)))
+    assert hit == pages[:1] and n == 4   # NOT both pages
+    hit, n = pc.lookup(list(range(9)))
+    assert hit == pages[:2] and n == 8   # one tail token -> full match
+    assert pc.lookup(list(range(3)))[1] == 0   # sub-page prompt: miss
+
+
+def test_prefix_radix_shares_common_nodes():
+    a, pc = _cache(page_size=4)
+    p1 = a.alloc(2)
+    pc.insert(list(range(8)) + [99], p1)
+    # Same first page, different second page -> ONE new node only.
+    p2 = [p1[0]] + a.alloc(1)
+    added = pc.insert(list(range(4)) + [50, 51, 52, 53, 99], p2)
+    assert added == 1
+    assert len(pc) == 3
+    assert a.refcount(p1[0]) == 2        # one cache ref despite two inserts
+
+
+def test_prefix_lru_eviction_order():
+    a, pc = _cache(page_size=4)
+    pa, pb = a.alloc(1), a.alloc(1)
+    pc.insert([1, 1, 1, 1, 9], pa)
+    pc.insert([2, 2, 2, 2, 9], pb)
+    a.free(pa + pb)                      # cache is now the only holder
+    pc.lookup([1, 1, 1, 1, 9])           # touch A — B becomes LRU
+    assert pc.evict(1) == 1
+    assert pc.lookup([2, 2, 2, 2, 9])[1] == 0   # B gone
+    assert pc.lookup([1, 1, 1, 1, 9])[1] == 4   # A survives
+    assert a.refcount(pb[0]) == 0
+
+
+def test_prefix_evict_skips_shared_and_interior_pages():
+    a, pc = _cache(page_size=4)
+    pages = a.alloc(2)
+    pc.insert(list(range(8)) + [9], pages)   # chain: interior -> leaf
+    # A live request still shares the LEAF page: nothing is evictable
+    # (the interior page is protected by its child).
+    assert pc.evict(5) == 0
+    a.free([pages[0]])                   # request drops the interior page
+    assert pc.evict(5) == 0              # leaf still shared by request
+    a.free([pages[1]])                   # request exits fully
+    assert pc.evict(5) == 2              # leaf first, then the exposed parent
+    assert len(pc) == 0
+    assert a.free_pages() == a.usable_pages
+
+
+# ---------------------------------------------------------------------------
+# batcher x prefix cache (COW admission / preemption / reclaim)
+# ---------------------------------------------------------------------------
+
+def _mk_cached(n_pages=32, page_size=4, max_batch=4, spec_tokens=0):
+    a = sched.PageAllocator(n_pages, page_size)
+    pc = prefix_cache.PrefixCache(a)
+    b = sched.ContinuousBatcher(a, max_batch, "continuous",
+                                prefix_cache=pc, spec_tokens=spec_tokens)
+    return a, pc, b
+
+
+def _preq(rid, prompt, max_new=8, eos=-1):
+    return sched.Request(rid=rid, prompt=list(prompt),
+                         max_new_tokens=max_new, eos_id=eos)
+
+
+def test_admission_shares_cached_prefix():
+    a, pc, b = _mk_cached()
+    b.submit(_preq(0, range(9)))
+    b.admit()
+    first = b.running[0]
+    assert first.cached_tokens == 0      # cold cache: full miss
+    b.register_prefilled(first)          # prompt pages published
+    shared_pages = first.pages[:2]
+    b.on_tokens({0: 99}, 0.0)
+    _conserved_shared(b, pc)
+    b.submit(_preq(1, range(9)))         # identical prompt
+    b.admit()
+    second = b.running[1]
+    assert second.cached_tokens == 8
+    assert second.pages[:2] == shared_pages    # the SAME physical pages
+    assert a.refcount(shared_pages[0]) == 3    # req0 + req1 + cache
+    assert b.stats["prefix_hit_tokens"] == 8
+    assert b.prefix_hit_ratio() == pytest.approx(8 / 18)
+    _conserved_shared(b, pc)
+
+
+def test_preemption_of_request_holding_shared_pages():
+    a, pc, b = _mk_cached(n_pages=32, page_size=2)
+    b.submit(_preq(0, range(5), max_new=16))
+    b.admit()
+    b.register_prefilled(b.running[0])
+    b.submit(_preq(1, range(5), max_new=16))
+    b.on_tokens({0: 7}, 0.0)             # admits rid 1 with a prefix hit
+    second = b.running[1]
+    assert second.cached_tokens == 4
+    shared = list(second.pages[:2])
+    assert a.refcount(shared[0]) == 3    # rid0 + rid1 + cache
+    b._preempt(second, 0.0)
+    # One reference dropped per shared page; the other holders survive.
+    assert second.pages == [] and second.cached_tokens == 0
+    assert b.waiting[0] is second        # preempted -> FRONT of the queue
+    assert a.refcount(shared[0]) == 2
+    _conserved_shared(b, pc)
+    b.admit()                            # readmits, re-hitting the cache
+    assert second.state == "running"
+    assert second.cached_tokens == 4     # re-resolved at readmission
+    assert a.refcount(shared[0]) == 3
+    _conserved_shared(b, pc)
+
+
+def test_page_pressure_evicts_cold_prefixes_before_preempting():
+    a, pc, b = _mk_cached(n_pages=8, page_size=2, max_batch=2)
+    b.submit(_preq(0, range(4), max_new=2))
+    b.admit()
+    b.register_prefilled(b.running[0])
+    cached = list(b.running[0].pages[:2])
+    b.on_tokens({0: 9}, 0.0)
+    b.on_tokens({0: 9}, 0.0)             # rid 0 finishes (max_new=2)
+    assert not b.running
+    assert a.used_pages() == 2           # only the cached prefix remains
+    # A fat unrelated request needs more than the free pool: the cold
+    # cached prefix is LRU-evicted to make room instead of stalling.
+    b.submit(_preq(1, list(range(50, 61)), max_new=4))
+    b.admit()
+    assert 0 in b.running and b.running[0].rid == 1
+    assert pc.stats["evictions"] >= 1
+    assert cached[1] not in pc.cached_pages()   # evicted leaf left the tree
+    _conserved_shared(b, pc)
+
+
+def test_grow_reserves_spec_lookahead():
+    a = sched.PageAllocator(32, 2)
+    bs = sched.ContinuousBatcher(a, 4, "continuous", spec_tokens=3)
+    bs.submit(_req(0, prompt_len=2, max_new=16))
+    bs.admit()
+    # context 2 + lookahead (1 + 3 drafts) = 6 positions -> 3 pages.
+    assert len(bs.running[0].pages) == 3
+    bs.on_tokens({0: 5}, 0.0)            # context 3, window to 7 -> 4 pages
+    assert len(bs.running[0].pages) == 4
+
+
+def test_on_tokens_list_truncates_at_finish():
+    _, b = _mk()
+    b.submit(_req(0, prompt_len=2, max_new=8, eos=42))
+    b.admit()
+    done = b.on_tokens({0: [1, 2, 42, 3, 4]}, 0.0)   # EOS mid-burst
+    assert len(done) == 1 and done[0].finish_reason == "eos"
+    assert done[0].generated == [1, 2, 42]           # trailing drafts dropped
+    assert b.stats["tokens"] == 3
+    b.submit(_req(1, prompt_len=2, max_new=2))
+    b.admit()
+    done = b.on_tokens({0: [7, 8, 9]}, 0.0)
+    assert done[0].finish_reason == "max_tokens"
+    assert done[0].generated == [7, 8]               # capped at max_new
+
+
+# ---------------------------------------------------------------------------
+# speculate (accept/reject arithmetic)
+# ---------------------------------------------------------------------------
+
+def test_accept_drafts_prefix_rule():
+    em, acc, rej = speculate.accept_drafts([3, 4, 1], [3, 4, 9, 7])
+    assert (em, acc, rej) == ([3, 4, 9], 2, 1)   # 2 accepted + bonus
+    em, acc, rej = speculate.accept_drafts([5, 6], [7, 8, 9])
+    assert (em, acc, rej) == ([7], 0, 2)         # full reject still emits 1
+    em, acc, rej = speculate.accept_drafts([1, 2], [1, 2, 3])
+    assert (em, acc, rej) == ([1, 2, 3], 2, 0)   # clean sweep: k+1 tokens
+    with pytest.raises(ValueError):
+        speculate.accept_drafts([1, 2], [1, 2])  # k+1 positions required
+
+
+def test_ngram_drafter_prefers_full_continuations():
+    d = speculate.NGramDrafter(2)
+    ctx = [1, 2, 3, 4, 1, 2, 3, 4, 1, 2]
+    # The trailing (1, 2) also matches at the END (truncated): the
+    # earlier FULL continuation must win.
+    assert d.propose(ctx, 3) == [3, 4, 1]
+    assert d.propose(ctx, 8) == [3, 4, 1, 2, 3, 4, 1, 2]
+    assert d.propose([9, 9], 4) == []            # no earlier occurrence
+    assert d.propose(ctx, 0) == []
+    with pytest.raises(ValueError):
+        speculate.NGramDrafter(0)
+
+
+def test_fixed_drafter_truncates():
+    d = speculate.FixedDrafter([5, 6, 7])
+    assert d.propose([1, 2], 2) == [5, 6]
+
+
+# ---------------------------------------------------------------------------
+# fuzz: shared prefixes + speculation bursts against conservation
+# ---------------------------------------------------------------------------
+
+def test_no_double_free_with_shared_prefixes_over_random_workload():
+    """The ISSUE-16 extension of the lifecycle fuzz: prompts drawn from
+    a handful of shared templates (so admissions constantly fork cached
+    prefix pages), multi-token speculative bursts at boundaries, and
+    periodic cache eviction pressure — the refcounted conservation
+    invariant must hold at every step."""
+    import numpy as np
+    rng = np.random.default_rng(16)
+    a, pc, b = _mk_cached(n_pages=14, page_size=2, max_batch=3,
+                          spec_tokens=2)
+    templates = [list(rng.integers(0, 50, size=6)) for _ in range(3)]
+    for i in range(40):
+        t = templates[int(rng.integers(0, 3))]
+        tail = [int(x) for x in
+                rng.integers(50, 99, size=int(rng.integers(1, 4)))]
+        b.submit(sched.Request(rid=i, prompt=list(t) + tail,
+                               max_new_tokens=int(rng.integers(1, 9))))
+    b.admit()
+    steps = 0
+    prefill_seen = set()
+    while not b.idle():
+        # Publish "prefilled" prompts like the serve loop would.
+        for r in list(b.running.values()):
+            key = (r.rid, r.admit_seq)
+            if key not in prefill_seen:
+                prefill_seen.add(key)
+                b.register_prefilled(r)
+        burst = {s: [int(x) for x in
+                     rng.integers(0, 9, size=int(rng.integers(1, 4)))]
+                 for s in list(b.running)}
+        b.on_tokens(burst, 0.0)
+        _conserved_shared(b, pc)
+        steps += 1
+        assert steps < 2000, "scheduler wedged"
+    assert len(b.done) == 40
+    # Every page still owned is owned by the cache alone.
+    for p in pc.cached_pages():
+        assert a.refcount(p) == 1
+    pc.evict(a.usable_pages)
+    assert a.used_pages() == 0 and a.free_pages() == a.usable_pages
 
 
 # ---------------------------------------------------------------------------
