@@ -142,6 +142,7 @@ start_timeline = _basics.start_timeline
 stop_timeline = _basics.stop_timeline
 cache_stats = _basics.cache_stats
 autotune_state = _basics.autotune_state
+autotune_stats = _basics.autotune_stats
 zerocopy_stats = _basics.zerocopy_stats
 zerocopy_state = _basics.zerocopy_state
 reduce_stats = _basics.reduce_stats

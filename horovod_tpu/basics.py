@@ -163,6 +163,22 @@ _lib.hvd_backend_uses.restype = c_int64
 _lib.hvd_backend_uses.argtypes = [c_char_p]
 _lib.hvd_autotune_state.restype = c_int
 _lib.hvd_autotune_state.argtypes = [P_int64, ctypes.POINTER(c_double)]
+_lib.hvd_autotune_stats.restype = c_int
+_lib.hvd_autotune_stats.argtypes = [P_int64]
+_lib.hvd_autotune_sim_begin.restype = c_int
+_lib.hvd_autotune_sim_begin.argtypes = [c_int, c_int64, c_int, c_char_p,
+                                        c_int64, c_int64]
+_lib.hvd_autotune_sim_arm.restype = c_int
+_lib.hvd_autotune_sim_arm.argtypes = []
+_lib.hvd_autotune_sim_step.restype = c_int
+_lib.hvd_autotune_sim_step.argtypes = [c_double]
+_lib.hvd_autotune_sim_stats.restype = c_int
+_lib.hvd_autotune_sim_stats.argtypes = [P_int64]
+_lib.hvd_autotune_sim_result.restype = c_int
+_lib.hvd_autotune_sim_result.argtypes = [ctypes.POINTER(c_int), P_int64,
+                                         ctypes.POINTER(c_double)]
+_lib.hvd_autotune_sim_end.restype = c_int
+_lib.hvd_autotune_sim_end.argtypes = []
 _lib.hvd_zerocopy_stats.restype = c_int
 _lib.hvd_zerocopy_stats.argtypes = [P_int64, P_int64, P_int64, P_int64]
 _lib.hvd_zerocopy_state.restype = c_int
@@ -330,6 +346,34 @@ class HorovodBasics:
             raise ValueError("horovod_tpu has not been initialized")
         status = {0: "off", 1: "searching", 2: "locked"}[rc]
         return status, fusion.value, cycle.value
+
+    def autotune_stats(self):
+        """Bandit search progress (docs/autotune.md "v2 search"): dict with
+        status ('off'|'searching'|'locked'), samples spent vs budget, the
+        lattice size (dims/arms), bracket size + halving round + live
+        survivors, and the profile-adoption ladder outcome
+        ('-'|'fresh'|'near'|'adopted'|'corrupt') plus the prior_seeded /
+        adopted_profile flags. The search runs on the coordinator; other
+        ranks report zeros with the broadcast status."""
+        out = (c_int64 * 10)()
+        rc = _lib.hvd_autotune_stats(out)
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        profile = {0: "-", 1: "fresh", 2: "near", 3: "adopted",
+                   4: "corrupt"}.get(int(out[7]), "?")
+        return {
+            "status": {0: "off", 1: "searching", 2: "locked"}[rc],
+            "samples": int(out[0]),
+            "budget": int(out[1]),
+            "dims": int(out[2]),
+            "arms": int(out[3]),
+            "bracket": int(out[4]),
+            "round": int(out[5]),
+            "survivors": int(out[6]),
+            "profile": profile,
+            "prior_seeded": bool(out[8]),
+            "adopted_profile": bool(out[9]),
+        }
 
     def zerocopy_stats(self):
         """(zerocopy_ops, zerocopy_bytes, staging_ops, staging_bytes) for
@@ -717,6 +761,75 @@ def _check_init(v):
             "horovod_tpu has not been initialized; call horovod_tpu.init() first"
         )
     return v
+
+
+class AutotuneSim:
+    """Drive the REAL in-core bandit search policy on a caller-supplied
+    synthetic score surface with a fake clock — no pod, no init() needed.
+    One window == one sample. Used by tests/test_autotune_v2.py and
+    `bench.py autotune` to measure samples-to-within-5%-of-exhaustive-best
+    and the profile save/adopt round-trip against an exhaustive 2^d
+    enumeration that a live sweep could never afford.
+
+    Process-global (one live sim per process): begin() resets it.
+    """
+
+    def __init__(self, n_dims, max_samples=0, bracket=0, profile_dir="",
+                 workload_id=1, world=1):
+        rc = _lib.hvd_autotune_sim_begin(
+            int(n_dims), int(max_samples), int(bracket),
+            str(profile_dir).encode(), int(workload_id), int(world))
+        if rc != 0:
+            raise ValueError(f"autotune sim rejected n_dims={n_dims}")
+
+    @property
+    def arm(self):
+        """Arm bits whose score the next step() should report (bit i set ==
+        dim i flipped on; sim initial config is all-off)."""
+        return _lib.hvd_autotune_sim_arm()
+
+    def step(self, score):
+        """Feed one window's score for the current arm. True == locked."""
+        return _lib.hvd_autotune_sim_step(c_double(float(score))) == 1
+
+    def run(self, surface, max_steps=10000):
+        """Step the search on score function surface(arm_bits) until it
+        locks; returns the locked arm bits."""
+        for _ in range(max_steps):
+            if self.step(surface(self.arm)):
+                break
+        return self.arm
+
+    def stats(self):
+        out = (c_int64 * 10)()
+        if _lib.hvd_autotune_sim_stats(out) != 0:
+            raise ValueError("autotune sim not begun")
+        profile = {0: "-", 1: "fresh", 2: "near", 3: "adopted",
+                   4: "corrupt"}.get(int(out[7]), "?")
+        return {
+            "samples": int(out[0]),
+            "budget": int(out[1]),
+            "dims": int(out[2]),
+            "arms": int(out[3]),
+            "bracket": int(out[4]),
+            "round": int(out[5]),
+            "survivors": int(out[6]),
+            "profile": profile,
+            "prior_seeded": bool(out[8]),
+            "adopted_profile": bool(out[9]),
+        }
+
+    def result(self):
+        """(locked, arm_bits, fusion_bytes, cycle_ms) for the search."""
+        arm = c_int(0)
+        fusion = c_int64(0)
+        cycle = c_double(0.0)
+        rc = _lib.hvd_autotune_sim_result(
+            ctypes.byref(arm), ctypes.byref(fusion), ctypes.byref(cycle))
+        return rc == 1, arm.value, fusion.value, cycle.value
+
+    def close(self):
+        _lib.hvd_autotune_sim_end()
 
 
 basics = HorovodBasics()

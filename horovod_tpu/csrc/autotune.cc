@@ -1,10 +1,15 @@
-// autotune.cc — GP + expected-improvement parameter search (see autotune.h).
+// autotune.cc — bandit arm search + GP numeric tuning (see autotune.h).
 #include "autotune.h"
+
+#include <dirent.h>
+#include <sys/stat.h>
+#include <unistd.h>
 
 #include <algorithm>
 #include <array>
 #include <cmath>
 #include <cstring>
+#include <numeric>
 
 namespace hvd {
 namespace {
@@ -23,112 +28,217 @@ double NormCdf(double z) { return 0.5 * erfc(-z / sqrt(2.0)); }
 double NormPdf(double z) { return exp(-0.5 * z * z) / sqrt(2.0 * M_PI); }
 
 // Warmup grid: corners + center + edge midpoints of the log-space square,
-// visited before the GP takes over (reference: categorical warmup passes).
+// visited before the GP takes over. warmup[0] is also the pinned numeric
+// point every categorical window (probe + halving) is measured at, so arm
+// scores stay comparable.
 const double kWarmup[][2] = {
     {0.5, 0.5}, {0.15, 0.15}, {0.85, 0.15}, {0.15, 0.85},
     {0.85, 0.85}, {0.5, 0.15}, {0.5, 0.85},
 };
 constexpr int kNumWarmup = sizeof(kWarmup) / sizeof(kWarmup[0]);
 
+// Numeric-tail budget reserved past the categorical phases when the total
+// is derived from the arm count (warmup grid + a few EI proposals).
+constexpr int kNumericTail = 12;
+
+// Largest power of two <= v (0 when v < 2).
+int Pow2Floor(int v) {
+  int p = 0;
+  for (int b = 2; b <= v; b <<= 1) p = b;
+  return p;
+}
+
+uint64_t Fnv1a(const void* p, size_t n,
+               uint64_t h = 1469598103934665603ull) {
+  const uint8_t* b = (const uint8_t*)p;
+  for (size_t i = 0; i < n; i++) {
+    h ^= b[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+// Parsed profile file (see WriteProfile for the format).
+struct TuningProfile {
+  int64_t world = 0, local_size = 0;
+  int wire_tier = 0;
+  uint32_t dims_mask = 0;
+  uint64_t tensors = 0;
+  uint32_t arm_vals = 0;  // absolute categorical values, bit = AutotuneDim
+  int64_t fusion = 0;
+  double cycle_ms = 0.0;
+  double score = 0.0;
+};
+
+// 0 ok, -1 missing/unreadable, -2 torn or corrupt (bad CRC / parse / header).
+int LoadProfile(const std::string& path, TuningProfile* p) {
+  FILE* f = fopen(path.c_str(), "r");
+  if (!f) return -1;
+  char buf[2048];
+  size_t n = fread(buf, 1, sizeof(buf) - 1, f);
+  fclose(f);
+  buf[n] = 0;
+  // The CRC line covers every byte before it; a torn write (crash between
+  // fwrite and rename never happens — the writer is atomic — but a partial
+  // copy or hand edit does) fails here.
+  const char* crc_line = strstr(buf, "\ncrc ");
+  if (!crc_line) return -2;
+  size_t body_len = (size_t)(crc_line - buf) + 1;  // include the '\n'
+  unsigned long long want = 0;
+  if (sscanf(crc_line + 1, "crc %llx", &want) != 1) return -2;
+  if (Fnv1a(buf, body_len) != (uint64_t)want) return -2;
+  if (strncmp(buf, "hvd-autotune-profile v2\n", 24) != 0) return -2;
+  long long world = 0, local = 0, fusion = 0;
+  int wire = 0;
+  unsigned dims = 0, arm_vals = 0;
+  unsigned long long tensors = 0;
+  double cycle = 0.0, score = 0.0;
+  if (sscanf(buf + 24,
+             "world %lld\nlocal %lld\nwire %d\ndims %x\ntensors %llx\n"
+             "arm_vals %x\nfusion %lld\ncycle_ms %lf\nscore_mbps %lf",
+             &world, &local, &wire, &dims, &tensors, &arm_vals, &fusion,
+             &cycle, &score) != 9)
+    return -2;
+  if (fusion <= 0 || cycle <= 0.0) return -2;
+  p->world = world;
+  p->local_size = local;
+  p->wire_tier = wire;
+  p->dims_mask = dims;
+  p->tensors = tensors;
+  p->arm_vals = arm_vals;
+  p->fusion = fusion;
+  p->cycle_ms = cycle;
+  p->score = score;
+  return 0;
+}
+
 }  // namespace
 
-void ParameterManager::Configure(bool enabled, const std::string& log_path,
-                                 int64_t init_fusion, double init_cycle_ms,
-                                 int64_t cycles_per_sample,
-                                 int64_t max_samples, bool init_cache,
-                                 bool init_hier, bool init_zerocopy,
-                                 bool init_pipeline, bool init_shm,
-                                 bool init_bucket, bool init_compress,
-                                 bool init_wire, bool can_toggle_cache,
-                                 bool can_toggle_hier,
-                                 bool can_toggle_zerocopy,
-                                 bool can_toggle_pipeline,
-                                 bool can_toggle_shm,
-                                 bool can_toggle_bucket,
-                                 bool can_toggle_compress,
-                                 bool can_toggle_wire,
-                                 const std::string& affinity) {
-  enabled_ = enabled;
-  affinity_ = affinity.empty() ? "?" : affinity;
+void ParameterManager::Configure(const AutotuneConfig& cfg) {
+  enabled_ = cfg.enabled;
+  affinity_ = cfg.affinity.empty() ? "?" : cfg.affinity;
   if (!enabled_) return;
-  cycles_per_sample_ = cycles_per_sample;
-  max_samples_ = max_samples;
-  best_fusion_ = init_fusion;
-  best_cycle_ms_ = init_cycle_ms;
-  // Arm order: the job's initial configuration first (the baseline every
-  // later score competes against), then the other combinations — but only
-  // over dims that can actually take effect (a capacity-0 cache, a
-  // non-uniform topology, HVD_ZEROCOPY=0, a single-member ring, or a wire
-  // probe that landed on basic makes that toggle a no-op; sweeping it
-  // would burn windows measuring a config that never engaged).
-  int n = 0;
-  for (int c = 0; c < (can_toggle_cache ? 2 : 1); c++) {
-    for (int h = 0; h < (can_toggle_hier ? 2 : 1); h++) {
-      for (int z = 0; z < (can_toggle_zerocopy ? 2 : 1); z++) {
-        for (int pl = 0; pl < (can_toggle_pipeline ? 2 : 1); pl++) {
-          for (int sh = 0; sh < (can_toggle_shm ? 2 : 1); sh++) {
-            for (int bk = 0; bk < (can_toggle_bucket ? 2 : 1); bk++) {
-              for (int cp = 0; cp < (can_toggle_compress ? 2 : 1); cp++) {
-                for (int w = 0; w < (can_toggle_wire ? 2 : 1); w++) {
-                  arm_cache_[n] = can_toggle_cache
-                                      ? (c == 0 ? init_cache : !init_cache)
-                                      : init_cache;
-                  arm_hier_[n] = can_toggle_hier
-                                     ? (h == 0 ? init_hier : !init_hier)
-                                     : init_hier;
-                  arm_zerocopy_[n] =
-                      can_toggle_zerocopy
-                          ? (z == 0 ? init_zerocopy : !init_zerocopy)
-                          : init_zerocopy;
-                  arm_pipeline_[n] =
-                      can_toggle_pipeline
-                          ? (pl == 0 ? init_pipeline : !init_pipeline)
-                          : init_pipeline;
-                  arm_shm_[n] = can_toggle_shm
-                                    ? (sh == 0 ? init_shm : !init_shm)
-                                    : init_shm;
-                  arm_bucket_[n] =
-                      can_toggle_bucket
-                          ? (bk == 0 ? init_bucket : !init_bucket)
-                          : init_bucket;
-                  arm_compress_[n] =
-                      can_toggle_compress
-                          ? (cp == 0 ? init_compress : !init_compress)
-                          : init_compress;
-                  arm_wire_[n] = can_toggle_wire
-                                     ? (w == 0 ? init_wire : !init_wire)
-                                     : init_wire;
-                  n++;
-                }
-              }
-            }
-          }
-        }
-      }
+  cycles_per_sample_ = cfg.cycles_per_sample;
+  window_cycles_ = cycles_per_sample_;
+  best_fusion_ = cfg.init_fusion;
+  best_cycle_ms_ = cfg.init_cycle_ms;
+  bracket_cfg_ = cfg.bracket;
+  profile_dir_ = cfg.profile_dir;
+  world_ = cfg.world;
+  local_size_ = cfg.local_size;
+  wire_tier_ = cfg.wire_tier;
+  profile_status_ = profile_dir_.empty() ? kProfileOff : kProfileFresh;
+
+  // The lattice: only dims that can actually take effect become bits (a
+  // capacity-0 cache, a non-uniform topology, HVD_ZEROCOPY=0, a
+  // single-member ring, or a wire probe that landed on basic makes that
+  // toggle a no-op; sweeping it would burn windows measuring a config that
+  // never engaged). Bit order == CSV column order.
+  const bool init_vals[kNumAutotuneDims] = {
+      cfg.init_cache,  cfg.init_hier,   cfg.init_zerocopy,
+      cfg.init_pipeline, cfg.init_shm,  cfg.init_bucket,
+      cfg.init_compress, cfg.init_wire};
+  const bool togg[kNumAutotuneDims] = {
+      cfg.can_toggle_cache,  cfg.can_toggle_hier,
+      cfg.can_toggle_zerocopy, cfg.can_toggle_pipeline,
+      cfg.can_toggle_shm,    cfg.can_toggle_bucket,
+      cfg.can_toggle_compress, cfg.can_toggle_wire};
+  dim_count_ = 0;
+  dims_mask_ = 0;
+  for (int d = 0; d < kNumAutotuneDims; d++) {
+    init_val_[d] = init_vals[d];
+    toggleable_[d] = togg[d];
+    if (togg[d]) {
+      dim_id_[dim_count_++] = d;
+      dims_mask_ |= 1u << d;
     }
   }
-  arm_count_ = n;
-  cur_cache_ = init_cache;
-  cur_hier_ = init_hier;
-  cur_zerocopy_ = init_zerocopy;
-  cur_pipeline_ = init_pipeline;
-  cur_shm_ = init_shm;
-  cur_bucket_ = init_bucket;
-  cur_compress_ = init_compress;
-  cur_wire_ = init_wire;
-  // With fewer than arms+warmup samples budgeted (or nothing to sweep),
-  // skip the arm phase and tune numerics only under the initial config.
-  if (arm_count_ < 2 || max_samples_ < arm_count_ + 3) arm_idx_ = arm_count_;
-  if (!log_path.empty()) {
-    log_ = fopen(log_path.c_str(), "w");
+  arm_count_ = 1 << dim_count_;  // <= kMaxArms (2^8)
+  cur_arm_ = 0;
+
+  // Budget + bracket. With HVD_AUTOTUNE_MAX_SAMPLES unset/0 the budget
+  // derives from the arm count: (d+1) probes + (2B-2) halving windows +
+  // a numeric tail — sublinear in the 2^d lattice. An explicit budget
+  // instead sizes the bracket to whatever fits after probes + a minimal
+  // numeric phase.
+  int d = dim_count_;
+  if (cfg.max_samples <= 0) {
+    int want = bracket_cfg_ > 0 ? bracket_cfg_ : 16;
+    bracket0_ = Pow2Floor(std::min(want, arm_count_));
+    max_samples_ =
+        (d + 1) + (bracket0_ >= 2 ? 2 * bracket0_ - 2 : 0) + kNumericTail;
+  } else {
+    max_samples_ = cfg.max_samples;
+    bracket0_ = 0;
+    for (int b = 2; b <= arm_count_; b <<= 1) {
+      if (bracket_cfg_ > 0 && b > bracket_cfg_) break;
+      if ((d + 1) + (2 * b - 2) + 3 <= max_samples_) bracket0_ = b;
+    }
+  }
+  // With nothing to sweep (or a budget too small for even the probes plus
+  // a minimal numeric phase) skip the categorical phases and tune numerics
+  // only under the initial config.
+  phase_ = (d < 1 || max_samples_ < d + 4) ? kNumeric : kProbe;
+  probe_idx_ = 0;
+
+  if (!cfg.log_path.empty()) {
+    log_ = fopen(cfg.log_path.c_str(), "w");
     if (log_)
-      fprintf(
-          log_,
-          "sample,fusion_kb,cycle_ms,cache,hier,zerocopy,pipeline,shm,"
-          "bucket,compress,wire,affinity,schedule,score_mbps\n");
+      // One schema, three consumers: this header, the autotune_worker
+      // assertions, and the hvdlint arm-stats rule all resolve to
+      // horovod_tpu/observability/autotune_csv.py. Keep them identical.
+      fprintf(log_,
+              "sample,fusion_kb,cycle_ms,cache,hier,zerocopy,pipeline,shm,"
+              "bucket,compress,wire,affinity,schedule,bracket,profile,"
+              "score_mbps\n");
   }
   // First sample point = warmup[0]; adopted on the first Record proposal.
   memcpy(cur_x_, kWarmup[0], sizeof(cur_x_));
+}
+
+bool ParameterManager::ArmValue(int arm_bits, int dim_id) const {
+  if (!toggleable_[dim_id]) return init_val_[dim_id];
+  for (int i = 0; i < dim_count_; i++)
+    if (dim_id_[i] == dim_id)
+      return ((arm_bits >> i) & 1) ? !init_val_[dim_id] : init_val_[dim_id];
+  return init_val_[dim_id];
+}
+
+void ParameterManager::AdoptArm(int arm_bits) { cur_arm_ = arm_bits; }
+
+double ParameterManager::ArmPrior(int arm_bits) const {
+  // Multiplicative extrapolation from the single-toggle probes: each
+  // flipped dim contributes its probe's speedup ratio over the baseline.
+  double base = std::max(probe_score_[0], 1e-9);
+  double prior = base;
+  for (int i = 0; i < dim_count_; i++)
+    if ((arm_bits >> i) & 1)
+      prior *= std::max(probe_score_[i + 1], 1e-9) / base;
+  return prior;
+}
+
+void ParameterManager::BuildBracket() {
+  if (bracket0_ < 2) return;  // halving doesn't fit the budget
+  std::vector<int> order(arm_count_);
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(), [this](int a, int b) {
+    return ArmPrior(a) > ArmPrior(b);
+  });
+  int take = std::min(bracket0_, arm_count_);
+  survivors_.assign(order.begin(), order.begin() + take);
+  // A near-miss profile's arm leads the bracket: same topology, different
+  // tensor digest — likely still strong here.
+  if (seed_arm_ >= 0 && seed_arm_ < arm_count_) {
+    survivors_.erase(
+        std::remove(survivors_.begin(), survivors_.end(), seed_arm_),
+        survivors_.end());
+    survivors_.insert(survivors_.begin(), seed_arm_);
+    survivors_.resize(take);
+  }
+  round_ = 0;
+  round_pos_ = 0;
+  round_scores_.assign(survivors_.size(), 0.0);
+  window_cycles_ = cycles_per_sample_;
 }
 
 void ParameterManager::ToParams(const double x[2], int64_t* fusion,
@@ -230,6 +340,209 @@ void ParameterManager::Propose(double out[2]) {
   }
 }
 
+// ---------------------------------------------------------------------------
+// Workload signature + persisted profiles.
+
+void ParameterManager::ObserveTensor(uint64_t h) {
+  if (sig_done_ || sig_tensors_.size() >= 65536) return;
+  sig_tensors_.insert(h);
+}
+
+void ParameterManager::FinalizeSignature() {
+  // Order-independent digest over the deduped tensor set: std::set
+  // iterates sorted, so identical workloads hash identically regardless
+  // of negotiation order.
+  uint64_t h = Fnv1a("hvdtune", 7);
+  uint64_t count = sig_tensors_.size();
+  h = Fnv1a(&count, sizeof(count), h);
+  for (uint64_t t : sig_tensors_) h = Fnv1a(&t, sizeof(t), h);
+  sig_digest_ = h;
+  sig_done_ = true;
+}
+
+std::string ParameterManager::ProfileFileName(uint64_t digest) const {
+  char buf[160];
+  snprintf(buf, sizeof(buf),
+           "hvdtune-w%lld-l%lld-t%d-d%02x-%016llx.profile",
+           (long long)world_, (long long)local_size_, wire_tier_,
+           dims_mask_, (unsigned long long)digest);
+  return profile_dir_ + "/" + buf;
+}
+
+bool ParameterManager::TryAdoptOrSeedProfile() {
+  if (profile_dir_.empty()) return false;  // kill switch: no fs access
+  TuningProfile p;
+  std::string exact = ProfileFileName(sig_digest_);
+  int rc = LoadProfile(exact, &p);
+  if (rc == 0 && p.world == world_ && p.local_size == local_size_ &&
+      p.wire_tier == wire_tier_ && p.dims_mask == dims_mask_) {
+    // Exact signature: adopt the tuned arm + numerics with 0 sweep
+    // samples. Translate the profile's absolute values into arm bits
+    // relative to THIS job's initial config (only toggleable dims move).
+    int bits = 0;
+    for (int i = 0; i < dim_count_; i++) {
+      int d = dim_id_[i];
+      bool want = (p.arm_vals >> d) & 1;
+      if (want != init_val_[d]) bits |= 1 << i;
+    }
+    AdoptArm(bits);
+    best_fusion_ = p.fusion;
+    best_cycle_ms_ = p.cycle_ms;
+    best_score_ = p.score * 1e6;
+    profile_status_ = kProfileAdopted;
+    adopted_profile_ = true;
+    return true;
+  }
+  if (rc == 0 || rc == -2) {
+    // A file with the exact name but a bad CRC, parse failure, or header
+    // that contradicts its own name: corrupt — fresh search, counted.
+    profile_status_ = kProfileCorrupt;
+    return false;
+  }
+  // Near miss: same topology prefix (world/local/wire/dims), different
+  // tensor digest. Its arm seeds the bracket priors; its numerics seed
+  // the GP start point once that arm wins.
+  char prefix[128];
+  snprintf(prefix, sizeof(prefix), "hvdtune-w%lld-l%lld-t%d-d%02x-",
+           (long long)world_, (long long)local_size_, wire_tier_,
+           dims_mask_);
+  DIR* dir = opendir(profile_dir_.c_str());
+  if (!dir) return false;
+  bool found = false;
+  struct dirent* e;
+  while (!found && (e = readdir(dir)) != nullptr) {
+    const char* name = e->d_name;
+    size_t len = strlen(name);
+    if (len < 9 || strcmp(name + len - 8, ".profile") != 0) continue;
+    if (strncmp(name, prefix, strlen(prefix)) != 0) continue;
+    if (LoadProfile(profile_dir_ + "/" + name, &p) != 0) continue;
+    if (p.world != world_ || p.local_size != local_size_ ||
+        p.wire_tier != wire_tier_ || p.dims_mask != dims_mask_)
+      continue;
+    int bits = 0;
+    for (int i = 0; i < dim_count_; i++) {
+      int d = dim_id_[i];
+      if (((p.arm_vals >> d) & 1) != (init_val_[d] ? 1 : 0)) bits |= 1 << i;
+    }
+    seed_arm_ = bits;
+    seed_fusion_ = p.fusion;
+    seed_cycle_ms_ = p.cycle_ms;
+    profile_status_ = kProfileNear;
+    prior_seeded_ = true;
+    found = true;
+  }
+  closedir(dir);
+  return false;
+}
+
+void ParameterManager::WriteProfile() const {
+  if (profile_dir_.empty() || !sig_done_) return;
+  uint32_t arm_vals = 0;
+  for (int d = 0; d < kNumAutotuneDims; d++)
+    if (ArmValue(cur_arm_, d)) arm_vals |= 1u << d;
+  char body[1024];
+  int n = snprintf(body, sizeof(body),
+                   "hvd-autotune-profile v2\n"
+                   "world %lld\nlocal %lld\nwire %d\ndims %02x\n"
+                   "tensors %016llx\narm_vals %02x\nfusion %lld\n"
+                   "cycle_ms %.6f\nscore_mbps %.3f\n",
+                   (long long)world_, (long long)local_size_, wire_tier_,
+                   dims_mask_, (unsigned long long)sig_digest_, arm_vals,
+                   (long long)best_fusion_, best_cycle_ms_,
+                   best_score_ / 1e6);
+  if (n <= 0 || n >= (int)sizeof(body)) return;
+  std::string path = ProfileFileName(sig_digest_);
+  // Atomic publish: readers either see the whole CRC'd file or nothing.
+  char tmp[32];
+  snprintf(tmp, sizeof(tmp), ".tmp.%d", (int)getpid());
+  std::string tmp_path = path + tmp;
+  FILE* f = fopen(tmp_path.c_str(), "w");
+  if (!f) return;
+  fwrite(body, 1, (size_t)n, f);
+  fprintf(f, "crc %016llx\n", (unsigned long long)Fnv1a(body, (size_t)n));
+  fclose(f);
+  if (rename(tmp_path.c_str(), path.c_str()) != 0) unlink(tmp_path.c_str());
+}
+
+// ---------------------------------------------------------------------------
+
+void ParameterManager::FillOutputs(int64_t* fusion, double* cycle_ms,
+                                   int* cache_on, int* hier_on,
+                                   int* zerocopy_on, int* pipeline_on,
+                                   int* shm_on, int* bucket_on,
+                                   int* compress_on, int* wire_on) const {
+  ToParams(cur_x_, fusion, cycle_ms);
+  *cache_on = ArmValue(cur_arm_, kDimCache) ? 1 : 0;
+  *hier_on = ArmValue(cur_arm_, kDimHier) ? 1 : 0;
+  *zerocopy_on = ArmValue(cur_arm_, kDimZerocopy) ? 1 : 0;
+  *pipeline_on = ArmValue(cur_arm_, kDimPipeline) ? 1 : 0;
+  *shm_on = ArmValue(cur_arm_, kDimShm) ? 1 : 0;
+  *bucket_on = ArmValue(cur_arm_, kDimBucket) ? 1 : 0;
+  *compress_on = ArmValue(cur_arm_, kDimCompress) ? 1 : 0;
+  *wire_on = ArmValue(cur_arm_, kDimWire) ? 1 : 0;
+}
+
+const char* ParameterManager::BracketLabel() const {
+  static const char* kRounds[] = {"h0", "h1", "h2", "h3",
+                                  "h4", "h5", "h6", "h7"};
+  switch (phase_) {
+    case kProbe:
+      return "probe";
+    case kHalving:
+      return kRounds[round_ < 8 ? round_ : 7];
+    default:
+      return "gp";
+  }
+}
+
+const char* ParameterManager::ProfileLabel() const {
+  switch (profile_status_) {
+    case kProfileFresh:
+      return "fresh";
+    case kProfileNear:
+      return "near";
+    case kProfileAdopted:
+      return "adopted";
+    case kProfileCorrupt:
+      return "corrupt";
+    default:
+      return "-";
+  }
+}
+
+void ParameterManager::EmitCsvRow(const char* sample_label,
+                                  const char* bracket_label, int64_t fusion,
+                                  double cyc, double score) {
+  if (!log_) return;
+  fprintf(log_, "%s,%.1f,%.3f,%d,%d,%d,%d,%d,%d,%d,%d,%s,%s,%s,%s,%.3f\n",
+          sample_label, fusion / 1024.0, cyc,
+          ArmValue(cur_arm_, kDimCache) ? 1 : 0,
+          ArmValue(cur_arm_, kDimHier) ? 1 : 0,
+          ArmValue(cur_arm_, kDimZerocopy) ? 1 : 0,
+          ArmValue(cur_arm_, kDimPipeline) ? 1 : 0,
+          ArmValue(cur_arm_, kDimShm) ? 1 : 0,
+          ArmValue(cur_arm_, kDimBucket) ? 1 : 0,
+          ArmValue(cur_arm_, kDimCompress) ? 1 : 0,
+          ArmValue(cur_arm_, kDimWire) ? 1 : 0, affinity_.c_str(),
+          pipe_schedule().c_str(), bracket_label, ProfileLabel(),
+          score / 1e6);
+  fflush(log_);
+}
+
+void ParameterManager::Stats(int64_t out[kStatsLen]) const {
+  std::lock_guard<std::mutex> l(stats_mu_);
+  out[0] = n_samples_;
+  out[1] = max_samples_;
+  out[2] = dim_count_;
+  out[3] = arm_count_;
+  out[4] = bracket0_;
+  out[5] = round_;
+  out[6] = (int64_t)survivors_.size();
+  out[7] = profile_status_;
+  out[8] = prior_seeded_ ? 1 : 0;
+  out[9] = adopted_profile_ ? 1 : 0;
+}
+
 bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
                               double* cycle_ms, int* cache_on, int* hier_on,
                               int* zerocopy_on, int* pipeline_on,
@@ -247,15 +560,8 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
     window_start_us_ = now_us;
     // Adopt the first sample point (arm 0 = the job's initial categorical
     // config, numeric point = warmup[0]) right away.
-    ToParams(cur_x_, fusion, cycle_ms);
-    *cache_on = cur_cache_ ? 1 : 0;
-    *hier_on = cur_hier_ ? 1 : 0;
-    *zerocopy_on = cur_zerocopy_ ? 1 : 0;
-    *pipeline_on = cur_pipeline_ ? 1 : 0;
-    *shm_on = cur_shm_ ? 1 : 0;
-    *bucket_on = cur_bucket_ ? 1 : 0;
-    *compress_on = cur_compress_ ? 1 : 0;
-    *wire_on = cur_wire_ ? 1 : 0;
+    FillOutputs(fusion, cycle_ms, cache_on, hier_on, zerocopy_on,
+                pipeline_on, shm_on, bucket_on, compress_on, wire_on);
     warmup_idx_ = 1;
     return true;
   }
@@ -265,115 +571,275 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
     acc_bytes_ += bytes;
     acc_cycles_++;
   }
-  if (acc_cycles_ < cycles_per_sample_) return false;
+  if (acc_cycles_ < window_cycles_) return false;
 
   double secs = (now_us - window_start_us_) / 1e6;
   double score = secs > 0 ? (double)acc_bytes_ / secs : 0.0;
-  n_samples_++;
-  if (log_) {
+  acc_bytes_ = 0;
+  acc_cycles_ = 0;
+  window_start_us_ = now_us;
+
+  // The first window doubles as the signature window: the profile ladder
+  // runs at its close, BEFORE anything is counted as a sweep sample, so an
+  // exact match adopts with samples() == 0.
+  if (!sig_done_) {
+    FinalizeSignature();
+    if (TryAdoptOrSeedProfile()) {
+      std::lock_guard<std::mutex> l(stats_mu_);
+      done_ = true;
+      *fusion = best_fusion_;
+      *cycle_ms = best_cycle_ms_;
+      *cache_on = ArmValue(cur_arm_, kDimCache) ? 1 : 0;
+      *hier_on = ArmValue(cur_arm_, kDimHier) ? 1 : 0;
+      *zerocopy_on = ArmValue(cur_arm_, kDimZerocopy) ? 1 : 0;
+      *pipeline_on = ArmValue(cur_arm_, kDimPipeline) ? 1 : 0;
+      *shm_on = ArmValue(cur_arm_, kDimShm) ? 1 : 0;
+      *bucket_on = ArmValue(cur_arm_, kDimBucket) ? 1 : 0;
+      *compress_on = ArmValue(cur_arm_, kDimCompress) ? 1 : 0;
+      *wire_on = ArmValue(cur_arm_, kDimWire) ? 1 : 0;
+      EmitCsvRow("# adopted", "-", best_fusion_, best_cycle_ms_,
+                 best_score_);
+      EmitCsvRow("# final", "-", best_fusion_, best_cycle_ms_, best_score_);
+      return true;
+    }
+  }
+
+  {
+    std::lock_guard<std::mutex> l(stats_mu_);
+    n_samples_++;
+  }
+  {
     int64_t f;
     double c;
     ToParams(cur_x_, &f, &c);
-    fprintf(log_, "%lld,%.1f,%.3f,%d,%d,%d,%d,%d,%d,%d,%d,%s,%s,%.3f\n",
-            (long long)n_samples_, f / 1024.0, c, cur_cache_ ? 1 : 0,
-            cur_hier_ ? 1 : 0, cur_zerocopy_ ? 1 : 0, cur_pipeline_ ? 1 : 0,
-            cur_shm_ ? 1 : 0, cur_bucket_ ? 1 : 0, cur_compress_ ? 1 : 0,
-            cur_wire_ ? 1 : 0, affinity_.c_str(), pipe_schedule().c_str(),
-            score / 1e6);
-    fflush(log_);
+    char label[24];
+    snprintf(label, sizeof(label), "%lld", (long long)n_samples_);
+    EmitCsvRow(label, BracketLabel(), f, c, score);
   }
   if (score > best_score_) {
     best_score_ = score;
     ToParams(cur_x_, &best_fusion_, &best_cycle_ms_);
   }
-  acc_bytes_ = 0;
-  acc_cycles_ = 0;
-  window_start_us_ = now_us;
-
-  bool budget_done = n_samples_ >= max_samples_;
-  if (arm_idx_ < arm_count_ && !budget_done) {
-    // Categorical phase: score this arm, move to the next (numeric point
-    // pinned at warmup[0] so arm scores are comparable), or lock the
-    // winner and hand over to the numeric search.
-    arm_score_[arm_idx_] = score;
-    arm_idx_++;
-    if (arm_idx_ < arm_count_) {
-      cur_cache_ = arm_cache_[arm_idx_];
-      cur_hier_ = arm_hier_[arm_idx_];
-      cur_zerocopy_ = arm_zerocopy_[arm_idx_];
-      cur_pipeline_ = arm_pipeline_[arm_idx_];
-      cur_shm_ = arm_shm_[arm_idx_];
-      cur_bucket_ = arm_bucket_[arm_idx_];
-      cur_compress_ = arm_compress_[arm_idx_];
-      cur_wire_ = arm_wire_[arm_idx_];
-    } else {
-      best_arm_ = 0;
-      for (int i = 1; i < arm_count_; i++)
-        if (arm_score_[i] > arm_score_[best_arm_]) best_arm_ = i;
-      cur_cache_ = arm_cache_[best_arm_];
-      cur_hier_ = arm_hier_[best_arm_];
-      cur_zerocopy_ = arm_zerocopy_[best_arm_];
-      cur_pipeline_ = arm_pipeline_[best_arm_];
-      cur_shm_ = arm_shm_[best_arm_];
-      cur_bucket_ = arm_bucket_[best_arm_];
-      cur_compress_ = arm_compress_[best_arm_];
-      cur_wire_ = arm_wire_[best_arm_];
-      // Seed the GP with the winning arm's observation at warmup[0]: the
-      // numeric phase continues from warmup[1] under the locked arm.
-      xs_.push_back({cur_x_[0], cur_x_[1]});
-      ys_.push_back(arm_score_[best_arm_]);
-      Propose(cur_x_);  // advance to warmup[1]
-    }
-    ToParams(cur_x_, fusion, cycle_ms);
-    *cache_on = cur_cache_ ? 1 : 0;
-    *hier_on = cur_hier_ ? 1 : 0;
-    *zerocopy_on = cur_zerocopy_ ? 1 : 0;
-    *pipeline_on = cur_pipeline_ ? 1 : 0;
-    *shm_on = cur_shm_ ? 1 : 0;
-    *bucket_on = cur_bucket_ ? 1 : 0;
-    *compress_on = cur_compress_ ? 1 : 0;
-    *wire_on = cur_wire_ ? 1 : 0;
-    return true;
+  if (phase_ != kNumeric && score > best_measured_arm_score_) {
+    best_measured_arm_score_ = score;
+    best_measured_arm_ = cur_arm_;
   }
 
-  xs_.push_back({cur_x_[0], cur_x_[1]});
-  ys_.push_back(score);
-
-  if (budget_done) {
-    // Search done: lock in the best observed point under the locked arm.
+  if (n_samples_ >= max_samples_) {
+    // Budget exhausted wherever we are: lock the best measured arm and
+    // the best observed numeric point, persist the profile, done.
+    std::lock_guard<std::mutex> l(stats_mu_);
     done_ = true;
+    if (phase_ != kNumeric) AdoptArm(best_measured_arm_);
     *fusion = best_fusion_;
     *cycle_ms = best_cycle_ms_;
-    *cache_on = cur_cache_ ? 1 : 0;
-    *hier_on = cur_hier_ ? 1 : 0;
-    *zerocopy_on = cur_zerocopy_ ? 1 : 0;
-    *pipeline_on = cur_pipeline_ ? 1 : 0;
-    *shm_on = cur_shm_ ? 1 : 0;
-    *bucket_on = cur_bucket_ ? 1 : 0;
-    *compress_on = cur_compress_ ? 1 : 0;
-    *wire_on = cur_wire_ ? 1 : 0;
-    if (log_) {
-      fprintf(log_, "# final,%.1f,%.3f,%d,%d,%d,%d,%d,%d,%d,%d,%s,%s,%.3f\n",
-              best_fusion_ / 1024.0, best_cycle_ms_, cur_cache_ ? 1 : 0,
-              cur_hier_ ? 1 : 0, cur_zerocopy_ ? 1 : 0, cur_pipeline_ ? 1 : 0,
-              cur_shm_ ? 1 : 0, cur_bucket_ ? 1 : 0, cur_compress_ ? 1 : 0,
-              cur_wire_ ? 1 : 0, affinity_.c_str(), pipe_schedule().c_str(),
-              best_score_ / 1e6);
-      fflush(log_);
-    }
+    *cache_on = ArmValue(cur_arm_, kDimCache) ? 1 : 0;
+    *hier_on = ArmValue(cur_arm_, kDimHier) ? 1 : 0;
+    *zerocopy_on = ArmValue(cur_arm_, kDimZerocopy) ? 1 : 0;
+    *pipeline_on = ArmValue(cur_arm_, kDimPipeline) ? 1 : 0;
+    *shm_on = ArmValue(cur_arm_, kDimShm) ? 1 : 0;
+    *bucket_on = ArmValue(cur_arm_, kDimBucket) ? 1 : 0;
+    *compress_on = ArmValue(cur_arm_, kDimCompress) ? 1 : 0;
+    *wire_on = ArmValue(cur_arm_, kDimWire) ? 1 : 0;
+    WriteProfile();
+    EmitCsvRow("# final", "-", best_fusion_, best_cycle_ms_, best_score_);
     return true;
   }
-  Propose(cur_x_);
-  ToParams(cur_x_, fusion, cycle_ms);
-  *cache_on = cur_cache_ ? 1 : 0;
-  *hier_on = cur_hier_ ? 1 : 0;
-  *zerocopy_on = cur_zerocopy_ ? 1 : 0;
-  *pipeline_on = cur_pipeline_ ? 1 : 0;
-  *shm_on = cur_shm_ ? 1 : 0;
-  *bucket_on = cur_bucket_ ? 1 : 0;
-  *compress_on = cur_compress_ ? 1 : 0;
-  *wire_on = cur_wire_ ? 1 : 0;
+
+  std::lock_guard<std::mutex> l(stats_mu_);
+  switch (phase_) {
+    case kProbe: {
+      probe_score_[probe_idx_] = score;
+      probe_idx_++;
+      if (probe_idx_ <= dim_count_) {
+        // Next single-toggle probe: dim probe_idx_-1 flipped alone.
+        AdoptArm(1 << (probe_idx_ - 1));
+      } else {
+        BuildBracket();
+        if (bracket0_ >= 2) {
+          phase_ = kHalving;
+          AdoptArm(survivors_[0]);
+        } else {
+          // No halving budget: lock the best single-toggle probe.
+          phase_ = kNumeric;
+          AdoptArm(best_measured_arm_);
+          xs_.push_back({cur_x_[0], cur_x_[1]});
+          ys_.push_back(best_measured_arm_score_);
+          Propose(cur_x_);
+        }
+      }
+      break;
+    }
+    case kHalving: {
+      round_scores_[round_pos_] = score;
+      round_pos_++;
+      if (round_pos_ < (int)survivors_.size()) {
+        AdoptArm(survivors_[round_pos_]);
+        break;
+      }
+      // Round over: keep the top half, double the window.
+      std::vector<int> idx(survivors_.size());
+      std::iota(idx.begin(), idx.end(), 0);
+      std::stable_sort(idx.begin(), idx.end(), [this](int a, int b) {
+        return round_scores_[a] > round_scores_[b];
+      });
+      int keep = std::max(1, (int)survivors_.size() / 2);
+      std::vector<int> next;
+      next.reserve(keep);
+      for (int k = 0; k < keep; k++) next.push_back(survivors_[idx[k]]);
+      double winner_score = round_scores_[idx[0]];
+      survivors_ = next;
+      if ((int)survivors_.size() <= 1) {
+        // Winner locked: the numeric GP search runs under it only.
+        phase_ = kNumeric;
+        window_cycles_ = cycles_per_sample_;
+        AdoptArm(survivors_[0]);
+        xs_.push_back({cur_x_[0], cur_x_[1]});
+        ys_.push_back(winner_score);
+        if (profile_status_ == kProfileNear && cur_arm_ == seed_arm_ &&
+            seed_fusion_ > 0) {
+          // The near-miss profile's numeric point starts the GP phase.
+          double lf = log(std::max((double)seed_fusion_ / (1024.0 * 1024.0),
+                                   kFusionMinMB));
+          double lc = log(std::min(std::max(seed_cycle_ms_, kCycleMinMs),
+                                   kCycleMaxMs));
+          cur_x_[0] = (lf - log(kFusionMinMB)) /
+                      (log(kFusionMaxMB) - log(kFusionMinMB));
+          cur_x_[1] = (lc - log(kCycleMinMs)) /
+                      (log(kCycleMaxMs) - log(kCycleMinMs));
+          cur_x_[0] = std::min(1.0, std::max(0.0, cur_x_[0]));
+          cur_x_[1] = std::min(1.0, std::max(0.0, cur_x_[1]));
+        } else {
+          Propose(cur_x_);
+        }
+      } else {
+        round_++;
+        window_cycles_ = cycles_per_sample_ << round_;
+        round_pos_ = 0;
+        round_scores_.assign(survivors_.size(), 0.0);
+        AdoptArm(survivors_[0]);
+      }
+      break;
+    }
+    case kNumeric: {
+      xs_.push_back({cur_x_[0], cur_x_[1]});
+      ys_.push_back(score);
+      Propose(cur_x_);
+      break;
+    }
+  }
+  FillOutputs(fusion, cycle_ms, cache_on, hier_on, zerocopy_on, pipeline_on,
+              shm_on, bucket_on, compress_on, wire_on);
   return true;
 }
 
 }  // namespace hvd
+
+// ---------------------------------------------------------------------------
+// Deterministic sim harness: drives the REAL search policy above on a
+// synthetic score surface with a fake clock — no job, no pod. Used by
+// tests/test_autotune_v2.py and `bench.py autotune` to measure
+// samples-to-within-5%-of-exhaustive-best and the profile adoption A/B
+// against an exhaustive 2^d enumeration that would never fit a live sweep.
+
+namespace {
+
+hvd::ParameterManager* g_sim = nullptr;
+int64_t g_sim_now_us = 0;
+int64_t g_sim_fusion = 0;
+double g_sim_cycle = 0.0;
+int g_sim_cat[8] = {};
+int g_sim_arm_bits = 0;
+
+void SimRecord(int64_t bytes) {
+  g_sim->Record(bytes, g_sim_now_us, &g_sim_fusion, &g_sim_cycle,
+                &g_sim_cat[0], &g_sim_cat[1], &g_sim_cat[2], &g_sim_cat[3],
+                &g_sim_cat[4], &g_sim_cat[5], &g_sim_cat[6], &g_sim_cat[7]);
+  // Arm bits = the categorical outputs directly (sim inits are all-false,
+  // dims 0..n-1 toggleable), so bit i == dim i flipped.
+  g_sim_arm_bits = 0;
+  for (int i = 0; i < 8; i++)
+    if (g_sim_cat[i]) g_sim_arm_bits |= 1 << i;
+}
+
+}  // namespace
+
+extern "C" {
+
+int hvd_autotune_sim_begin(int n_dims, int64_t max_samples, int bracket,
+                           const char* profile_dir, int64_t workload_id,
+                           int64_t world) {
+  if (n_dims < 0 || n_dims > hvd::kNumAutotuneDims) return -1;
+  delete g_sim;
+  g_sim = new hvd::ParameterManager();
+  hvd::AutotuneConfig c;
+  c.enabled = true;
+  c.cycles_per_sample = 1;  // one sim step == one sample window
+  c.max_samples = max_samples;
+  c.bracket = bracket;
+  c.profile_dir = profile_dir ? profile_dir : "";
+  c.world = world;
+  c.local_size = 1;
+  c.wire_tier = 0;
+  c.affinity = "sim";
+  bool* init_flags[8] = {&c.init_cache,    &c.init_hier,
+                         &c.init_zerocopy, &c.init_pipeline,
+                         &c.init_shm,      &c.init_bucket,
+                         &c.init_compress, &c.init_wire};
+  bool* togg_flags[8] = {&c.can_toggle_cache,    &c.can_toggle_hier,
+                         &c.can_toggle_zerocopy, &c.can_toggle_pipeline,
+                         &c.can_toggle_shm,      &c.can_toggle_bucket,
+                         &c.can_toggle_compress, &c.can_toggle_wire};
+  for (int i = 0; i < 8; i++) {
+    *init_flags[i] = false;
+    *togg_flags[i] = i < n_dims;
+  }
+  g_sim->Configure(c);
+  g_sim->ObserveTensor((uint64_t)workload_id);
+  g_sim_now_us = 0;
+  // Open the first window (adopts arm 0 at warmup[0]).
+  SimRecord(1);
+  return 0;
+}
+
+// Arm whose score the next sim_step should report, as a bitmask over the
+// sim dims (bit i set == dim i flipped on).
+int hvd_autotune_sim_arm(void) {
+  if (!g_sim) return -1;
+  return g_sim_arm_bits;
+}
+
+// Feed one window's score for the current arm. Returns 1 when the search
+// locked (converged/adopted/budget), 0 while still searching, -1 unbegun.
+int hvd_autotune_sim_step(double score) {
+  if (!g_sim) return -1;
+  if (!g_sim->active()) return 1;
+  g_sim_now_us += 1000000;  // fake clock: one second per window
+  int64_t bytes = (int64_t)(score * 1e6);
+  SimRecord(bytes < 1 ? 1 : bytes);
+  return g_sim->active() ? 0 : 1;
+}
+
+int hvd_autotune_sim_stats(int64_t* out) {
+  if (!g_sim) return -1;
+  g_sim->Stats(out);
+  return 0;
+}
+
+// Locked result: arm bits + tuned numerics.
+int hvd_autotune_sim_result(int* arm_bits, int64_t* fusion,
+                            double* cycle_ms) {
+  if (!g_sim) return -1;
+  if (arm_bits) *arm_bits = g_sim_arm_bits;
+  if (fusion) *fusion = g_sim->best_fusion();
+  if (cycle_ms) *cycle_ms = g_sim->best_cycle_ms();
+  return g_sim->active() ? 0 : 1;
+}
+
+int hvd_autotune_sim_end(void) {
+  delete g_sim;
+  g_sim = nullptr;
+  return 0;
+}
+
+}  // extern "C"

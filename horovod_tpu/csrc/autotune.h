@@ -1,4 +1,4 @@
-// autotune.h — online Bayesian autotuning of fusion threshold + cycle time.
+// autotune.h — bandit arm search + GP numeric tuning + persisted profiles.
 //
 // TPU-native redesign of the reference's ParameterManager
 // (horovod/common/parameter_manager.cc) with the GP + expected-improvement
@@ -7,22 +7,102 @@
 // hand-rolled Cholesky on the (tiny) sample matrix and EI is maximized over
 // random candidates instead of gradient ascent.
 //
+// v2 search (docs/autotune.md "v2 search"): the categorical space is up to
+// 2^8 = 256 arms (cache x hier x zerocopy x pipeline x shm x bucket x
+// compress x wire), far past what one window per arm can afford. Instead of
+// enumerating it, the search runs three phases:
+//
+//   1. probe  — d+1 windows: the job's initial config (arm 0), then each
+//               toggleable dim flipped alone. Every dim is guaranteed to be
+//               observed in both states here.
+//   2. halving — per-arm priors are extrapolated multiplicatively from the
+//               probe ratios onto the whole lattice; the top-B arms (the
+//               bracket) are measured and successively halved, the window
+//               doubling each round so survivors earn sharper scores.
+//   3. numeric — the GP fusion/cycle search runs under the winning arm
+//               only (warmup grid then expected improvement).
+//
+// The sample budget derives from the arm count when HVD_AUTOTUNE_MAX_SAMPLES
+// is unset/0: (d+1) probes + (2B-2) halving windows + a numeric tail.
+//
+// Persisted profiles (HVD_AUTOTUNE_PROFILE_DIR): on convergence the
+// coordinator writes the tuned arm + numerics keyed by a workload signature
+// (tensor name/dtype/size digest, world/local size, wire tier, toggleable-dim
+// mask). A later job with the exact signature adopts the profile with 0
+// sweep samples; a same-topology near-miss seeds the bracket priors; a
+// mismatched or corrupt file falls back to a fresh search with the reason
+// counted in Stats(). Unset dir = no filesystem access at all.
+//
 // Runs on the coordinator only. Each sample window accumulates negotiated
-// payload bytes over wall time at the current (fusion_threshold,
-// cycle_time) point; the score is bytes/sec. After warmup grid points, new
-// points are proposed by EI. Proposals ride the broadcast ResponseList so
-// every rank switches parameters on the same cycle. HVD_AUTOTUNE=1 enables;
-// HVD_AUTOTUNE_LOG writes a CSV of (sample, fusion_kb, cycle_ms, score).
+// payload bytes over wall time at the current point; the score is bytes/sec.
+// Proposals ride the broadcast ResponseList so every rank switches
+// parameters on the same cycle. HVD_AUTOTUNE=1 enables; HVD_AUTOTUNE_LOG
+// writes the CSV described by observability/autotune_csv.py.
 #pragma once
 
 #include <array>
 #include <cstdint>
 #include <cstdio>
 #include <mutex>
+#include <set>
 #include <string>
 #include <vector>
 
 namespace hvd {
+
+// Toggleable categorical dimensions, in CSV column order. Bit i of an arm
+// index flips toggleable dim i away from the job's initial configuration.
+enum AutotuneDim {
+  kDimCache = 0,
+  kDimHier,
+  kDimZerocopy,
+  kDimPipeline,
+  kDimShm,
+  kDimBucket,
+  kDimCompress,
+  kDimWire,
+  kNumAutotuneDims,
+};
+
+// Everything Configure needs, in one place. The init_* fields are the job's
+// starting categorical values; can_toggle_* gates whether that dim is part
+// of the searched lattice (a toggle that cannot take effect — capacity-0
+// cache, single-member ring, failed wire probe — would burn windows
+// measuring a config that never engaged).
+struct AutotuneConfig {
+  bool enabled = false;
+  std::string log_path;     // rank-0 CSV; empty = no log
+  std::string profile_dir;  // rank-0 profile store; empty = profiles off
+  int64_t init_fusion = 64 << 20;
+  double init_cycle_ms = 1.0;
+  int64_t cycles_per_sample = 20;
+  int64_t max_samples = 0;  // <=0: derive from the arm count
+  int bracket = 0;          // HVD_AUTOTUNE_BRACKET; <=0: derive (<=16)
+  bool init_cache = true, init_hier = false, init_zerocopy = true,
+       init_pipeline = true, init_shm = true, init_bucket = false,
+       init_compress = false, init_wire = false;
+  bool can_toggle_cache = false, can_toggle_hier = false,
+       can_toggle_zerocopy = false, can_toggle_pipeline = false,
+       can_toggle_shm = false, can_toggle_bucket = false,
+       can_toggle_compress = false, can_toggle_wire = false;
+  // Workload-signature topology fields (profile key).
+  int64_t world = 1;
+  int64_t local_size = 1;
+  int wire_tier = 0;
+  // Process CPU-affinity string recorded verbatim in every CSV row
+  // (comma-free; see numa::AffinityString).
+  std::string affinity;
+};
+
+// Profile-match ladder outcome, exposed via Stats() and the CSV `profile`
+// column ("-", "fresh", "near", "adopted", "corrupt").
+enum AutotuneProfileStatus {
+  kProfileOff = 0,      // no HVD_AUTOTUNE_PROFILE_DIR
+  kProfileFresh = 1,    // dir set, no usable profile for this topology
+  kProfileNear = 2,     // same topology, different tensor digest: seeded
+  kProfileAdopted = 3,  // exact signature: adopted with 0 sweep samples
+  kProfileCorrupt = 4,  // exact-name file failed parse/CRC: fresh search
+};
 
 class ParameterManager {
  public:
@@ -30,36 +110,22 @@ class ParameterManager {
     if (log_) fclose(log_);
   }
 
-  // `affinity` is the process CPU-affinity string recorded verbatim in
-  // every CSV row (comma-free; see numa::AffinityString) so tuning runs
-  // are attributable to their placement.
-  void Configure(bool enabled, const std::string& log_path,
-                 int64_t init_fusion, double init_cycle_ms,
-                 int64_t cycles_per_sample, int64_t max_samples,
-                 bool init_cache, bool init_hier, bool init_zerocopy,
-                 bool init_pipeline, bool init_shm, bool init_bucket,
-                 bool init_compress, bool init_wire, bool can_toggle_cache,
-                 bool can_toggle_hier, bool can_toggle_zerocopy,
-                 bool can_toggle_pipeline, bool can_toggle_shm,
-                 bool can_toggle_bucket, bool can_toggle_compress,
-                 bool can_toggle_wire, const std::string& affinity);
+  void Configure(const AutotuneConfig& cfg);
   bool active() const { return enabled_ && !done_; }
   bool enabled() const { return enabled_; }
   // Non-coordinator ranks mirror the coordinator's search-finished state
   // from the broadcast ResponseList.
   void SetDone() { done_ = true; }
 
+  // True until the workload signature is finalized (first window close):
+  // the coordinator keeps feeding per-tensor hashes via ObserveTensor.
+  bool wants_workload() const { return enabled_ && !done_ && !sig_done_; }
+  void ObserveTensor(uint64_t h);
+
   // Called by the coordinator every negotiation cycle with the payload
   // bytes this cycle's ResponseList moves (0 for idle cycles). Returns true
   // when a new parameter point is proposed; *fusion / *cycle_ms /
-  // *cache_on / *hier_on then carry the values every rank must adopt.
-  // The search runs in two phases (reference: parameter_manager.cc's
-  // categorical layers before numeric tuning): first the categorical
-  // arms (response cache x hierarchical allreduce x zero-copy
-  // scatter-gather x ring pipeline x shm host plane x gradient
-  // bucketing x compressed collectives x wire tier) are each scored for
-  // one window at the initial numeric point; the winning arm is locked,
-  // then the (fusion, cycle) warmup grid + GP search runs under it.
+  // *cache_on .. *wire_on then carry the values every rank must adopt.
   bool Record(int64_t bytes, int64_t now_us, int64_t* fusion,
               double* cycle_ms, int* cache_on, int* hier_on,
               int* zerocopy_on, int* pipeline_on, int* shm_on,
@@ -68,6 +134,13 @@ class ParameterManager {
   int64_t best_fusion() const { return best_fusion_; }
   double best_cycle_ms() const { return best_cycle_ms_; }
   int64_t samples() const { return n_samples_; }
+
+  // Search-progress snapshot for hvd_autotune_stats (basics.autotune_stats
+  // key order): [samples, budget, dims, arms, bracket, round, survivors,
+  // profile_status, prior_seeded, adopted_profile]. Guarded by stats_mu_;
+  // callable from user threads while Record runs on the background loop.
+  static constexpr int kStatsLen = 10;
+  void Stats(int64_t out[kStatsLen]) const;
 
   // Categorical *recorded* field, not a swept arm (the `pipeline` arm
   // above is the ring-pipeline toggle — unrelated): the active JAX
@@ -92,41 +165,67 @@ class ParameterManager {
   static constexpr double kCycleMinMs = 0.2;
   static constexpr double kCycleMaxMs = 25.0;
 
+  enum Phase { kProbe, kHalving, kNumeric };
+
   void ToParams(const double x[2], int64_t* fusion, double* cycle_ms) const;
   void Propose(double out[2]);
   double EI(const double x[2], double best_y) const;
   void GpFit() const;  // builds chol_ / alpha_ lazily over xs_/ys_
+
+  // Arm lattice helpers: an arm is a bitmask over the toggleable dims.
+  bool ArmValue(int arm_bits, int dim_id) const;
+  void AdoptArm(int arm_bits);
+  double ArmPrior(int arm_bits) const;
+  void BuildBracket();
+  void EmitCsvRow(const char* sample_label, const char* bracket_label,
+                  int64_t fusion, double cyc, double score);
+  void FillOutputs(int64_t* fusion, double* cycle_ms, int* cache_on,
+                   int* hier_on, int* zerocopy_on, int* pipeline_on,
+                   int* shm_on, int* bucket_on, int* compress_on,
+                   int* wire_on) const;
+  const char* BracketLabel() const;
+  const char* ProfileLabel() const;
+
+  // Profile persistence (autotune.cc): signature finalization, the
+  // exact/near/corrupt ladder, and the atomic tmp+rename writer.
+  void FinalizeSignature();
+  bool TryAdoptOrSeedProfile();  // true => adopted (search over, 0 samples)
+  void WriteProfile() const;
+  std::string ProfileFileName(uint64_t digest) const;
 
   bool enabled_ = false;
   bool done_ = false;
   FILE* log_ = nullptr;
 
   int64_t cycles_per_sample_ = 20;
+  int64_t window_cycles_ = 20;  // cycles_per_sample_ << halving round
   int64_t max_samples_ = 30;
-  int64_t n_samples_ = 0;  // arm + numeric windows scored so far
+  int64_t n_samples_ = 0;  // probe + halving + numeric windows scored
 
-  // Categorical phase: (cache, hier, zerocopy, pipeline, shm, bucket,
-  // compress, wire) arms over the TOGGLEABLE dims only, initial-config arm
-  // first so the baseline is always measured. Filled in Configure;
-  // arm_count_ is a power of two in 1..256. The wire dim only exists where
-  // the tier probe succeeded (can_toggle_wire), so no arm ever asks for an
-  // unsupported kernel feature.
+  // The lattice, bit i of an arm index <-> toggleable dim dim_id_[i].
+  // kMaxArms bounds 2^dim_count_ (8 dims -> 256).
   static constexpr int kMaxArms = 256;
-  bool arm_cache_[kMaxArms];
-  bool arm_hier_[kMaxArms];
-  bool arm_zerocopy_[kMaxArms];
-  bool arm_pipeline_[kMaxArms];
-  bool arm_shm_[kMaxArms];
-  bool arm_bucket_[kMaxArms];
-  bool arm_compress_[kMaxArms];
-  bool arm_wire_[kMaxArms];
-  double arm_score_[kMaxArms] = {};
-  int arm_count_ = 1;
-  int arm_idx_ = 0;        // next arm to measure; == arm_count_ -> locked
-  int best_arm_ = 0;
-  bool cur_cache_ = true, cur_hier_ = false, cur_zerocopy_ = true,
-       cur_pipeline_ = true, cur_shm_ = true, cur_bucket_ = false,
-       cur_compress_ = false, cur_wire_ = false;
+  int dim_count_ = 0;               // toggleable dims (d)
+  int dim_id_[kNumAutotuneDims];    // bit index -> AutotuneDim
+  bool init_val_[kNumAutotuneDims]; // initial value per AutotuneDim
+  bool toggleable_[kNumAutotuneDims];
+  int arm_count_ = 1;  // 1 << dim_count_
+  int cur_arm_ = 0;
+
+  Phase phase_ = kNumeric;
+  // Probe phase: probe k measures arm (k ? 1<<(k-1) : 0).
+  int probe_idx_ = 0;
+  double probe_score_[kNumAutotuneDims + 1] = {};
+  // Halving phase.
+  int bracket_cfg_ = 0;          // requested bracket (0 = derive)
+  int bracket0_ = 0;             // initial bracket size B
+  int round_ = 0;                // halving round (window = cps << round)
+  int round_pos_ = 0;            // next survivor to measure this round
+  std::vector<int> survivors_;   // arm bits still in the bracket
+  std::vector<double> round_scores_;
+  int best_measured_arm_ = 0;
+  double best_measured_arm_score_ = -1.0;
+
   std::string affinity_ = "?";
   mutable std::mutex sched_mu_;
   std::string pipe_schedule_ = "-";
@@ -137,7 +236,7 @@ class ParameterManager {
   int64_t acc_cycles_ = 0;
   int64_t window_start_us_ = -1;
 
-  // Observations (normalized inputs, raw scores).
+  // Observations (normalized inputs, raw scores) for the numeric GP.
   std::vector<std::array<double, 2>> xs_;
   std::vector<double> ys_;
 
@@ -146,6 +245,23 @@ class ParameterManager {
   double best_score_ = -1.0;
   int warmup_idx_ = 0;
   uint64_t rng_ = 0x9e3779b97f4a7c15ull;
+
+  // Workload signature + profile state.
+  std::string profile_dir_;
+  int64_t world_ = 1, local_size_ = 1;
+  int wire_tier_ = 0;
+  uint32_t dims_mask_ = 0;  // bitmask over AutotuneDim of toggleable dims
+  std::set<uint64_t> sig_tensors_;
+  uint64_t sig_digest_ = 0;
+  bool sig_done_ = false;
+  int profile_status_ = kProfileOff;
+  bool prior_seeded_ = false;
+  bool adopted_profile_ = false;
+  int seed_arm_ = -1;  // near-miss profile's arm bits (bracket head)
+  int64_t seed_fusion_ = 0;
+  double seed_cycle_ms_ = 0.0;
+
+  mutable std::mutex stats_mu_;
 
   // GP state (rebuilt per proposal; tiny matrices).
   mutable std::vector<double> chol_;   // lower-triangular N x N

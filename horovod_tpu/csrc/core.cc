@@ -1204,11 +1204,48 @@ int64_t PayloadBytes(const ResponseList& rl) {
   return total;
 }
 
+// Per-tensor identity hash for the autotune workload signature: name +
+// dtype + payload bytes (FNV-1a). Two jobs submitting the same tensors see
+// the same set of hashes regardless of negotiation order.
+uint64_t TensorSigHash(const std::string& name, DataType dtype,
+                       int64_t bytes) {
+  uint64_t h = 1469598103934665603ull;
+  for (char ch : name) {
+    h ^= (uint8_t)ch;
+    h *= 1099511628211ull;
+  }
+  h ^= (uint64_t)dtype;
+  h *= 1099511628211ull;
+  h ^= (uint64_t)bytes;
+  h *= 1099511628211ull;
+  return h;
+}
+
+// Feed this cycle's tensors into the workload-signature digest (autotune.h:
+// the signature is finalized at the first sample-window close, when the
+// profile adoption ladder runs).
+void AutotuneObserveWorkload(const ResponseList& rl) {
+  auto observe = [](const Response& r) {
+    for (size_t i = 0; i < r.names.size(); i++) {
+      int64_t bytes = 0;
+      if (i < r.shapes.size())
+        bytes = NumElements(r.shapes[i]) * (int64_t)DataTypeSize(r.dtype);
+      g->autotune.ObserveTensor(TensorSigHash(r.names[i], r.dtype, bytes));
+    }
+  };
+  for (auto& r : rl.responses) observe(r);
+  for (uint32_t b : rl.cache_hits) {
+    if (!g->cache.Valid(b)) continue;
+    observe(g->cache.Get(b));
+  }
+}
+
 // Coordinator-side: score the cycle and stamp parameter proposals onto the
 // outgoing list.
 void AutotuneCycle(ResponseList& rl) {
   if (!g->autotune.enabled()) return;
   if (g->autotune.active()) {
+    if (g->autotune.wants_workload()) AutotuneObserveWorkload(rl);
     int64_t fusion;
     double cycle_ms;
     int cache_on, hier_on, zerocopy_on, pipeline_on, shm_on, bucket_on,
@@ -2027,45 +2064,64 @@ int hvd_init() {
     // can actually take effect — a cache arm with capacity 0 or a
     // hierarchical arm on a non-uniform topology would burn sample
     // windows measuring (and logging) a configuration that never engaged.
-    g->autotune.Configure(
-        EnvInt("HVD_AUTOTUNE", 0) != 0,
-        g->rank == 0 ? EnvStr("HVD_AUTOTUNE_LOG", "") : "",
-        g->fusion_threshold, g->cycle_time_ms,
-        EnvInt("HVD_AUTOTUNE_CYCLES_PER_SAMPLE", 20),
-        EnvInt("HVD_AUTOTUNE_MAX_SAMPLES", 30),
-        g->cache.enabled(), g->hierarchical, g->zerocopy_on,
-        /*init_pipeline=*/g->ring_pipeline_cfg != 1,
-        /*init_shm=*/g->data.shm_enabled(),
-        /*init_bucket=*/g->queue.bucket_enabled(),
-        /*init_compress=*/g->compress_live.load() != 0,
-        /*init_wire=*/g->wire_tier > wire::kBasic,
-        /*can_toggle_cache=*/g->cache.enabled(),
-        // On a single host the hierarchical arm only pays off when the
-        // local phase actually rides shm — without the plane it degrades
-        // to the flat ring and would burn a sample window measuring the
-        // same configuration twice.
-        /*can_toggle_hier=*/g->hier_ok && g->size > 1 &&
-            (g->cross_size > 1 || g->data.shm().active()),
-        /*can_toggle_zerocopy=*/g->zerocopy_allowed && g->size > 1,
-        // HVD_RING_PIPELINE=1 is the operator pinning serial: drop the
-        // arm dimension instead of sweeping a config they opted out of.
-        /*can_toggle_pipeline=*/g->size > 1 && g->ring_pipeline_cfg != 1,
-        // Same opt-out rule for shm: HVD_SHM=0 or no plane (single rank
-        // per host, non-uniform topology) drops the dimension.
-        /*can_toggle_shm=*/g->shm_allowed && g->data.shm().active(),
-        // Bucketing pays off only when a peer exists to overlap comms
-        // against; HVD_BUCKET=0 is the operator opting out of the arm.
-        /*can_toggle_bucket=*/g->bucket_allowed && g->size > 1,
-        // The compress arm exists only when a codec is configured
-        // (HVD_COMPRESS=int8|topk) and a peer exists to move bytes to;
-        // unset/0 keeps the arm out of the sweep AND the wire
-        // byte-identical.
-        /*can_toggle_compress=*/g->compress_allowed.load() && g->size > 1,
-        // The wire arm exists only where the mesh agreed on a tier above
-        // basic — on kernels where the probe failed (or HVD_WIRE=basic)
-        // both arm settings would measure the identical sendmsg path.
-        /*can_toggle_wire=*/g->wire_tier > wire::kBasic && g->size > 1,
-        /*affinity=*/numa::AffinityString());
+    {
+      AutotuneConfig at;
+      at.enabled = EnvInt("HVD_AUTOTUNE", 0) != 0;
+      // CSV log + profile store are coordinator-side artifacts: the
+      // search (and profile read/write) runs on rank 0 only; other ranks
+      // adopt whatever rides the ResponseList tuned_* wire.
+      at.log_path = g->rank == 0 ? EnvStr("HVD_AUTOTUNE_LOG", "") : "";
+      at.profile_dir =
+          g->rank == 0 ? EnvStr("HVD_AUTOTUNE_PROFILE_DIR", "") : "";
+      at.init_fusion = g->fusion_threshold;
+      at.init_cycle_ms = g->cycle_time_ms;
+      at.cycles_per_sample = EnvInt("HVD_AUTOTUNE_CYCLES_PER_SAMPLE", 20);
+      // 0 (the default) derives the budget from the arm count — probes +
+      // halving bracket + numeric tail — instead of a flat cap blind to
+      // how big the lattice actually is.
+      at.max_samples = EnvInt("HVD_AUTOTUNE_MAX_SAMPLES", 0);
+      at.bracket = (int)EnvInt("HVD_AUTOTUNE_BRACKET", 0);
+      at.init_cache = g->cache.enabled();
+      at.init_hier = g->hierarchical;
+      at.init_zerocopy = g->zerocopy_on;
+      at.init_pipeline = g->ring_pipeline_cfg != 1;
+      at.init_shm = g->data.shm_enabled();
+      at.init_bucket = g->queue.bucket_enabled();
+      at.init_compress = g->compress_live.load() != 0;
+      at.init_wire = g->wire_tier > wire::kBasic;
+      at.can_toggle_cache = g->cache.enabled();
+      // On a single host the hierarchical arm only pays off when the
+      // local phase actually rides shm — without the plane it degrades
+      // to the flat ring and would burn a sample window measuring the
+      // same configuration twice.
+      at.can_toggle_hier = g->hier_ok && g->size > 1 &&
+                           (g->cross_size > 1 || g->data.shm().active());
+      at.can_toggle_zerocopy = g->zerocopy_allowed && g->size > 1;
+      // HVD_RING_PIPELINE=1 is the operator pinning serial: drop the
+      // arm dimension instead of sweeping a config they opted out of.
+      at.can_toggle_pipeline = g->size > 1 && g->ring_pipeline_cfg != 1;
+      // Same opt-out rule for shm: HVD_SHM=0 or no plane (single rank
+      // per host, non-uniform topology) drops the dimension.
+      at.can_toggle_shm = g->shm_allowed && g->data.shm().active();
+      // Bucketing pays off only when a peer exists to overlap comms
+      // against; HVD_BUCKET=0 is the operator opting out of the arm.
+      at.can_toggle_bucket = g->bucket_allowed && g->size > 1;
+      // The compress arm exists only when a codec is configured
+      // (HVD_COMPRESS=int8|topk) and a peer exists to move bytes to;
+      // unset/0 keeps the arm out of the sweep AND the wire
+      // byte-identical.
+      at.can_toggle_compress = g->compress_allowed.load() && g->size > 1;
+      // The wire arm exists only where the mesh agreed on a tier above
+      // basic — on kernels where the probe failed (or HVD_WIRE=basic)
+      // both arm settings would measure the identical sendmsg path.
+      at.can_toggle_wire = g->wire_tier > wire::kBasic && g->size > 1;
+      // Workload-signature topology key (profile match ladder).
+      at.world = g->size;
+      at.local_size = g->local_size;
+      at.wire_tier = g->wire_tier;
+      at.affinity = numa::AffinityString();
+      g->autotune.Configure(at);
+    }
     double data_tmo = EnvDouble("HVD_DATA_TIMEOUT_SECONDS", -1.0);
     if (data_tmo <= 0) {
       data_tmo = 300.0;
@@ -2382,6 +2438,18 @@ int hvd_autotune_state(int64_t* fusion_threshold, double* cycle_time_ms) {
   if (!g || !g->initialized) return -1;
   if (fusion_threshold) *fusion_threshold = g->fusion_threshold;
   if (cycle_time_ms) *cycle_time_ms = g->cycle_time_ms;
+  if (!g->autotune.enabled()) return 0;
+  return g->autotune.active() ? 1 : 2;
+}
+
+// Bandit search progress (basics.autotune_stats / the AUTOTUNE_* gauges):
+// out[10] = [samples, budget, dims, arms, bracket, round, survivors,
+// profile_status, prior_seeded, adopted_profile]. Meaningful on the
+// coordinator (the search runs there); other ranks report zeros. Returns
+// the autotune state code (same as hvd_autotune_state) or -1.
+int hvd_autotune_stats(int64_t* out) {
+  if (!g || !g->initialized || !out) return -1;
+  g->autotune.Stats(out);
   if (!g->autotune.enabled()) return 0;
   return g->autotune.active() ? 1 : 2;
 }

@@ -472,6 +472,40 @@ WIRE_ZC_SENDS = gauge(
 WIRE_PINNED_LANES = gauge(
     "hvd_wire_pinned_lanes",
     "Reduce-pool lanes NUMA-pinned under HVD_NUMA")
+AUTOTUNE_SAMPLES = gauge(
+    "hvd_autotune_samples",
+    "Measured tuning windows the v2 search has consumed so far (0 at "
+    "lock == a persisted profile was adopted without sweeping — "
+    "docs/autotune.md)")
+AUTOTUNE_BUDGET = gauge(
+    "hvd_autotune_budget",
+    "Total sample budget the search derived from the toggleable-dim "
+    "count (probes + halving bracket + GP tail; HVD_AUTOTUNE_MAX_SAMPLES "
+    "caps it when set)")
+AUTOTUNE_DIMS = gauge(
+    "hvd_autotune_dims",
+    "Toggleable categorical dimensions on this topology (the arm "
+    "lattice is 2^dims)")
+AUTOTUNE_BRACKET_ROUND = gauge(
+    "hvd_autotune_bracket_round",
+    "Current successive-halving round (0 until the probes finish; the "
+    "bracket halves each round until one arm survives)")
+AUTOTUNE_SURVIVORS = gauge(
+    "hvd_autotune_survivors",
+    "Arms still alive in the current halving round")
+AUTOTUNE_PROFILE_STATUS = gauge(
+    "hvd_autotune_profile_status",
+    "Persisted-profile adoption outcome (0 off / 1 fresh / 2 near-miss "
+    "seeded / 3 adopted / 4 corrupt-fallback — the counted reason "
+    "ladder, see autotune_csv.PROFILE_STATES)")
+AUTOTUNE_PROFILE_ADOPTED = gauge(
+    "hvd_autotune_profile_adopted",
+    "1 when an exact workload-keyed profile was adopted with zero sweep "
+    "samples this job")
+AUTOTUNE_PRIOR_SEEDED = gauge(
+    "hvd_autotune_prior_seeded",
+    "1 when a near-miss profile seeded the bracket priors and numeric "
+    "start point (same topology, different tensor digest)")
 SERVE_QUEUE_DEPTH = gauge(
     "hvd_serve_queue_depth",
     "Requests waiting for admission into the decode batch (the "
@@ -590,6 +624,17 @@ def sample_core_stats(hvd=None):
     live, _, _, _, pinned = hvd.wire_state()
     WIRE_TIER.set({"basic": 0, "zerocopy": 1, "uring": 2}[live])
     WIRE_PINNED_LANES.set(pinned)
+    ats = hvd.autotune_stats()
+    AUTOTUNE_SAMPLES.set(ats["samples"])
+    AUTOTUNE_BUDGET.set(ats["budget"])
+    AUTOTUNE_DIMS.set(ats["dims"])
+    AUTOTUNE_BRACKET_ROUND.set(ats["round"])
+    AUTOTUNE_SURVIVORS.set(ats["survivors"])
+    PROFILE_CODES = {"-": 0, "fresh": 1, "near": 2, "adopted": 3,
+                     "corrupt": 4}
+    AUTOTUNE_PROFILE_STATUS.set(PROFILE_CODES.get(ats["profile"], 0))
+    AUTOTUNE_PROFILE_ADOPTED.set(int(ats["adopted_profile"]))
+    AUTOTUNE_PRIOR_SEEDED.set(int(ats["prior_seeded"]))
 
 
 def record_call(op, seconds, nbytes, process_set=0):

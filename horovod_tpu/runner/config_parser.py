@@ -26,6 +26,7 @@ YAML schema (any subset):
     autotune:
       enable: true
       log-file: /tmp/autotune.csv
+      profile-dir: /var/lib/hvd/profiles
     metrics:
       enable: true
       port: 9090
@@ -72,6 +73,7 @@ ARG_TO_ENV = {
                                           str),
     "autotune": ("HVD_AUTOTUNE", lambda v: "1" if v else "0"),
     "autotune_log_file": ("HVD_AUTOTUNE_LOG", str),
+    "autotune_profile_dir": ("HVD_AUTOTUNE_PROFILE_DIR", str),
     "start_timeout": ("HVD_START_TIMEOUT", str),
     "log_level": ("HVD_LOG_LEVEL", str),
     "peer_timeout_ms": ("HVD_PEER_TIMEOUT_MS", lambda v: str(int(v))),
@@ -127,7 +129,8 @@ _FILE_SECTIONS = {
                     "stall_check_warning_time_seconds",
                     "shutdown-time-seconds":
                     "stall_check_shutdown_time_seconds"},
-    "autotune": {"enable": "autotune", "log-file": "autotune_log_file"},
+    "autotune": {"enable": "autotune", "log-file": "autotune_log_file",
+                 "profile-dir": "autotune_profile_dir"},
     "metrics": {"enable": "metrics", "port": "metrics_port"},
     "serve": {"page-size": "serve_page_size",
               "kv-pages": "serve_kv_pages",

@@ -152,6 +152,15 @@ def parse_args(argv=None):
                    default=None)
     p.add_argument("--autotune", action="store_true", default=None)
     p.add_argument("--autotune-log-file", dest="autotune_log_file")
+    p.add_argument("--autotune-profile-dir", dest="autotune_profile_dir",
+                   help="directory for persisted workload-keyed tuning "
+                        "profiles (HVD_AUTOTUNE_PROFILE_DIR): on "
+                        "convergence the coordinator writes the winning "
+                        "configuration keyed by workload signature; a "
+                        "later identical job adopts it with zero sweep "
+                        "samples, a near-miss seeds the search priors. "
+                        "Unset = profiles off (v1-identical search, no "
+                        "filesystem access)")
     p.add_argument("--log-level", dest="log_level",
                    choices=["trace", "debug", "info", "warn", "error"])
     p.add_argument("--metrics", dest="metrics", action="store_true",

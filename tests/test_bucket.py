@@ -99,9 +99,9 @@ def test_bucket_timeline_events(tmp_path):
 
 def test_autotune_bucket_arm(tmp_path):
     """The bucket toggle as the sixth autotune categorical arm: with
-    zerocopy/pipeline/shm pinned off on a 2-rank pod the sweep walks all
-    4 (cache, bucket) combinations, locks one, and ships it in the
-    ResponseList (autotune_worker.py asserts the CSV arm walk)."""
+    zerocopy/pipeline/shm pinned off on a 2-rank pod the (cache, bucket)
+    probe rows flip each dim once, the bandit locks a winner, and ships
+    it in the ResponseList (autotune_worker.py asserts the phase walk)."""
     log = tmp_path / "autotune_bucket.csv"
     run_worker_job(2, "autotune_worker.py", extra_env={
         "HVD_AUTOTUNE": "1",
@@ -113,9 +113,9 @@ def test_autotune_bucket_arm(tmp_path):
         "HVD_SHM": "0",
         # wire arm pinned off: covered by test_wire.py::test_autotune_wire_arm
         "HVD_WIRE": "basic",
-        "EXPECT_ARMS": "4",
+        "EXPECT_DIMS": "2",
     }, timeout=240)
-    # The bucket column really swept both states.
-    rows = [l for l in log.read_text().splitlines()[1:5]
+    # The bucket column really swept both states (d+1 = 3 probe rows).
+    rows = [l for l in log.read_text().splitlines()[1:4]
             if not l.startswith("#")]
     assert {l.split(",")[8] for l in rows} == {"0", "1"}, rows

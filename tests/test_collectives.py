@@ -80,21 +80,21 @@ def test_autotune(tmp_path):
         "HVD_AUTOTUNE": "1",
         "HVD_AUTOTUNE_LOG": str(log),
         "HVD_AUTOTUNE_CYCLES_PER_SAMPLE": "4",
-        # 16 arms need >= arm_count + 3 samples or the categorical sweep
-        # is skipped (parameter_manager arm guard).
+        # Explicit budget: the bandit sizes its bracket to what fits after
+        # the d+1 probes + a minimal numeric phase (autotune.cc Configure).
         "HVD_AUTOTUNE_MAX_SAMPLES": "20",
         # 2 fake hosts x 2 locals: the hierarchical arm is toggleable, so
-        # the categorical sweep covers all 16 (cache, hier, zerocopy,
-        # pipeline) combinations. HVD_SHM=0 / HVD_BUCKET=0 remove those
-        # dimensions (32/64 arms would outgrow the 20-sample budget); the
-        # shm arm is covered by test_hier_shm.py::test_autotune_shm_arm,
-        # the bucket arm by test_bucket.py::test_autotune_bucket_arm.
+        # the lattice covers at least (cache, hier, zerocopy, pipeline).
+        # HVD_SHM=0 / HVD_BUCKET=0 remove those dimensions; the shm arm is
+        # covered by test_hier_shm.py::test_autotune_shm_arm, the bucket
+        # arm by test_bucket.py::test_autotune_bucket_arm. The wire dim is
+        # UNPINNED (the PR 13 HVD_WIRE=basic workaround is gone): the
+        # bandit fits whatever lattice the wire probe yields, so the dim
+        # count is env-dependent — hence the >= bound.
         "AT_LOCAL_SIZE": "2",
         "HVD_SHM": "0",
         "HVD_BUCKET": "0",
-        # wire arm pinned off: covered by test_wire.py::test_autotune_wire_arm
-        "HVD_WIRE": "basic",
-        "EXPECT_ARMS": "16",
+        "EXPECT_DIMS_MIN": "4",
     }, timeout=240)
 
 
@@ -117,7 +117,7 @@ def test_autotune_schedule_column(tmp_path):
         "HVD_SHM": "0",
         "HVD_BUCKET": "0",
         "HVD_WIRE": "basic",
-        "EXPECT_ARMS": "2",
+        "EXPECT_DIMS": "1",
     }, timeout=240)
     rows = [l for l in log.read_text().splitlines()[1:] if l]
     assert all(l.split(",")[12] == "interleaved2" for l in rows), rows[:3]
@@ -136,27 +136,28 @@ def test_autotune_beats_defaults_32rank(tmp_path):
         "HVD_AUTOTUNE_CYCLES_PER_SAMPLE": "3",
         "HVD_AUTOTUNE_MAX_SAMPLES": "8",
         "HVD_CYCLE_TIME_MS": "25",
-        "AT_LOCAL_SIZE": "8",  # 4 fake hosts x 8: all 4 arms toggleable
-        # Pin the zero-copy, ring-pipeline, shm, and bucket arms off:
-        # keeps the 4-arm (cache x hier) sweep inside the tight 8-sample
-        # budget (8 arms would need >= 11 samples, 16 would need 19).
-        # Those arms are covered by test_autotune above,
-        # test_hier_shm.py::test_autotune_shm_arm, and
-        # test_bucket.py::test_autotune_bucket_arm.
+        "AT_LOCAL_SIZE": "8",  # 4 fake hosts x 8: cache + hier toggleable
+        # Pin the zero-copy, ring-pipeline, shm, bucket, and wire arms
+        # off: keeps the probe phase at 3 windows + a 2-arm bracket inside
+        # the tight 8-sample budget, and keeps the probe-row assertion
+        # below deterministic (the wire dim is kernel-dependent). Those
+        # arms are covered by test_autotune above,
+        # test_hier_shm.py::test_autotune_shm_arm,
+        # test_bucket.py::test_autotune_bucket_arm, and test_wire.py.
         "HVD_ZEROCOPY": "0",
         "HVD_RING_PIPELINE": "1",
         "HVD_SHM": "0",
         "HVD_BUCKET": "0",
-        # wire arm pinned off too (covered by test_wire.py): a probed
-        # uring/zerocopy kernel would add a dimension and the 8-arm
-        # sweep no longer fits the 8-sample budget (sweep skipped).
         "HVD_WIRE": "basic",
     }, timeout=600)
     text = log.read_text()
     assert text.startswith("sample,fusion_kb,cycle_ms,cache,hier,"), text
-    arm_cols = {tuple(l.split(",")[3:5])
-                for l in text.splitlines()[1:5]}
-    assert len(arm_cols) == 4, arm_cols  # categorical sweep recorded
+    # Probe phase recorded: baseline + cache-flip + hier-flip are three
+    # distinct (cache, hier) pairs, and each dim took both values.
+    probe = [l.split(",") for l in text.splitlines()[1:4]]
+    assert len({tuple(l[3:5]) for l in probe}) == 3, probe
+    assert {l[3] for l in probe} == {"0", "1"}, probe
+    assert {l[4] for l in probe} == {"0", "1"}, probe
 
 
 def test_join_same_cycle_drain_and_overlap():

@@ -164,8 +164,8 @@ def test_timeline_compress_events(tmp_path):
 def test_autotune_compress_arm(tmp_path):
     """The compress toggle as the seventh categorical arm: with
     zerocopy/pipeline/shm/bucket pinned and int8 configured, a 2-rank
-    sweep walks all 4 (cache, compress) combinations and the compress
-    CSV column really takes both states."""
+    job's (cache, compress) probe rows flip each dim once and the
+    compress CSV column really takes both states."""
     log = tmp_path / "autotune_compress.csv"
     run_worker_job(2, "autotune_worker.py", extra_env={
         "HVD_AUTOTUNE": "1",
@@ -179,9 +179,10 @@ def test_autotune_compress_arm(tmp_path):
         "HVD_COMPRESS": "int8",
         # wire arm pinned off: covered by test_wire.py::test_autotune_wire_arm
         "HVD_WIRE": "basic",
-        "EXPECT_ARMS": "4",
+        "EXPECT_DIMS": "2",
     }, timeout=240)
-    rows = [l for l in log.read_text().splitlines()[1:5]
+    # d+1 = 3 probe rows: baseline, cache flipped, compress flipped.
+    rows = [l for l in log.read_text().splitlines()[1:4]
             if not l.startswith("#")]
     assert {l.split(",")[9] for l in rows} == {"0", "1"}, rows
 
@@ -189,15 +190,23 @@ def test_autotune_compress_arm(tmp_path):
 def test_arm_space_is_two_to_the_eighth():
     """kMaxArms covers the full 2^8 categorical space: eight toggleable
     dimensions (cache, hier, zerocopy, pipeline, shm, bucket, compress,
-    wire — ISSUE 12) need 256 arm slots, and the Configure nest
-    enumerates one loop per dimension."""
+    wire — ISSUE 12) need 256 arm slots. v2 (ISSUE 18) replaces the
+    exhaustive Configure nest with a bit-lattice the bandit searches:
+    every dim must be an AutotuneDim enum bit with init_/can_toggle_
+    config fields, and the lattice size must be 2^dims."""
     src = open(os.path.join(_CSRC, "autotune.h")).read()
     m = re.search(r"kMaxArms\s*=\s*(\d+)", src)
     assert m and int(m.group(1)) == 256, m
-    cc = open(os.path.join(_CSRC, "autotune.cc")).read()
     for dim in ("cache", "hier", "zerocopy", "pipeline", "shm", "bucket",
                 "compress", "wire"):
-        assert re.search(r"can_toggle_%s\s*\?\s*2\s*:\s*1" % dim, cc), dim
+        assert re.search(r"kDim%s\b" % dim.capitalize(), src), dim
+        assert re.search(r"\binit_%s\b" % dim, src), dim
+        assert re.search(r"\bcan_toggle_%s\b" % dim, src), dim
+    cc = open(os.path.join(_CSRC, "autotune.cc")).read()
+    assert re.search(r"arm_count_\s*=\s*1\s*<<\s*dim_count_", cc)
+    # ...and the shared CSV schema carries one column per dim.
+    from horovod_tpu.observability import autotune_csv
+    assert len(autotune_csv.ARM_COLUMNS) == 8, autotune_csv.ARM_COLUMNS
 
 
 # --- sanitizer tiers --------------------------------------------------------

@@ -103,9 +103,9 @@ def test_shm_threshold_fallback_2rank():
 def test_autotune_shm_arm(tmp_path):
     """The shm routing toggle as an autotune categorical arm: on a
     2-rank single-host pod with zerocopy and ring-pipeline pinned off,
-    the sweep walks all 8 (cache, hier, shm) combinations, locks one,
-    and ships it in the ResponseList (autotune_worker.py asserts the CSV
-    arm walk and the lock)."""
+    the (cache, hier, shm) probe rows flip each dim once, the bandit
+    locks a winner, and ships it in the ResponseList (autotune_worker.py
+    asserts the CSV phase walk and the lock)."""
     log = tmp_path / "autotune_shm.csv"
     run_worker_job(2, "autotune_worker.py", extra_env={
         "HVD_AUTOTUNE": "1",
@@ -114,15 +114,14 @@ def test_autotune_shm_arm(tmp_path):
         "HVD_AUTOTUNE_MAX_SAMPLES": "12",
         "HVD_ZEROCOPY": "0",
         "HVD_RING_PIPELINE": "1",
-        # bucket arm off: 16 arms would outgrow the 12-sample budget
-        # (covered by test_bucket.py::test_autotune_bucket_arm)
+        # bucket arm off: covered by test_bucket.py::test_autotune_bucket_arm
         "HVD_BUCKET": "0",
         # wire arm pinned off: covered by test_wire.py::test_autotune_wire_arm
         "HVD_WIRE": "basic",
-        "EXPECT_ARMS": "8",
+        "EXPECT_DIMS": "3",
     }, timeout=240)
-    # The shm column really swept both states.
-    rows = [l for l in log.read_text().splitlines()[1:9]
+    # The shm column really swept both states (d+1 = 4 probe rows).
+    rows = [l for l in log.read_text().splitlines()[1:5]
             if not l.startswith("#")]
     assert {l.split(",")[7] for l in rows} == {"0", "1"}, rows
 

@@ -187,6 +187,26 @@ def test_missing_arm_stats_is_reported(tmp_path):
     assert "newarm_stats()" in vs[0].message
 
 
+def test_csv_schema_skew_is_reported(tmp_path):
+    # The C++ writer's header literal and the shared Python schema table
+    # (observability/autotune_csv.py COLUMNS) must agree exactly — a
+    # drifted column order silently skews every by-name consumer.
+    root = _seed_repo(tmp_path)
+    csrc = root / "horovod_tpu" / "csrc"
+    (csrc / "autotune.cc").write_text(
+        'void Hdr() { fprintf(f, "sample,cache,score_mbps\\n"); }\n')
+    obs = root / "horovod_tpu" / "observability"
+    obs.mkdir()
+    (obs / "autotune_csv.py").write_text(
+        'COLUMNS = ("sample", "cache", "score_mbps")\n')
+    assert _by_rule(hvdlint.run(str(root)), "arm-stats") == []
+    (obs / "autotune_csv.py").write_text(
+        'COLUMNS = ("sample", "hier", "score_mbps")\n')
+    vs = _by_rule(hvdlint.run(str(root)), "arm-stats")
+    assert len(vs) == 1 and vs[0].symbol == "COLUMNS", vs
+    assert "header literal" in vs[0].message
+
+
 def test_counter_after_complete_is_reported(tmp_path):
     root = _seed_repo(tmp_path)
     (root / "horovod_tpu" / "csrc" / "core.cc").write_text(

@@ -170,13 +170,14 @@ _AUTOTUNE_ENV = {
 
 def test_autotune_wire_arm(tmp_path):
     """The wire tier as the eighth categorical arm: when the probe
-    succeeds, a 2-rank sweep walks all 4 (cache, wire) combinations and
-    the wire CSV column really takes both states."""
+    succeeds, the (cache, wire) lattice's probe rows flip the wire dim
+    and the wire CSV column really takes both states."""
     log = tmp_path / "autotune_wire.csv"
     run_worker_job(2, "autotune_worker.py", timeout=240,
                    extra_env=dict(_AUTOTUNE_ENV, HVD_AUTOTUNE_LOG=str(log),
-                                  EXPECT_ARMS="4"))
-    rows = [l for l in log.read_text().splitlines()[1:5]
+                                  EXPECT_DIMS="2"))
+    # d+1 = 3 probe rows: baseline, cache flipped, wire flipped.
+    rows = [l for l in log.read_text().splitlines()[1:4]
             if not l.startswith("#")]
     assert {l.split(",")[10] for l in rows} == {"0", "1"}, rows
 
@@ -185,12 +186,12 @@ def test_autotune_wire_arm_absent_when_probe_fails(tmp_path):
     """The acceptance guard: the arm exists ONLY where the probe
     succeeded. With every rung denied the mesh lands on basic, both arm
     settings would measure the identical sendmsg path, and the sweep
-    must not waste samples on it — 2 arms (cache only), wire pinned 0."""
+    must not waste samples on it — one dim (cache only), wire pinned 0."""
     log = tmp_path / "autotune_wire_denied.csv"
     run_worker_job(2, "autotune_worker.py", timeout=240,
                    extra_env=dict(_AUTOTUNE_ENV, HVD_AUTOTUNE_LOG=str(log),
                                   HVD_WIRE_PROBE_FAIL="6",
-                                  EXPECT_ARMS="2"))
+                                  EXPECT_DIMS="1"))
     rows = [l for l in log.read_text().splitlines()[1:]
             if not l.startswith("#") and l]
     assert {l.split(",")[10] for l in rows} == {"0"}, rows

@@ -1,17 +1,28 @@
-"""Worker: autotune drives fusion threshold + cycle time on a synthetic
-gradient stream (reference: parameter_manager.cc GP+EI, HOROVOD_AUTOTUNE,
-HOROVOD_AUTOTUNE_LOG). Run with HVD_AUTOTUNE=1 and fast sampling knobs.
+"""Worker: autotune v2 drives the bandit arm search + GP numeric tuning on
+a synthetic gradient stream (reference: parameter_manager.cc GP+EI,
+HOROVOD_AUTOTUNE, HOROVOD_AUTOTUNE_LOG; docs/autotune.md "v2 search").
 
 Asserts: parameters measurably change from their defaults, the search
-eventually locks, the CSV log on rank 0 records one row per sample, and
-every collective result stays correct while parameters move underneath.
+eventually locks, and the rank-0 CSV log matches the shared schema
+(observability/autotune_csv.py): d+1 probe rows walking every toggleable
+dim once at a pinned numeric point, halving rounds, then the GP phase
+under ONE locked arm — with collective results staying correct while
+parameters move underneath.
+
+Env contract (all optional):
+  AT_LOCAL_SIZE        fake multi-host topology (hier arm toggleable)
+  AT_PIPE_SCHEDULE     register a pipeline schedule (CSV `schedule` col)
+  EXPECT_DIMS          exact toggleable-dim count to assert
+  EXPECT_DIMS_MIN      lower bound instead (env-dependent dims, e.g. wire)
+  AT_PROFILE_EXPECT    expected CSV/stats profile state ("fresh",
+                       "adopted", "near", "corrupt"); "adopted" also
+                       asserts 0 sweep samples and an empty sweep log
 """
 import os
 
 # Optional fake multi-host topology (hier_worker.py convention): makes the
-# hierarchical-allreduce arm toggleable, so the categorical sweep covers
-# all 16 (cache, hier, zerocopy, pipeline) combinations. Without it
-# cross_size == 1 and the manager correctly skips the no-op hier arm.
+# hierarchical-allreduce arm toggleable. Without it cross_size == 1 and
+# the manager correctly skips the no-op hier arm.
 _L = os.environ.get("AT_LOCAL_SIZE")
 if _L:
     _r = int(os.environ["HVD_RANK"])
@@ -25,6 +36,7 @@ if _L:
 import numpy as np
 
 import horovod_tpu as hvd
+from horovod_tpu.observability import autotune_csv
 
 hvd.init()
 r, s = hvd.rank(), hvd.size()
@@ -36,61 +48,119 @@ if _SCHED:
     from horovod_tpu.basics import basics as _basics
     assert _basics.register_pipeline_workload(_SCHED)
 
+profile_expect = os.environ.get("AT_PROFILE_EXPECT", "")
 status0, fusion0, cycle0 = hvd.autotune_state()
-assert status0 == "searching", status0
+if profile_expect != "adopted":
+    assert status0 == "searching", status0
 default_fusion = 64 * 1024 * 1024
 
+# The sample budget derives from the arm count when HVD_AUTOTUNE_MAX_SAMPLES
+# is unset/0 (Configure is deterministic from env + topology, so every rank
+# computes the same number — safe to drive loop bounds from it).
+budget = hvd.autotune_stats()["budget"]
+assert budget > 0, budget
+
+# Chunked stream with a symmetric stop vote: collectives must stay
+# symmetric, so no rank may data-dependently break first — instead every
+# chunk ends with an allreduced "I'm locked" vote and all ranks exit
+# together once unanimous. The cap covers the halving windows' geometric
+# growth (cycles_per_sample << round) with generous slack.
 saw_change = False
-max_samples = int(os.environ.get("HVD_AUTOTUNE_MAX_SAMPLES", "30"))
-# Fixed iteration count on every rank: collectives must stay symmetric, so
-# no data-dependent early exit (a rank breaking first would strand peers).
-for i in range(30 * max_samples):
-    out = hvd.allreduce(np.full((256,), float(r + 1), np.float32),
-                        op=hvd.Sum, name=f"g{i % 4}")
-    assert np.allclose(out, sum(range(1, s + 1))), out[0]
+it = 0
+for _chunk in range(20 * budget):
+    for _ in range(8):
+        out = hvd.allreduce(np.full((256,), float(r + 1), np.float32),
+                            op=hvd.Sum, name=f"g{it % 4}")
+        assert np.allclose(out, sum(range(1, s + 1))), out[0]
+        it += 1
     status, fusion, cycle = hvd.autotune_state()
     if fusion != default_fusion or cycle != 1.0:
         saw_change = True
+    locked = hvd.allreduce(
+        np.full((1,), 1.0 if status == "locked" else 0.0, np.float32),
+        op=hvd.Sum, name="at_locked_vote")
+    if locked[0] >= s:
+        break
 
 status, fusion, cycle = hvd.autotune_state()
-assert saw_change, "autotune never changed the live parameters"
 assert status == "locked", (status, fusion, cycle)
+stats = hvd.autotune_stats()
+
+if r == 0:
+    # The search ran on this rank: cross-check the stats surface.
+    assert stats["status"] == "locked", stats
+    exp_dims = os.environ.get("EXPECT_DIMS")
+    if exp_dims is not None:
+        assert stats["dims"] == int(exp_dims), (stats, exp_dims)
+    exp_dims_min = os.environ.get("EXPECT_DIMS_MIN")
+    if exp_dims_min is not None:
+        assert stats["dims"] >= int(exp_dims_min), (stats, exp_dims_min)
+    assert stats["arms"] == 2 ** stats["dims"], stats
+    if profile_expect:
+        assert stats["profile"] == profile_expect, stats
+    if profile_expect == "adopted":
+        # Second identical job: the persisted profile was adopted with
+        # ZERO sweep samples (the acceptance headline).
+        assert stats["adopted_profile"] and stats["samples"] == 0, stats
+    else:
+        assert not stats["adopted_profile"], stats
+        assert stats["samples"] == stats["budget"], stats
+        assert saw_change, "autotune never changed the live parameters"
 
 log_path = os.environ.get("HVD_AUTOTUNE_LOG", "")
 if r == 0 and log_path:
     with open(log_path) as f:
         lines = [l for l in f.read().splitlines() if l]
-    assert lines[0] == \
-        "sample,fusion_kb,cycle_ms,cache,hier,zerocopy,pipeline,shm," \
-        "bucket,compress,wire,affinity,schedule,score_mbps", \
-        lines[:1]
-    rows = [l for l in lines[1:] if not l.startswith("#")]
-    assert len(rows) == max_samples, (len(rows), max_samples)
+    assert lines[0] == autotune_csv.HEADER, lines[:1]
+    rows = [autotune_csv.split_row(l) for l in lines[1:]
+            if not l.startswith("#")]
     assert any(l.startswith("# final") for l in lines), lines[-2:]
-    # The schedule column is a recorded context field: "-" until a
-    # pipeline workload registers, the registered label afterwards.
-    want_sched = _SCHED or "-"
-    assert all(l.split(",")[12] == want_sched for l in rows), \
-        (want_sched, rows[:2])
-    # More than one distinct numeric point was actually explored.
-    points = {tuple(l.split(",")[1:3]) for l in rows}
-    assert len(points) >= 3, points
-    # The categorical sweep ran: the first rows walk every TOGGLEABLE
-    # (cache, hier, zerocopy, pipeline, shm, bucket, compress, wire) arm
-    # at a pinned numeric point (reference: parameter_manager.cc
-    # categorical layers before numeric tuning). Up to 2^8 = 256 arms;
-    # HVD_ZEROCOPY=0, HVD_RING_PIPELINE=1, HVD_SHM=0, HVD_BUCKET=0, no
-    # HVD_COMPRESS codec, HVD_WIRE=basic (or a probe-refused kernel), an
-    # invalid topology, or single-rank each remove a dimension.
-    n_arms = int(os.environ.get("EXPECT_ARMS", "8"))
-    arms = [tuple(l.split(",")[3:11]) for l in rows[:n_arms]]
-    assert len(set(arms)) == n_arms, arms
-    numeric_pts = {tuple(l.split(",")[1:3]) for l in rows[:n_arms]}
-    assert len(numeric_pts) == 1, numeric_pts
-    # ...and the numeric phase runs under ONE locked arm.
-    tail_arms = {tuple(l.split(",")[3:11]) for l in rows[n_arms:]}
-    assert len(tail_arms) == 1, tail_arms
+    want_profile = profile_expect or ("fresh" if os.environ.get(
+        "HVD_AUTOTUNE_PROFILE_DIR") else "-")
+    if profile_expect == "adopted":
+        # No sweep rows at all; the log records the adoption + final only.
+        assert not rows, rows[:2]
+        assert any(l.startswith("# adopted") for l in lines), lines
+    else:
+        assert len(rows) == stats["budget"], (len(rows), stats)
+        assert all(row["profile"] == want_profile for row in rows), \
+            (want_profile, rows[0])
+        # The schedule column is a recorded context field: "-" until a
+        # pipeline workload registers, the registered label afterwards.
+        want_sched = _SCHED or "-"
+        assert all(row["schedule"] == want_sched for row in rows), \
+            (want_sched, rows[:2])
+        d = stats["dims"]
+
+        def arm_of(row):
+            return tuple(row[c] for c in autotune_csv.ARM_COLUMNS)
+
+        def pt_of(row):
+            return (row["fusion_kb"], row["cycle_ms"])
+
+        # Probe phase: d+1 rows (baseline + each dim flipped alone), every
+        # toggleable dim observed in both states, all at ONE pinned
+        # numeric point so arm scores stay comparable.
+        probes = rows[:d + 1]
+        assert all(row["bracket"] == "probe" for row in probes), probes
+        assert len({arm_of(row) for row in probes}) == d + 1, probes
+        varying = sum(1 for c in autotune_csv.ARM_COLUMNS
+                      if len({row[c] for row in probes}) == 2)
+        assert varying == d, (varying, d, probes)
+        assert len({pt_of(row) for row in probes}) == 1, probes
+        # After the probes: halving rounds (h<r>), numerically pinned like
+        # the probes, then the GP phase under ONE locked arm.
+        tail = rows[d + 1:]
+        assert all(row["bracket"][0] in "hg" for row in tail), tail[:2]
+        halving = [row for row in tail if row["bracket"].startswith("h")]
+        assert len({pt_of(row) for row in probes + halving}) == 1, halving
+        gp = [row for row in tail if row["bracket"] == "gp"]
+        assert gp, "numeric phase never ran"
+        assert len({arm_of(row) for row in gp}) == 1, gp
+        # More than one distinct numeric point was actually explored.
+        assert len({pt_of(row) for row in rows}) >= 3, rows
 
 hvd.shutdown()
-print(f"rank {r}: autotune PASS fusion={fusion} cycle={cycle:.3f}",
+print(f"rank {r}: autotune PASS fusion={fusion} cycle={cycle:.3f} "
+      f"samples={stats['samples']} profile={stats['profile']}",
       flush=True)

@@ -13,10 +13,14 @@ system and to pytest, so this lint parses the sources and enforces:
   arm-stats      every autotune categorical arm (`int8_t tuned_X` in
                  csrc/common.h) has a matching `X_stats()` introspection
                  in basics.py, a column named X in autotune.cc's CSV
-                 header, and `init_X`/`can_toggle_X` parameters on
-                 Autotuner::Configure (autotune.h) — the three places a
-                 new arm must be threaded through or the sweep silently
-                 never walks it
+                 header, and `init_X`/`can_toggle_X` fields on
+                 AutotuneConfig (autotune.h) — the three places a new
+                 arm must be threaded through or the search silently
+                 never walks it; additionally the C++ CSV header literal
+                 must equal the shared schema table
+                 (horovod_tpu/observability/autotune_csv.py COLUMNS) so
+                 the writer and every Python consumer split rows the
+                 same way
   config-parity  config_parser.ARG_TO_ENV attrs <-> launch.py CLI flags
                  <-> _FILE_SECTIONS YAML keys stay in sync (both ways
                  for YAML, env->CLI for flags)
@@ -165,6 +169,25 @@ def _autotune_csv_columns(src):
     return joined.replace("\\n", "").split(",")
 
 
+def _schema_columns(root):
+    """COLUMNS from horovod_tpu/observability/autotune_csv.py (the shared
+    schema table), parsed via ast so linting never imports the package, or
+    None when the module/table is absent."""
+    path = os.path.join(root, "horovod_tpu", "observability",
+                        "autotune_csv.py")
+    if not os.path.exists(path):
+        return None, path
+    for node in ast.walk(ast.parse(_read(path))):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "COLUMNS"
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            cols = [e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)]
+            return cols, path
+    return None, path
+
+
 def check_arm_stats(root):
     common = os.path.join(root, "horovod_tpu", "csrc", "common.h")
     basics = os.path.join(root, "horovod_tpu", "basics.py")
@@ -178,6 +201,18 @@ def check_arm_stats(root):
     if os.path.exists(at_cc):
         csv_cols = _autotune_csv_columns(_read(at_cc))
     out = []
+    # The C++ writer's header literal and the shared Python schema table
+    # must be the SAME row layout, or every consumer slicing columns by
+    # name (worker asserts, bench.py autotune, operator tooling) reads
+    # skewed fields.
+    schema_cols, schema_path = _schema_columns(root)
+    if csv_cols is not None and schema_cols is not None \
+            and csv_cols != schema_cols:
+        out.append(Violation(
+            "arm-stats", _rel(root, schema_path), 1, "COLUMNS",
+            "autotune_csv.COLUMNS (%s) != the CSV header literal in "
+            "autotune.cc (%s)" % (",".join(schema_cols),
+                                  ",".join(csv_cols))))
     for i, line in enumerate(_read(common).splitlines(), 1):
         for m in re.finditer(r"\bint8_t\s+tuned_([a-z0-9_]+)", line):
             arm = m.group(1)
