@@ -1554,6 +1554,147 @@ def _compress_bench_worker():
     hvd.shutdown()
 
 
+def _bench_alltoall():
+    """Tiered alltoallv A/B through the C++ host plane (ISSUE 19
+    acceptance): an MoE expert-dispatch-shaped alltoallv stream run
+    under {basic, shm, uring} x {off, int8} at each BENCH_ALLTOALL_RANKS
+    pod size. Records per-cell dispatch tokens/s and alltoallv GB/s, the
+    shm-vs-basic bandwidth ratio at the largest pod (must clear 1.5x at
+    8 ranks), the int8 wire-byte reduction (must clear 3.5x), and output
+    digests — the uncompressed tiers must be bit-identical (the tiers
+    move bytes, they never round). Same caveat as _bench_hostplane:
+    loopback TCP is a scaling signal, not an ICI claim."""
+    import tempfile
+
+    from horovod_tpu.runner.local import run_local
+
+    rank_list = sorted(int(v) for v in os.environ.get(
+        "BENCH_ALLTOALL_RANKS", "2,4,8").split(","))
+    tiers = (
+        ("basic", {"HVD_SHM": "0", "HVD_WIRE": "basic"}),
+        ("shm", {"HVD_SHM_THRESHOLD": "0", "HVD_WIRE": "basic"}),
+        ("uring", {"HVD_SHM": "0", "HVD_WIRE": "uring",
+                   "HVD_ZEROCOPY_THRESHOLD": "16384"}),
+    )
+    codecs = (
+        ("off", {}),
+        ("int8", {"HVD_COMPRESS": "int8", "HVD_ALLTOALL_COMPRESS": "1"}),
+    )
+    cells = {}
+    for np_ in rank_list:
+        for tier, tier_env in tiers:
+            for codec, codec_env in codecs:
+                fd, out_path = tempfile.mkstemp(prefix="hvd_bench_a2a_")
+                os.close(fd)
+                try:
+                    env = {"PYTHONPATH":
+                           _repo_pythonpath(os.environ.get("PYTHONPATH")),
+                           "JAX_PLATFORMS": "cpu",
+                           "_BENCH_ALLTOALL_WORKER": "1",
+                           "_BENCH_ALLTOALL_OUT": out_path}
+                    env.update(tier_env)
+                    env.update(codec_env)
+                    codes = run_local(
+                        np_, [sys.executable, os.path.abspath(__file__)],
+                        env=env, timeout=90)
+                    if codes != [0] * np_:
+                        raise RuntimeError(
+                            f"alltoall[{tier}+{codec}@{np_}] exited {codes}")
+                    with open(out_path) as f:
+                        cells[(tier, codec, np_)] = json.load(f)
+                finally:
+                    try:
+                        os.unlink(out_path)
+                    except OSError:
+                        pass
+    per_cell = {}
+    for (tier, codec, np_), rec in cells.items():
+        per_cell[f"{tier}+{codec}@{np_}"] = {
+            "tokens_per_s": rec["tokens_per_s"],
+            "alltoallv_gbps": rec["alltoallv_gbps"],
+            "shm_ops": rec["shm_ops"], "sg_rounds": rec["sg_rounds"],
+            "wire_ratio": rec.get("wire_ratio"),
+        }
+    big = rank_list[-1]
+    for np_ in rank_list:
+        # Bit-identity across the uncompressed tiers: same seeded stream,
+        # same rank-ordered output digests on every tier.
+        d0 = cells[("basic", "off", np_)]["digests"]
+        for tier, _ in tiers[1:]:
+            assert cells[(tier, "off", np_)]["digests"] == d0, (tier, np_)
+        # Each cell really took its tier (and ONLY its tier).
+        for codec, _ in codecs:
+            assert cells[("shm", codec, np_)]["shm_ops"] > 0
+            assert cells[("uring", codec, np_)]["sg_rounds"] > 0
+            assert cells[("basic", codec, np_)]["shm_ops"] == 0
+            assert cells[("basic", codec, np_)]["sg_rounds"] == 0
+    speedup = round(cells[("shm", "off", big)]["alltoallv_gbps"]
+                    / cells[("basic", "off", big)]["alltoallv_gbps"], 2)
+    wire_ratio = cells[("shm", "int8", big)]["wire_ratio"]
+    cores = len(os.sched_getaffinity(0))
+    d = {"metric": "alltoallv_shm_vs_basic_speedup", "value": speedup,
+         "unit": "x (shm alltoallv GB/s / basic, loopback, largest pod)",
+         "rank_list": rank_list, "int8_wire_ratio": wire_ratio,
+         "cells": per_cell, "cpu_cores": cores,
+         "shm_floor_checked": bool(big >= 8 and cores >= big),
+         "vs_baseline": 1.0}
+    # Byte-count floor is deterministic — holds on any box. The timing
+    # floor (shm >= 1.5x basic at 8 ranks) is only meaningful when the
+    # ranks actually run in parallel; on an oversubscribed box both
+    # tiers serialize onto the same core and the ratio washes toward 1,
+    # so record it and only enforce where the hardware can show it.
+    assert wire_ratio is not None and wire_ratio >= 3.5, per_cell
+    if d["shm_floor_checked"]:
+        assert speedup >= 1.5, per_cell
+    return d
+
+
+def _alltoall_bench_worker():
+    """Rank body for _bench_alltoall (spawned with _BENCH_ALLTOALL_WORKER
+    set). One MoE-dispatch-shaped f32 alltoallv (uniform splits, `rows`
+    tokens per peer) repeated for `iters` steady-state steps; rank 0
+    writes tokens/s + GB/s + digest + tier/codec counter JSON."""
+    import hashlib
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r, s = hvd.rank(), hvd.size()
+    rows = int(os.environ.get("_BENCH_ALLTOALL_ROWS", "65536"))
+    D = 8
+    iters = int(os.environ.get("_BENCH_ALLTOALL_ITERS", "6"))
+    rng = np.random.RandomState(7 + r)
+    x = rng.rand(rows * s, D).astype(np.float32) * 2.0 - 1.0
+    out = hvd.alltoall(x, name="dispatch")  # warm: dial + negotiate
+    hvd.barrier()
+    ops0, bytes0, shm0, sg0 = hvd.alltoall_stats()
+    c0 = hvd.compress_stats()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = hvd.alltoall(x, name="dispatch")
+    dt = time.perf_counter() - t0
+    ops1, bytes1, shm1, sg1 = hvd.alltoall_stats()
+    c1 = hvd.compress_stats()
+    assert ops1 - ops0 == iters, (ops0, ops1, iters)
+    digest = hashlib.sha256(np.ascontiguousarray(out).tobytes()).hexdigest()
+    digests = hvd.allgather_object(digest)
+    wire_ratio = None
+    if c1["int8_ops"] > c0["int8_ops"]:
+        wire_ratio = round((c1["raw_bytes"] - c0["raw_bytes"])
+                           / max(1, c1["wire_bytes"] - c0["wire_bytes"]), 2)
+    if r == 0:
+        with open(os.environ["_BENCH_ALLTOALL_OUT"], "w") as f:
+            json.dump({
+                "tokens_per_s": round(rows * s * iters / dt, 1),
+                "alltoallv_gbps": round((bytes1 - bytes0) / dt / 1e9, 4),
+                "digests": digests,
+                "shm_ops": shm1 - shm0, "sg_rounds": sg1 - sg0,
+                "wire_ratio": wire_ratio, "iters": iters,
+                "payload_bytes": int(x.nbytes)}, f)
+    hvd.barrier()
+    hvd.shutdown()
+
+
 def _bench_bridge():
     """16 MB bridged eager allreduce (ISSUE 4 tentpole): the dlpack /
     buffer-protocol zero-copy bridge vs a forced-copy A/B on a 2-rank
@@ -2514,6 +2655,7 @@ _CONFIG_FNS = {
     "serve": _bench_serve,
     "ckpt": _bench_ckpt,
     "autotune": _bench_autotune,
+    "alltoall": _bench_alltoall,
 }
 
 _METRIC_NAMES = {
@@ -2537,6 +2679,8 @@ _METRIC_NAMES = {
              "x (async save blocked-ms / sync save blocked-ms)"),
     "autotune": ("autotune_bandit_sample_fraction",
                  "fraction of the 256-arm exhaustive sweep measured"),
+    "alltoall": ("alltoallv_shm_vs_basic_speedup",
+                 "x (shm alltoallv GB/s / basic, loopback, largest pod)"),
 }
 
 # Per-config wall caps (seconds). Only bind when something hangs; healthy
@@ -2581,8 +2725,13 @@ _CONFIG_CAPS = {
     "ckpt": 300,
     # In-process sim headline (seconds) + two sequential 2-rank pods for
     # the profile-adoption A/B; a tight sub-budget sheds the pods, never
-    # the sim. Runs LAST in the order: newest config, shed first.
+    # the sim. Runs second-to-last in the order; only the alltoall
+    # matrix sheds before it.
     "autotune": 210,
+    # {basic, shm, uring} x {off, int8} at each BENCH_ALLTOALL_RANKS pod
+    # size (18 pods by default, each a few seconds of loopback alltoallv).
+    # Runs LAST in the order: newest config, shed first.
+    "alltoall": 300,
 }
 
 _PROBE_TIMEOUT = 75
@@ -2819,7 +2968,7 @@ def main():
     results = {}
     order = ["resnet50", "transformer", "allreduce", "longctx", "hostplane",
              "bucket", "compress", "bridge", "reduce", "moe", "elastic",
-             "pipeline", "serve", "ckpt", "autotune"]
+             "pipeline", "serve", "ckpt", "autotune", "alltoall"]
     for name in order:
         cap = _cap(name)
         left = remaining() - 15  # reserve for final assembly
@@ -2874,5 +3023,7 @@ if __name__ == "__main__":
         _ckpt_bench_worker()
     elif os.environ.get("_BENCH_AUTOTUNE_WORKER") == "1":
         _autotune_bench_worker()
+    elif os.environ.get("_BENCH_ALLTOALL_WORKER") == "1":
+        _alltoall_bench_worker()
     else:
         main()

@@ -158,6 +158,10 @@ compress_state = _basics.compress_state
 set_compression = _basics.set_compression
 wire_stats = _basics.wire_stats
 wire_state = _basics.wire_state
+alltoall_stats = _basics.alltoall_stats
+alltoall_state = _basics.alltoall_state
+ep_report = _basics.ep_report
+ep_stats = _basics.ep_stats
 reduce_pool_stats = _basics.reduce_pool_stats
 hier_stats = _basics.hier_stats
 elastic_stats = _basics.elastic_stats

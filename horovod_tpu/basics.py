@@ -230,6 +230,14 @@ _lib.hvd_wire_stats.argtypes = [P_int64, P_int64, P_int64, P_int64, P_int64,
                                 P_int64, P_int64, P_int64, P_int64, P_int64]
 _lib.hvd_wire_state.restype = c_int
 _lib.hvd_wire_state.argtypes = [P_int64, P_int64, P_int64, P_int64]
+_lib.hvd_alltoall_stats.restype = c_int
+_lib.hvd_alltoall_stats.argtypes = [P_int64, P_int64, P_int64, P_int64]
+_lib.hvd_alltoall_state.restype = c_int
+_lib.hvd_alltoall_state.argtypes = [P_int64]
+_lib.hvd_ep_report.restype = c_int
+_lib.hvd_ep_report.argtypes = [c_double, c_int64, c_int64]
+_lib.hvd_ep_stats.restype = c_int
+_lib.hvd_ep_stats.argtypes = [P_int64, P_int64, P_int64, P_int64]
 
 
 def last_error():
@@ -649,6 +657,69 @@ class HorovodBasics:
         return (names.get(rc, "basic"), names.get(probed.value, "basic"),
                 names.get(agreed.value, "basic"), failures.value,
                 pinned.value)
+
+    def alltoall_stats(self):
+        """(ops, bytes, shm_ops, sg_rounds) for the tiered alltoallv
+        (HVD_ALLTOALL / the autotune `alltoall` arm): exchanges executed,
+        non-self payload bytes sent, exchanges whose whole pairwise
+        schedule rode the intra-host shm plane, and pairwise rounds that
+        took the SG io_uring linked-wave path. shm_ops/sg_rounds stay 0
+        with HVD_ALLTOALL=basic — the kill-switch proof the acceptance
+        tests pin."""
+        ops = c_int64(0)
+        nbytes = c_int64(0)
+        shm_ops = c_int64(0)
+        sg_rounds = c_int64(0)
+        rc = _lib.hvd_alltoall_stats(
+            ctypes.byref(ops), ctypes.byref(nbytes),
+            ctypes.byref(shm_ops), ctypes.byref(sg_rounds))
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        return ops.value, nbytes.value, shm_ops.value, sg_rounds.value
+
+    def alltoall_state(self):
+        """(tiered, compress_opt_in): whether alltoallv currently routes
+        through the shm/SG tiers (HVD_ALLTOALL=auto AND the autotune
+        `alltoall` arm on) and whether expert dispatch opted into the int8
+        wire codec (HVD_ALLTOALL_COMPRESS — engages only while the int8
+        codec is live)."""
+        opt_in = c_int64(0)
+        rc = _lib.hvd_alltoall_state(ctypes.byref(opt_in))
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        return bool(rc), bool(opt_in.value)
+
+    def ep_report(self, dropped_fraction, tokens, dropped_tokens):
+        """Publish one expert-dispatch capacity report: tokens the router
+        saw, tokens the capacity-factor clamp dropped, and the dropped
+        fraction. Feeds the EP_* gauges read back by ep_stats."""
+        rc = _lib.hvd_ep_report(c_double(float(dropped_fraction)),
+                                c_int64(int(tokens)),
+                                c_int64(int(dropped_tokens)))
+        if rc == -1:
+            raise ValueError("horovod_tpu has not been initialized")
+        if rc < 0:
+            raise ValueError(
+                "invalid ep report: tokens=%r dropped=%r"
+                % (tokens, dropped_tokens))
+        return rc
+
+    def ep_stats(self):
+        """(reports, tokens, dropped_tokens, last_dropped_fraction) for
+        expert-parallel capacity-factor routing: dispatches reported via
+        ep_report, cumulative token/drop counts, and the most recent
+        dropped fraction."""
+        reports = c_int64(0)
+        tokens = c_int64(0)
+        dropped = c_int64(0)
+        last_micro = c_int64(0)
+        rc = _lib.hvd_ep_stats(
+            ctypes.byref(reports), ctypes.byref(tokens),
+            ctypes.byref(dropped), ctypes.byref(last_micro))
+        if rc < 0:
+            raise ValueError("horovod_tpu has not been initialized")
+        return (reports.value, tokens.value, dropped.value,
+                last_micro.value / 1e6)
 
     def reduce_pool_stats(self):
         """(threads, jobs, spans): configured reduce-pool lanes

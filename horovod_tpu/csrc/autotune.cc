@@ -137,12 +137,13 @@ void ParameterManager::Configure(const AutotuneConfig& cfg) {
   const bool init_vals[kNumAutotuneDims] = {
       cfg.init_cache,  cfg.init_hier,   cfg.init_zerocopy,
       cfg.init_pipeline, cfg.init_shm,  cfg.init_bucket,
-      cfg.init_compress, cfg.init_wire};
+      cfg.init_compress, cfg.init_wire, cfg.init_alltoall};
   const bool togg[kNumAutotuneDims] = {
       cfg.can_toggle_cache,  cfg.can_toggle_hier,
       cfg.can_toggle_zerocopy, cfg.can_toggle_pipeline,
       cfg.can_toggle_shm,    cfg.can_toggle_bucket,
-      cfg.can_toggle_compress, cfg.can_toggle_wire};
+      cfg.can_toggle_compress, cfg.can_toggle_wire,
+      cfg.can_toggle_alltoall};
   dim_count_ = 0;
   dims_mask_ = 0;
   for (int d = 0; d < kNumAutotuneDims; d++) {
@@ -153,7 +154,7 @@ void ParameterManager::Configure(const AutotuneConfig& cfg) {
       dims_mask_ |= 1u << d;
     }
   }
-  arm_count_ = 1 << dim_count_;  // <= kMaxArms (2^8)
+  arm_count_ = 1 << dim_count_;  // <= kMaxArms (2^9)
   cur_arm_ = 0;
 
   // Budget + bracket. With HVD_AUTOTUNE_MAX_SAMPLES unset/0 the budget
@@ -189,8 +190,8 @@ void ParameterManager::Configure(const AutotuneConfig& cfg) {
       // horovod_tpu/observability/autotune_csv.py. Keep them identical.
       fprintf(log_,
               "sample,fusion_kb,cycle_ms,cache,hier,zerocopy,pipeline,shm,"
-              "bucket,compress,wire,affinity,schedule,bracket,profile,"
-              "score_mbps\n");
+              "bucket,compress,wire,alltoall,affinity,schedule,bracket,"
+              "profile,score_mbps\n");
   }
   // First sample point = warmup[0]; adopted on the first Record proposal.
   memcpy(cur_x_, kWarmup[0], sizeof(cur_x_));
@@ -470,7 +471,8 @@ void ParameterManager::FillOutputs(int64_t* fusion, double* cycle_ms,
                                    int* cache_on, int* hier_on,
                                    int* zerocopy_on, int* pipeline_on,
                                    int* shm_on, int* bucket_on,
-                                   int* compress_on, int* wire_on) const {
+                                   int* compress_on, int* wire_on,
+                                   int* alltoall_on) const {
   ToParams(cur_x_, fusion, cycle_ms);
   *cache_on = ArmValue(cur_arm_, kDimCache) ? 1 : 0;
   *hier_on = ArmValue(cur_arm_, kDimHier) ? 1 : 0;
@@ -480,6 +482,7 @@ void ParameterManager::FillOutputs(int64_t* fusion, double* cycle_ms,
   *bucket_on = ArmValue(cur_arm_, kDimBucket) ? 1 : 0;
   *compress_on = ArmValue(cur_arm_, kDimCompress) ? 1 : 0;
   *wire_on = ArmValue(cur_arm_, kDimWire) ? 1 : 0;
+  *alltoall_on = ArmValue(cur_arm_, kDimAlltoall) ? 1 : 0;
 }
 
 const char* ParameterManager::BracketLabel() const {
@@ -514,7 +517,7 @@ void ParameterManager::EmitCsvRow(const char* sample_label,
                                   const char* bracket_label, int64_t fusion,
                                   double cyc, double score) {
   if (!log_) return;
-  fprintf(log_, "%s,%.1f,%.3f,%d,%d,%d,%d,%d,%d,%d,%d,%s,%s,%s,%s,%.3f\n",
+  fprintf(log_, "%s,%.1f,%.3f,%d,%d,%d,%d,%d,%d,%d,%d,%d,%s,%s,%s,%s,%.3f\n",
           sample_label, fusion / 1024.0, cyc,
           ArmValue(cur_arm_, kDimCache) ? 1 : 0,
           ArmValue(cur_arm_, kDimHier) ? 1 : 0,
@@ -523,7 +526,8 @@ void ParameterManager::EmitCsvRow(const char* sample_label,
           ArmValue(cur_arm_, kDimShm) ? 1 : 0,
           ArmValue(cur_arm_, kDimBucket) ? 1 : 0,
           ArmValue(cur_arm_, kDimCompress) ? 1 : 0,
-          ArmValue(cur_arm_, kDimWire) ? 1 : 0, affinity_.c_str(),
+          ArmValue(cur_arm_, kDimWire) ? 1 : 0,
+          ArmValue(cur_arm_, kDimAlltoall) ? 1 : 0, affinity_.c_str(),
           pipe_schedule().c_str(), bracket_label, ProfileLabel(),
           score / 1e6);
   fflush(log_);
@@ -547,7 +551,7 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
                               double* cycle_ms, int* cache_on, int* hier_on,
                               int* zerocopy_on, int* pipeline_on,
                               int* shm_on, int* bucket_on, int* compress_on,
-                              int* wire_on) {
+                              int* wire_on, int* alltoall_on) {
   if (!active()) return false;
   if (bytes <= 0 && acc_cycles_ == 0) {
     // Idle before the window opens: keep re-stamping the start so a pause
@@ -561,7 +565,8 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
     // Adopt the first sample point (arm 0 = the job's initial categorical
     // config, numeric point = warmup[0]) right away.
     FillOutputs(fusion, cycle_ms, cache_on, hier_on, zerocopy_on,
-                pipeline_on, shm_on, bucket_on, compress_on, wire_on);
+                pipeline_on, shm_on, bucket_on, compress_on, wire_on,
+                alltoall_on);
     warmup_idx_ = 1;
     return true;
   }
@@ -597,6 +602,7 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
       *bucket_on = ArmValue(cur_arm_, kDimBucket) ? 1 : 0;
       *compress_on = ArmValue(cur_arm_, kDimCompress) ? 1 : 0;
       *wire_on = ArmValue(cur_arm_, kDimWire) ? 1 : 0;
+      *alltoall_on = ArmValue(cur_arm_, kDimAlltoall) ? 1 : 0;
       EmitCsvRow("# adopted", "-", best_fusion_, best_cycle_ms_,
                  best_score_);
       EmitCsvRow("# final", "-", best_fusion_, best_cycle_ms_, best_score_);
@@ -641,6 +647,7 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
     *bucket_on = ArmValue(cur_arm_, kDimBucket) ? 1 : 0;
     *compress_on = ArmValue(cur_arm_, kDimCompress) ? 1 : 0;
     *wire_on = ArmValue(cur_arm_, kDimWire) ? 1 : 0;
+    *alltoall_on = ArmValue(cur_arm_, kDimAlltoall) ? 1 : 0;
     WriteProfile();
     EmitCsvRow("# final", "-", best_fusion_, best_cycle_ms_, best_score_);
     return true;
@@ -729,7 +736,7 @@ bool ParameterManager::Record(int64_t bytes, int64_t now_us, int64_t* fusion,
     }
   }
   FillOutputs(fusion, cycle_ms, cache_on, hier_on, zerocopy_on, pipeline_on,
-              shm_on, bucket_on, compress_on, wire_on);
+              shm_on, bucket_on, compress_on, wire_on, alltoall_on);
   return true;
 }
 
@@ -748,17 +755,18 @@ hvd::ParameterManager* g_sim = nullptr;
 int64_t g_sim_now_us = 0;
 int64_t g_sim_fusion = 0;
 double g_sim_cycle = 0.0;
-int g_sim_cat[8] = {};
+int g_sim_cat[9] = {};
 int g_sim_arm_bits = 0;
 
 void SimRecord(int64_t bytes) {
   g_sim->Record(bytes, g_sim_now_us, &g_sim_fusion, &g_sim_cycle,
                 &g_sim_cat[0], &g_sim_cat[1], &g_sim_cat[2], &g_sim_cat[3],
-                &g_sim_cat[4], &g_sim_cat[5], &g_sim_cat[6], &g_sim_cat[7]);
+                &g_sim_cat[4], &g_sim_cat[5], &g_sim_cat[6], &g_sim_cat[7],
+                &g_sim_cat[8]);
   // Arm bits = the categorical outputs directly (sim inits are all-false,
   // dims 0..n-1 toggleable), so bit i == dim i flipped.
   g_sim_arm_bits = 0;
-  for (int i = 0; i < 8; i++)
+  for (int i = 0; i < 9; i++)
     if (g_sim_cat[i]) g_sim_arm_bits |= 1 << i;
 }
 
@@ -782,15 +790,17 @@ int hvd_autotune_sim_begin(int n_dims, int64_t max_samples, int bracket,
   c.local_size = 1;
   c.wire_tier = 0;
   c.affinity = "sim";
-  bool* init_flags[8] = {&c.init_cache,    &c.init_hier,
+  bool* init_flags[9] = {&c.init_cache,    &c.init_hier,
                          &c.init_zerocopy, &c.init_pipeline,
                          &c.init_shm,      &c.init_bucket,
-                         &c.init_compress, &c.init_wire};
-  bool* togg_flags[8] = {&c.can_toggle_cache,    &c.can_toggle_hier,
+                         &c.init_compress, &c.init_wire,
+                         &c.init_alltoall};
+  bool* togg_flags[9] = {&c.can_toggle_cache,    &c.can_toggle_hier,
                          &c.can_toggle_zerocopy, &c.can_toggle_pipeline,
                          &c.can_toggle_shm,      &c.can_toggle_bucket,
-                         &c.can_toggle_compress, &c.can_toggle_wire};
-  for (int i = 0; i < 8; i++) {
+                         &c.can_toggle_compress, &c.can_toggle_wire,
+                         &c.can_toggle_alltoall};
+  for (int i = 0; i < 9; i++) {
     *init_flags[i] = false;
     *togg_flags[i] = i < n_dims;
   }

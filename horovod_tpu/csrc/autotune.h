@@ -8,9 +8,9 @@
 // random candidates instead of gradient ascent.
 //
 // v2 search (docs/autotune.md "v2 search"): the categorical space is up to
-// 2^8 = 256 arms (cache x hier x zerocopy x pipeline x shm x bucket x
-// compress x wire), far past what one window per arm can afford. Instead of
-// enumerating it, the search runs three phases:
+// 2^9 = 512 arms (cache x hier x zerocopy x pipeline x shm x bucket x
+// compress x wire x alltoall), far past what one window per arm can afford.
+// Instead of enumerating it, the search runs three phases:
 //
 //   1. probe  — d+1 windows: the job's initial config (arm 0), then each
 //               toggleable dim flipped alone. Every dim is guaranteed to be
@@ -61,6 +61,7 @@ enum AutotuneDim {
   kDimBucket,
   kDimCompress,
   kDimWire,
+  kDimAlltoall,
   kNumAutotuneDims,
 };
 
@@ -80,11 +81,12 @@ struct AutotuneConfig {
   int bracket = 0;          // HVD_AUTOTUNE_BRACKET; <=0: derive (<=16)
   bool init_cache = true, init_hier = false, init_zerocopy = true,
        init_pipeline = true, init_shm = true, init_bucket = false,
-       init_compress = false, init_wire = false;
+       init_compress = false, init_wire = false, init_alltoall = false;
   bool can_toggle_cache = false, can_toggle_hier = false,
        can_toggle_zerocopy = false, can_toggle_pipeline = false,
        can_toggle_shm = false, can_toggle_bucket = false,
-       can_toggle_compress = false, can_toggle_wire = false;
+       can_toggle_compress = false, can_toggle_wire = false,
+       can_toggle_alltoall = false;
   // Workload-signature topology fields (profile key).
   int64_t world = 1;
   int64_t local_size = 1;
@@ -129,7 +131,8 @@ class ParameterManager {
   bool Record(int64_t bytes, int64_t now_us, int64_t* fusion,
               double* cycle_ms, int* cache_on, int* hier_on,
               int* zerocopy_on, int* pipeline_on, int* shm_on,
-              int* bucket_on, int* compress_on, int* wire_on);
+              int* bucket_on, int* compress_on, int* wire_on,
+              int* alltoall_on);
 
   int64_t best_fusion() const { return best_fusion_; }
   double best_cycle_ms() const { return best_cycle_ms_; }
@@ -182,7 +185,7 @@ class ParameterManager {
   void FillOutputs(int64_t* fusion, double* cycle_ms, int* cache_on,
                    int* hier_on, int* zerocopy_on, int* pipeline_on,
                    int* shm_on, int* bucket_on, int* compress_on,
-                   int* wire_on) const;
+                   int* wire_on, int* alltoall_on) const;
   const char* BracketLabel() const;
   const char* ProfileLabel() const;
 
@@ -203,8 +206,8 @@ class ParameterManager {
   int64_t n_samples_ = 0;  // probe + halving + numeric windows scored
 
   // The lattice, bit i of an arm index <-> toggleable dim dim_id_[i].
-  // kMaxArms bounds 2^dim_count_ (8 dims -> 256).
-  static constexpr int kMaxArms = 256;
+  // kMaxArms bounds 2^dim_count_ (9 dims -> 512).
+  static constexpr int kMaxArms = 512;
   int dim_count_ = 0;               // toggleable dims (d)
   int dim_id_[kNumAutotuneDims];    // bit index -> AutotuneDim
   bool init_val_[kNumAutotuneDims]; // initial value per AutotuneDim

@@ -1163,15 +1163,58 @@ void DataPlane::AlltoAllv(const void* send,
   int my = IndexOf(members, rank_);
   const uint8_t* s = (const uint8_t*)send;
   uint8_t* o = (uint8_t*)out;
-  // Self chunk.
-  if (send_bytes[my] > 0) memcpy(o + roff[my], s + soff[my], (size_t)send_bytes[my]);
+  stat_alltoall_ops++;
+  stat_alltoall_bytes += soff[m] - send_bytes[my];
+  // Self chunk never touches a tier.
+  if (send_bytes[my] > 0)
+    memcpy(o + roff[my], s + soff[my], (size_t)send_bytes[my]);
+  if (m <= 1) return;
+  // Intra-host tier: the whole pairwise schedule rides the shm rings —
+  // each step's payload is a pointer handoff through the peer's mapped
+  // slot, the consume callback lands bytes straight in the packed output
+  // (same shape as the RingAllgatherv shm branch).
+  if (alltoall_tiered_ && UseShm(members, soff[m] + roff[m])) {
+    stat_alltoall_shm++;
+    int64_t t0 = MonoUs();
+    for (int k = 1; k < m; k++) {
+      int to_idx = (my + k) % m;
+      int from_idx = (my - k + m) % m;
+      uint8_t* dst = o + roff[from_idx];
+      bool ok = shm_.Exchange(
+          members[to_idx], s + soff[to_idx], send_bytes[to_idx],
+          members[from_idx], recv_bytes[from_idx], poll_timeout_ms_,
+          [&](const uint8_t* ptr, int64_t len, int64_t boff) {
+            memcpy(dst + boff, ptr, (size_t)len);
+          });
+      if (!ok) throw std::runtime_error("shm alltoallv exchange failed");
+    }
+    stat_shm_us += MonoUs() - t0;
+    return;
+  }
   // Pairwise exchange with increasing offset.
   for (int k = 1; k < m; k++) {
     int to_idx = (my + k) % m;
     int from_idx = (my - k + m) % m;
-    FullDuplex(peer(members[to_idx]), s + soff[to_idx],
-               (size_t)send_bytes[to_idx], peer(members[from_idx]),
-               o + roff[from_idx], (size_t)recv_bytes[from_idx]);
+    size_t sn = (size_t)send_bytes[to_idx];
+    size_t rn = (size_t)recv_bytes[from_idx];
+    // SG linked-wave rung: at or above the scatter-gather threshold the
+    // step goes straight to UringDuplex with a block-streamed receive —
+    // rblock > 0 plus the single contiguous receive iovec engage
+    // chain_mode, so the whole step is chained MSG_WAITALL waves with the
+    // short-completion repair, not the per-round poll/readv dance.
+    if (alltoall_tiered_ && UringReady() &&
+        (int64_t)(sn + rn) >= zc_threshold_) {
+      stat_alltoall_sg++;
+      std::vector<iovec> sv, rv;
+      if (sn > 0) sv.push_back({(void*)(s + soff[to_idx]), sn});
+      if (rn > 0) rv.push_back({o + roff[from_idx], rn});
+      size_t rblock = rn > 0 ? StreamBlockBytes(rn, 1) : 0;
+      UringDuplex(peer(members[to_idx]), sv, peer(members[from_idx]), rv,
+                  rblock, {});
+      continue;
+    }
+    FullDuplex(peer(members[to_idx]), s + soff[to_idx], sn,
+               peer(members[from_idx]), o + roff[from_idx], rn);
   }
 }
 

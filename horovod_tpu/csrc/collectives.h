@@ -120,6 +120,23 @@ class DataPlane {
   int64_t stat_shm_fallback = 0;  // covered by the plane, but routed to TCP
   int64_t stat_shm_us = 0;        // µs inside shm exchange phases
 
+  // Alltoall proof counters (same background-thread-only contract;
+  // core.cc's PipelineScope folds deltas into Global BEFORE
+  // CompleteHandle). ops/bytes count every AlltoAllv; shm_ops counts the
+  // calls the intra-host tier swallowed whole; sg_rounds counts the
+  // pairwise steps that rode the SG linked-wave uring path.
+  int64_t stat_alltoall_ops = 0;
+  int64_t stat_alltoall_bytes = 0;   // non-self payload bytes sent
+  int64_t stat_alltoall_shm = 0;
+  int64_t stat_alltoall_sg = 0;
+
+  // Alltoall tiering (HVD_ALLTOALL / the autotune alltoall arm): when off,
+  // AlltoAllv pins the legacy basic pairwise FullDuplex schedule — no shm
+  // routing, no SG linked waves — so the arm's "off" state is the honest
+  // pre-tiering baseline. Stateless flip, same contract as set_wire_tier.
+  void set_alltoall_tiered(bool on) { alltoall_tiered_ = on; }
+  bool alltoall_tiered() const { return alltoall_tiered_; }
+
   // In-place ring allreduce over `members` (sorted global ranks incl. self).
   // buf holds nelem elements of dtype; op applied elementwise.
   void RingAllreduce(void* buf, int64_t nelem, DataType dtype, ReduceOp op,
@@ -162,9 +179,13 @@ class DataPlane {
   void Broadcast(void* buf, int64_t nbytes, int root_idx,
                  const std::vector<int32_t>& members);
 
-  // Pairwise alltoallv: send_bytes[j] bytes from send buffer (packed in member
-  // order) to member j; receive recv_bytes[j] from member j into out (packed
-  // in member order).
+  // Tiered pairwise alltoallv: send_bytes[j] bytes from send buffer (packed
+  // in member order) to member j; receive recv_bytes[j] from member j into
+  // out (packed in member order). With tiering on (the default), same-host
+  // member sets ride the shm plane (pointer handoff into the packed
+  // output) and pairwise steps at or above the zero-copy threshold ride
+  // the uring tier as chained MSG_WAITALL linked waves; everything else —
+  // and tiering off — is the basic pairwise FullDuplex schedule.
   void AlltoAllv(const void* send, const std::vector<int64_t>& send_bytes,
                  void* out, const std::vector<int64_t>& recv_bytes,
                  const std::vector<int32_t>& members);
@@ -270,6 +291,7 @@ class DataPlane {
   bool shm_enabled_ = false;
   int64_t shm_threshold_ = 0;
   int wire_tier_ = wire::kBasic;
+  bool alltoall_tiered_ = true;
   int64_t zc_threshold_ = 16384;
   wire::Uring uring_;
   std::vector<uint8_t> scratch_;

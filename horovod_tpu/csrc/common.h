@@ -385,6 +385,10 @@ struct ResponseList {
   // autotuner only explores it where the tier probe succeeded, so "off"
   // means the legacy sendmsg path, never an unsupported tier.
   int8_t tuned_wire = -1;
+  // Alltoall-tier arm (1 = tiered host-plane alltoallv: shm + SG linked
+  // waves, 0 = basic pairwise): only explored where a tier exists (shm
+  // plane active or wire above basic), so "on" always changes behavior.
+  int8_t tuned_alltoall = -1;
   bool tuned_locked = false;  // coordinator's search finished
   // Rank the coordinator evicted this cycle (-1 = none). Survivors abort
   // in-flight work with a retriable RankEvictedError instead of hanging in
@@ -408,6 +412,7 @@ struct ResponseList {
     w.u8((uint8_t)(tuned_bucket + 1));
     w.u8((uint8_t)(tuned_compress + 1));
     w.u8((uint8_t)(tuned_wire + 1));
+    w.u8((uint8_t)(tuned_alltoall + 1));
     w.u8(tuned_locked ? 1 : 0);
     w.i32(evicted_rank);
   }
@@ -431,6 +436,7 @@ struct ResponseList {
     l.tuned_bucket = (int8_t)r.u8() - 1;
     l.tuned_compress = (int8_t)r.u8() - 1;
     l.tuned_wire = (int8_t)r.u8() - 1;
+    l.tuned_alltoall = (int8_t)r.u8() - 1;
     l.tuned_locked = r.u8() != 0;
     l.evicted_rank = r.i32();
     return l;

@@ -250,6 +250,36 @@ struct Global {
   std::atomic<int64_t> compress_residual_norm_micro{0};
   std::atomic<int64_t> compress_residual_buckets{0};
 
+  // Tiered alltoall (docs/perf_tuning.md "Expert parallelism & alltoall").
+  // alltoall_tier_allowed is the HVD_ALLTOALL master switch (basic kills
+  // the shm/SG tiers AND the autotune alltoall arm); alltoall_on is the
+  // autotune arm's live toggle (rides ResponseList.tuned_alltoall, adopted
+  // on the same cycle by every rank). alltoall_compress is the
+  // HVD_ALLTOALL_COMPRESS opt-in: when set AND compress_live is int8,
+  // Enqueue stamps compress onto kAlltoall requests and the negotiation
+  // (all-members-agree, op-agnostic in BuildResponse) picks the
+  // int8_alltoallv backend. Counters snapshot DataPlane's background-
+  // thread-only stat_alltoall_* members (PipelineScope, under the
+  // counters-before-CompleteHandle rule), readable from user threads via
+  // hvd_alltoall_stats.
+  bool alltoall_tier_allowed = true;
+  bool alltoall_on = true;
+  std::atomic<bool> alltoall_compress{false};
+  std::atomic<int64_t> alltoall_ops_total{0};
+  std::atomic<int64_t> alltoall_bytes_total{0};
+  std::atomic<int64_t> alltoall_shm_total{0};
+  std::atomic<int64_t> alltoall_sg_total{0};
+
+  // Expert-parallel capacity-factor routing gauges, published from Python
+  // (expert_parallel.py) via hvd_ep_report after each dispatch: how many
+  // tokens the router saw and how many were dropped by the capacity clamp.
+  // last_dropped_micro is the most recent dropped fraction in 1e-6 units
+  // (atomic-int encoding of a gauge, same trick as residual_norm).
+  std::atomic<int64_t> ep_reports_total{0};
+  std::atomic<int64_t> ep_tokens_total{0};
+  std::atomic<int64_t> ep_dropped_tokens_total{0};
+  std::atomic<int64_t> ep_dropped_micro{0};
+
   // Elastic churn: per-peer liveness on the control plane. peer_timeout_ms
   // (HVD_PEER_TIMEOUT_MS) bounds rank 0's per-cycle RequestList gather;
   // 0 (the default) keeps the legacy unbounded gather — byte-identical
@@ -614,6 +644,7 @@ struct PipelineScope {
   int64_t shm_ops0, shm_bytes0, shm_staged0, shm_fb0, shm_us0;
   int64_t w_ops0, w_sys0, u_sub0, u_sqe0, u_cqe0, u_us0;
   int64_t zc_send0, zc_comp0, zc_cop0, zc_us0;
+  int64_t a2a_ops0, a2a_bytes0, a2a_shm0, a2a_sg0;
   PipelineScope()
       : steps0(g->data.stat_stream_steps),
         blocks0(g->data.stat_stream_blocks),
@@ -633,7 +664,11 @@ struct PipelineScope {
         zc_send0(g->data.stat_zc_sends),
         zc_comp0(g->data.stat_zc_completions),
         zc_cop0(g->data.stat_zc_copied),
-        zc_us0(g->data.stat_zc_us) {}
+        zc_us0(g->data.stat_zc_us),
+        a2a_ops0(g->data.stat_alltoall_ops),
+        a2a_bytes0(g->data.stat_alltoall_bytes),
+        a2a_shm0(g->data.stat_alltoall_shm),
+        a2a_sg0(g->data.stat_alltoall_sg) {}
   int64_t overlap_us() const { return g->data.stat_overlap_us - us0; }
   int64_t shm_us() const { return g->data.stat_shm_us - shm_us0; }
   // Sizes for the wire-plane timeline sub-spans: µs this op spent inside
@@ -661,6 +696,10 @@ struct PipelineScope {
     g->zc_completions_total += g->data.stat_zc_completions - zc_comp0;
     g->zc_copied_total += g->data.stat_zc_copied - zc_cop0;
     g->zc_us_total += zc_us();
+    g->alltoall_ops_total += g->data.stat_alltoall_ops - a2a_ops0;
+    g->alltoall_bytes_total += g->data.stat_alltoall_bytes - a2a_bytes0;
+    g->alltoall_shm_total += g->data.stat_alltoall_shm - a2a_shm0;
+    g->alltoall_sg_total += g->data.stat_alltoall_sg - a2a_sg0;
   }
 };
 
@@ -902,10 +941,153 @@ void ExecAlltoall(const Response& resp, TensorTableEntry& e,
   hs->out_buf.resize((size_t)(recv_rows * row_elems) * esz);
   hs->out_meta.resize(m);
   for (size_t j = 0; j < m; j++) hs->out_meta[j] = matrix[j * m + my_idx];
+  PipelineScope ps;
   int64_t t0 = NowUs();
   g->data.AlltoAllv(e.input, send_bytes, hs->out_buf.data(), recv_bytes,
                     members);
   g->timeline.Record(e.req.name, "TCP_ALLTOALL", t0, NowUs());
+  if (ps.shm_us() > 0)
+    g->timeline.Record(e.req.name, "TCP_ALLTOALL_SHM", t0, t0 + ps.shm_us());
+  if (ps.uring_us() > 0)
+    g->timeline.Record(e.req.name, "TCP_ALLTOALL_SG", t0,
+                       t0 + ps.uring_us());
+  ps.Publish();
+  CompleteHandle(e.handle, Status::Ok());
+}
+
+// Pool-parallel symmetric int8 helpers for the compressed alltoall. Same
+// scale/round/clamp convention as QuantizeI8 but lossy (no residual):
+// expert activations are routed, not accumulated, so there is no next
+// step for an error term to re-enter. maxabs reduces across lanes via the
+// non-negative-float-bits-order-as-u32 trick.
+float PoolQuantizeI8(const float* x, int64_t n, int8_t* q) {
+  std::atomic<uint32_t> maxbits{0};
+  GlobalReducePool().Run(n, sizeof(float), [&](int64_t b, int64_t e2) {
+    float local = 0.0f;
+    for (int64_t i = b; i < e2; i++) local = std::max(local, fabsf(x[i]));
+    uint32_t lb;
+    memcpy(&lb, &local, 4);
+    uint32_t cur = maxbits.load(std::memory_order_relaxed);
+    while (lb > cur && !maxbits.compare_exchange_weak(
+                           cur, lb, std::memory_order_relaxed)) {
+    }
+  });
+  uint32_t mb = maxbits.load(std::memory_order_relaxed);
+  float maxabs;
+  memcpy(&maxabs, &mb, 4);
+  float scale = maxabs > 0.0f ? maxabs / 127.0f : 1.0f;
+  float inv = 1.0f / scale;
+  GlobalReducePool().Run(n, sizeof(float), [&](int64_t b, int64_t e2) {
+    for (int64_t i = b; i < e2; i++) {
+      long v = lrintf(x[i] * inv);
+      if (v > 127) v = 127;
+      if (v < -127) v = -127;
+      q[i] = (int8_t)v;
+    }
+  });
+  return scale;
+}
+
+void PoolDequantizeI8(const int8_t* q, int64_t n, float scale, float* out) {
+  GlobalReducePool().Run(n, sizeof(float), [&](int64_t b, int64_t e2) {
+    for (int64_t i = b; i < e2; i++) out[i] = scale * (float)q[i];
+  });
+}
+
+// int8 expert dispatch: the pairwise alltoallv with every per-peer payload
+// quantized to int8 plus one f32 scale per peer chunk — ~1/4 the wire
+// bytes of the f32 exchange. A Response carries compress only when EVERY
+// member stamped it (HVD_ALLTOALL_COMPRESS while the int8 codec is live),
+// so all ranks build the same wire-chunk geometry from the same matrix.
+// The self chunk is quantized too — lossy uniformly, so a token's payload
+// doesn't change precision depending on which expert it routed to.
+void ExecAlltoallInt8(const Response& resp, TensorTableEntry& e,
+                      const std::vector<int64_t>& matrix,
+                      const std::vector<int32_t>& members) {
+  size_t m = members.size();
+  int my_idx = -1;
+  for (size_t i = 0; i < m; i++)
+    if (members[i] == g->rank) my_idx = (int)i;
+  int64_t row_elems = 1;
+  for (size_t i = 1; i < e.req.shape.size(); i++) row_elems *= e.req.shape[i];
+  // Wire chunk to/from peer j = 4-byte f32 scale + int8[rows_j*row_elems]
+  // (the scale header rides even on empty chunks — constant geometry).
+  std::vector<int64_t> send_elems(m), recv_elems(m);
+  std::vector<int64_t> send_bytes(m), recv_bytes(m);
+  int64_t recv_rows = 0;
+  for (size_t j = 0; j < m; j++) {
+    send_elems[j] = matrix[my_idx * m + j] * row_elems;
+    recv_elems[j] = matrix[j * m + my_idx] * row_elems;
+    send_bytes[j] = 4 + send_elems[j];
+    recv_bytes[j] = 4 + recv_elems[j];
+    recv_rows += matrix[j * m + my_idx];
+  }
+  auto soff = [&](size_t j) {
+    int64_t o = 0;
+    for (size_t i = 0; i < j; i++) o += send_bytes[i];
+    return o;
+  };
+  auto roff = [&](size_t j) {
+    int64_t o = 0;
+    for (size_t i = 0; i < j; i++) o += recv_bytes[i];
+    return o;
+  };
+  std::vector<uint8_t> pack((size_t)soff(m));
+  std::vector<uint8_t> stage((size_t)roff(m));
+
+  auto hs = GetHandle(e.handle);
+  hs->out_shape = e.req.shape;
+  if (hs->out_shape.empty()) hs->out_shape = {0};
+  hs->out_shape[0] = recv_rows;
+  hs->dtype = resp.dtype;
+  hs->out_buf.resize((size_t)(recv_rows * row_elems) * sizeof(float));
+  hs->out_meta.resize(m);
+  for (size_t j = 0; j < m; j++) hs->out_meta[j] = matrix[j * m + my_idx];
+
+  const float* x = (const float*)e.input;
+  int64_t t0 = NowUs();
+  int64_t raw = 0, wire = 0, in_off = 0;
+  for (size_t j = 0; j < m; j++) {
+    uint8_t* w = pack.data() + soff(j);
+    float scale = PoolQuantizeI8(x + in_off, send_elems[j], (int8_t*)(w + 4));
+    memcpy(w, &scale, 4);
+    in_off += send_elems[j];
+    if ((int)j != my_idx) {
+      raw += 4 * send_elems[j];
+      wire += send_bytes[j];
+    }
+  }
+  int64_t t1 = NowUs();
+  PipelineScope ps;
+  g->data.AlltoAllv(pack.data(), send_bytes, stage.data(), recv_bytes,
+                    members);
+  int64_t t2 = NowUs();
+  float* out = (float*)hs->out_buf.data();
+  int64_t out_off = 0;
+  for (size_t j = 0; j < m; j++) {
+    const uint8_t* w = stage.data() + roff(j);
+    float scale;
+    memcpy(&scale, w, 4);
+    PoolDequantizeI8((const int8_t*)(w + 4), recv_elems[j], scale,
+                     out + out_off);
+    out_off += recv_elems[j];
+  }
+  int64_t t3 = NowUs();
+
+  // Counters before CompleteHandle, same rule as Int8RingKernel.
+  g->compress_int8_ops++;
+  g->compress_raw_bytes += raw;
+  g->compress_wire_bytes += wire;
+  g->timeline.Record(e.req.name, "TCP_ALLTOALL_QUANTIZE", t0, t1);
+  g->timeline.Record(e.req.name, "TCP_ALLTOALL_EXCHANGE", t1, t2);
+  if (ps.shm_us() > 0)
+    g->timeline.Record(e.req.name, "TCP_ALLTOALL_SHM", t1, t1 + ps.shm_us());
+  if (ps.uring_us() > 0)
+    g->timeline.Record(e.req.name, "TCP_ALLTOALL_SG", t1,
+                       t1 + ps.uring_us());
+  g->timeline.Record(e.req.name, "TCP_ALLTOALL_DEQUANT", t2, t3);
+  g->timeline.Record(e.req.name, "TCP_ALLTOALL", t0, t3);
+  ps.Publish();
   CompleteHandle(e.handle, Status::Ok());
 }
 
@@ -1017,6 +1199,20 @@ void RegisterBackends(OperationManager& om) {
       OpType::kBroadcast, "binomial_broadcast", nullptr,
       [](const Response& r, std::vector<TensorTableEntry>& e,
          const std::vector<int32_t>& m) { ExecBroadcast(r, e[0], m); });
+  // Compressed expert dispatch outranks the plain pairwise exchange under
+  // the same all-members-agree contract as the compressed allreduce
+  // codecs: the Response carries compress == 1 only when every member
+  // stamped it, so the same replica picks the same backend everywhere.
+  om.Register(
+      OpType::kAlltoall, "int8_alltoallv",
+      [](const Response& r, const std::vector<int32_t>& m) {
+        return r.compress == 1 && m.size() > 1 &&
+               r.dtype == DataType::kFloat32;
+      },
+      [](const Response& r, std::vector<TensorTableEntry>& e,
+         const std::vector<int32_t>& m) {
+        ExecAlltoallInt8(r, e[0], r.per_rank_meta[0], m);
+      });
   om.Register(
       OpType::kAlltoall, "pairwise_alltoallv", nullptr,
       [](const Response& r, std::vector<TensorTableEntry>& e,
@@ -1249,10 +1445,11 @@ void AutotuneCycle(ResponseList& rl) {
     int64_t fusion;
     double cycle_ms;
     int cache_on, hier_on, zerocopy_on, pipeline_on, shm_on, bucket_on,
-        compress_on, wire_on;
+        compress_on, wire_on, alltoall_on;
     if (g->autotune.Record(PayloadBytes(rl), NowUs(), &fusion, &cycle_ms,
                            &cache_on, &hier_on, &zerocopy_on, &pipeline_on,
-                           &shm_on, &bucket_on, &compress_on, &wire_on)) {
+                           &shm_on, &bucket_on, &compress_on, &wire_on,
+                           &alltoall_on)) {
       rl.tuned_fusion = fusion;
       rl.tuned_cycle_ms = cycle_ms;
       rl.tuned_cache = (int8_t)cache_on;
@@ -1263,6 +1460,7 @@ void AutotuneCycle(ResponseList& rl) {
       rl.tuned_bucket = (int8_t)bucket_on;
       rl.tuned_compress = (int8_t)compress_on;
       rl.tuned_wire = (int8_t)wire_on;
+      rl.tuned_alltoall = (int8_t)alltoall_on;
     }
   }
   rl.tuned_locked = !g->autotune.active();
@@ -1316,6 +1514,13 @@ void ProcessResponseList(ResponseList& rl) {
   if (rl.tuned_wire >= 0 && g->wire_tier > wire::kBasic) {
     g->wire_on = rl.tuned_wire != 0;
     g->data.set_wire_tier(g->wire_on ? g->wire_tier : wire::kBasic);
+  }
+  // The alltoall arm flips the tiered (shm/SG) exchange against the basic
+  // pairwise loop. Stateless like the wire arm: shm segments stay mapped
+  // and the uring ring stays set up, only AlltoAllv's dispatch changes.
+  if (rl.tuned_alltoall >= 0 && g->alltoall_tier_allowed) {
+    g->alltoall_on = rl.tuned_alltoall != 0;
+    g->data.set_alltoall_tiered(g->alltoall_on);
   }
   if (rl.tuned_locked && g->autotune.enabled()) g->autotune.SetDone();
   if (CacheOn()) {
@@ -1889,6 +2094,15 @@ int Enqueue(OpType type, const char* name, const void* input, void* output,
       e.req.topk_frac =
           (double)g->topk_frac_micro.load(std::memory_order_relaxed) / 1e6;
   }
+  // Compressed expert dispatch is a separate opt-in (HVD_ALLTOALL_COMPRESS
+  // — activations tolerate a lossy wire differently than error-fed
+  // gradients do) and only the int8 codec applies: top-k sparsification
+  // has no meaning for routed rows. Same all-members-agree negotiation —
+  // a rank caught mid-flip just runs one uncompressed exchange.
+  if (live == 1 && type == OpType::kAlltoall &&
+      g->alltoall_compress.load(std::memory_order_relaxed) &&
+      (DataType)dtype == DataType::kFloat32)
+    e.req.compress = 1;
   if (shape && ndim > 0) e.req.shape.assign(shape, shape + ndim);
   if (splits && nsplits > 0) e.req.splits.assign(splits, splits + nsplits);
   e.input = input;
@@ -2039,6 +2253,25 @@ int hvd_init() {
       int64_t numa_env = EnvInt("HVD_NUMA", -1);
       g->numa_pin = numa_env < 0 ? numa::NodeCount() > 1 : numa_env != 0;
     }
+    // Tiered alltoall: HVD_ALLTOALL=basic pins the pairwise FullDuplex
+    // exchange (kill switch — also drops the autotune alltoall arm);
+    // "auto" (the default) lets AlltoAllv route same-host peer pairs
+    // through the shm plane and large cross-host pairs through SG
+    // io_uring linked waves. HVD_ALLTOALL_COMPRESS=1 opts expert
+    // dispatch into the int8 codec — engages only while HVD_COMPRESS=int8
+    // is live, so the wire stays byte-identical otherwise.
+    {
+      std::string a2a = EnvStr("HVD_ALLTOALL", "auto");
+      if (a2a == "basic" || a2a == "0")
+        g->alltoall_tier_allowed = false;
+      else if (a2a != "auto" && a2a != "1" && !a2a.empty())
+        LogF(LogLevel::kWarn,
+             "HVD_ALLTOALL=%s unknown (want auto|basic); using auto",
+             a2a.c_str());
+      g->alltoall_on = g->alltoall_tier_allowed;
+      g->data.set_alltoall_tiered(g->alltoall_tier_allowed);
+      g->alltoall_compress = EnvInt("HVD_ALLTOALL_COMPRESS", 0) != 0;
+    }
     GlobalReducePool().Configure(g->reduce_threads, g->numa_pin);
     // Reduce-kernel tier: HVD_REDUCE_VECTOR=0 pins the scalar baseline
     // (the bench's A/B switch); default is the vectorized tier.
@@ -2089,6 +2322,7 @@ int hvd_init() {
       at.init_bucket = g->queue.bucket_enabled();
       at.init_compress = g->compress_live.load() != 0;
       at.init_wire = g->wire_tier > wire::kBasic;
+      at.init_alltoall = g->data.alltoall_tiered();
       at.can_toggle_cache = g->cache.enabled();
       // On a single host the hierarchical arm only pays off when the
       // local phase actually rides shm — without the plane it degrades
@@ -2115,6 +2349,14 @@ int hvd_init() {
       // basic — on kernels where the probe failed (or HVD_WIRE=basic)
       // both arm settings would measure the identical sendmsg path.
       at.can_toggle_wire = g->wire_tier > wire::kBasic && g->size > 1;
+      // The alltoall arm exists only where a faster tier can actually
+      // engage — same-host peers on the shm plane or an above-basic wire
+      // for the SG waves; otherwise both arm settings would measure the
+      // identical pairwise FullDuplex path. HVD_ALLTOALL=basic is the
+      // operator opting out.
+      at.can_toggle_alltoall =
+          g->alltoall_tier_allowed && g->size > 1 &&
+          (g->data.shm().active() || g->wire_tier > wire::kBasic);
       // Workload-signature topology key (profile match ladder).
       at.world = g->size;
       at.local_size = g->local_size;
@@ -2574,6 +2816,64 @@ int hvd_shm_state(int64_t* threshold) {
   if (!g || !g->initialized) return -1;
   if (threshold) *threshold = g->data.shm_threshold();
   return g->data.shm().active() && g->data.shm_enabled() ? 1 : 0;
+}
+
+// Alltoall observability: exchanges run, non-self payload bytes sent,
+// ops whose whole exchange rode the shm plane, and pairwise rounds that
+// took the SG io_uring linked-wave path. Tier adoption proof for the
+// acceptance tests: shm_ops/sg_rounds stay 0 with HVD_ALLTOALL=basic.
+int hvd_alltoall_stats(int64_t* ops, int64_t* bytes, int64_t* shm_ops,
+                       int64_t* sg_rounds) {
+  if (!g || !g->initialized) return -1;
+  if (ops) *ops = g->alltoall_ops_total.load(std::memory_order_relaxed);
+  if (bytes) *bytes = g->alltoall_bytes_total.load(std::memory_order_relaxed);
+  if (shm_ops)
+    *shm_ops = g->alltoall_shm_total.load(std::memory_order_relaxed);
+  if (sg_rounds)
+    *sg_rounds = g->alltoall_sg_total.load(std::memory_order_relaxed);
+  return 0;
+}
+
+// Current alltoall state: returns -1 uninitialized, 0 when pinned to the
+// basic pairwise exchange (HVD_ALLTOALL=basic or the autotune arm), 1
+// when the shm/SG tiers are live; *compress_opt_in gets the
+// HVD_ALLTOALL_COMPRESS flag (whether kAlltoall requests stamp the int8
+// codec while it is live).
+int hvd_alltoall_state(int64_t* compress_opt_in) {
+  if (!g || !g->initialized) return -1;
+  if (compress_opt_in)
+    *compress_opt_in = g->alltoall_compress.load() ? 1 : 0;
+  return g->alltoall_tier_allowed && g->data.alltoall_tiered() ? 1 : 0;
+}
+
+// Expert-parallel capacity-factor gauge feed: the Python router reports
+// each dispatch's token count and capacity-clamp drops here so the EP_*
+// gauges (and the timeline consumers reading them) see routing pressure
+// without a host round-trip per token. dropped_fraction is recorded in
+// 1e-6 units, same atomic-gauge encoding as the compress residual norm.
+int hvd_ep_report(double dropped_fraction, int64_t tokens,
+                  int64_t dropped_tokens) {
+  if (!g || !g->initialized) return -1;
+  if (tokens < 0 || dropped_tokens < 0 || dropped_tokens > tokens)
+    return -2;
+  g->ep_reports_total++;
+  g->ep_tokens_total += tokens;
+  g->ep_dropped_tokens_total += dropped_tokens;
+  g->ep_dropped_micro = (int64_t)llround(dropped_fraction * 1e6);
+  return 0;
+}
+
+int hvd_ep_stats(int64_t* reports, int64_t* tokens, int64_t* dropped_tokens,
+                 int64_t* last_dropped_micro) {
+  if (!g || !g->initialized) return -1;
+  if (reports) *reports = g->ep_reports_total.load(std::memory_order_relaxed);
+  if (tokens) *tokens = g->ep_tokens_total.load(std::memory_order_relaxed);
+  if (dropped_tokens)
+    *dropped_tokens =
+        g->ep_dropped_tokens_total.load(std::memory_order_relaxed);
+  if (last_dropped_micro)
+    *last_dropped_micro = g->ep_dropped_micro.load(std::memory_order_relaxed);
+  return 0;
 }
 
 // Bucket-assembler observability: buckets launched complete, buckets

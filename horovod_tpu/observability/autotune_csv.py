@@ -26,6 +26,7 @@ COLUMNS = (
     "bucket",
     "compress",
     "wire",
+    "alltoall",
     "affinity",
     "schedule",
     "bracket",
@@ -39,7 +40,7 @@ HEADER = ",".join(COLUMNS)
 # order. Every entry has a tuned_<dim> ResponseList field, an init_<dim> /
 # can_toggle_<dim> AutotuneConfig field, and a <dim>_stats() surface —
 # cross-checked by tools/hvdlint.py check_arm_stats.
-ARM_COLUMNS = COLUMNS[COLUMNS.index("cache"):COLUMNS.index("wire") + 1]
+ARM_COLUMNS = COLUMNS[COLUMNS.index("cache"):COLUMNS.index("alltoall") + 1]
 
 # Values the `profile` column (and autotune_stats()["profile"]) can take:
 # "-" = HVD_AUTOTUNE_PROFILE_DIR unset, then the adoption ladder.

@@ -472,6 +472,36 @@ WIRE_ZC_SENDS = gauge(
 WIRE_PINNED_LANES = gauge(
     "hvd_wire_pinned_lanes",
     "Reduce-pool lanes NUMA-pinned under HVD_NUMA")
+ALLTOALL_OPS = gauge(
+    "hvd_alltoall_ops",
+    "Host-plane alltoallv exchanges completed (tiered routing — "
+    "docs/perf_tuning.md §Expert parallelism & alltoall)")
+ALLTOALL_BYTES = gauge(
+    "hvd_alltoall_bytes",
+    "Non-self payload bytes alltoallvs moved between peers")
+ALLTOALL_SHM_OPS = gauge(
+    "hvd_alltoall_shm_ops",
+    "Alltoallv exchanges whose whole pairwise schedule rode the "
+    "intra-host shm plane (0 under HVD_ALLTOALL=basic)")
+ALLTOALL_SG_ROUNDS = gauge(
+    "hvd_alltoall_sg_rounds",
+    "Pairwise alltoallv rounds that took the SG io_uring linked-wave "
+    "path (send+recv above HVD_ZEROCOPY_THRESHOLD on the uring tier)")
+EP_REPORTS = gauge(
+    "hvd_ep_reports",
+    "Expert-dispatch balance reports published to the core gauge plane "
+    "(moe_dispatch_combine via hvd.ep_report)")
+EP_TOKENS = gauge(
+    "hvd_ep_tokens",
+    "Tokens routed through reported expert dispatches")
+EP_DROPPED = gauge(
+    "hvd_ep_dropped",
+    "Tokens dropped by capacity-factor overflow across reported "
+    "dispatches (raise HVD_EP_CAPACITY_FACTOR if this grows)")
+EP_LAST_FRACTION = gauge(
+    "hvd_ep_last_fraction",
+    "Most recent reported max-expert load fraction (1/experts = "
+    "perfectly balanced router)")
 AUTOTUNE_SAMPLES = gauge(
     "hvd_autotune_samples",
     "Measured tuning windows the v2 search has consumed so far (0 at "
@@ -590,7 +620,8 @@ CKPT_LAST_COMMITTED_STEP = gauge(
 
 def sample_core_stats(hvd=None):
     """Snapshot the core's ring-pipeline, shm-plane, reduce-pool,
-    reduce-kernel, and wire-plane counters into the gauge families above. Call after
+    reduce-kernel, wire-plane, alltoall-tier, and expert-dispatch
+    counters into the gauge families above. Call after
     synchronize() (or any quiesce point); cheap, so callers may sample per
     step. `hvd` defaults to the horovod_tpu package (parameter for
     tests)."""
@@ -624,6 +655,16 @@ def sample_core_stats(hvd=None):
     live, _, _, _, pinned = hvd.wire_state()
     WIRE_TIER.set({"basic": 0, "zerocopy": 1, "uring": 2}[live])
     WIRE_PINNED_LANES.set(pinned)
+    a_ops, a_bytes, a_shm, a_sg = hvd.alltoall_stats()
+    ALLTOALL_OPS.set(a_ops)
+    ALLTOALL_BYTES.set(a_bytes)
+    ALLTOALL_SHM_OPS.set(a_shm)
+    ALLTOALL_SG_ROUNDS.set(a_sg)
+    ep_reports, ep_tokens, ep_dropped, ep_frac = hvd.ep_stats()
+    EP_REPORTS.set(ep_reports)
+    EP_TOKENS.set(ep_tokens)
+    EP_DROPPED.set(ep_dropped)
+    EP_LAST_FRACTION.set(ep_frac)
     ats = hvd.autotune_stats()
     AUTOTUNE_SAMPLES.set(ats["samples"])
     AUTOTUNE_BUDGET.set(ats["budget"])

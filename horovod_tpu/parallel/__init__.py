@@ -18,9 +18,11 @@ from .ring_attention import (  # noqa: F401
 )
 from .ulysses import make_ulysses_attention, ulysses_attention  # noqa: F401
 from .expert_parallel import (  # noqa: F401
+    env_capacity_factor,
     make_moe_layer,
     moe_dispatch_combine,
     moe_dispatch_combine_ragged,
+    report_dispatch,
 )
 from .pipeline import (  # noqa: F401
     make_pipeline_train_step,

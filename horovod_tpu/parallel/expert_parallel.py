@@ -17,9 +17,39 @@ Use inside shard_map over the expert axis:
   the shard's local expert weights.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+def env_capacity_factor(default=1.25):
+    """Router capacity factor from HVD_EP_CAPACITY_FACTOR (default 1.25,
+    the standard Switch setting): per-expert queue slots = T * factor / E.
+    Raising it trades buffer memory/wire bytes for fewer dropped tokens —
+    the EP_* gauges (ep_stats) show where the current setting lands."""
+    try:
+        return float(os.environ.get("HVD_EP_CAPACITY_FACTOR", default))
+    except (TypeError, ValueError):
+        return default
+
+
+def report_dispatch(dropped_fraction, tokens, dropped_tokens=None):
+    """Publish one dispatch's capacity-clamp outcome to the core EP_*
+    gauges (hvd_ep_report -> ep_stats). No-op (returns False) when the
+    core is not initialized — pure-XLA runs have no gauge plane."""
+    tokens = int(tokens)
+    frac = float(dropped_fraction)
+    if dropped_tokens is None:
+        dropped_tokens = int(round(frac * tokens))
+    dropped_tokens = max(0, min(int(dropped_tokens), tokens))
+    try:
+        import horovod_tpu as _hvd
+        _hvd.ep_report(frac, tokens, dropped_tokens)
+        return True
+    except (ValueError, ImportError):
+        return False
 
 
 def moe_dispatch_combine(x, logits, expert_fn, axis, capacity_factor=1.25,
@@ -173,8 +203,8 @@ def moe_dispatch_combine_ragged(x, logits, expert_fn, axis,
     return y.astype(x.dtype), aux
 
 
-def make_moe_layer(mesh, axis, w_in, w_out, capacity_factor=1.25,
-                   ragged=False):
+def make_moe_layer(mesh, axis, w_in, w_out, capacity_factor=None,
+                   ragged=False, report=True):
     """Convenience: build a jitted MoE FFN over `mesh`.
 
     w_in: [E, D, F], w_out: [E, F, D] — sharded on dim0 over `axis`.
@@ -182,13 +212,18 @@ def make_moe_layer(mesh, axis, w_in, w_out, capacity_factor=1.25,
     token count (flatten any batch/sequence dims into T first; T must be
     divisible by the axis size). ``ragged=True`` dispatches through
     :func:`moe_dispatch_combine_ragged` (alltoallv-style wire format)
-    instead of the dense fixed-slot exchange.
+    instead of the dense fixed-slot exchange. ``capacity_factor=None``
+    resolves HVD_EP_CAPACITY_FACTOR (default 1.25); ``report=True``
+    publishes each dispatch's dropped-token fraction to the core EP_*
+    gauges via :func:`report_dispatch`.
     """
     import functools
 
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    if capacity_factor is None:
+        capacity_factor = env_capacity_factor()
     dispatch = moe_dispatch_combine_ragged if ragged \
         else moe_dispatch_combine
     espec = P(axis, None, None)
@@ -197,7 +232,7 @@ def make_moe_layer(mesh, axis, w_in, w_out, capacity_factor=1.25,
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(P(axis, None), P(axis, None), espec, espec),
-        out_specs=P(axis, None), check_vma=False)
+        out_specs=(P(axis, None), P()), check_vma=False)
     def fn(x, logits, w_in_l, w_out_l):
         def expert_fn(buf):  # [E_loc, N, D]
             h = jnp.einsum("end,edf->enf", buf.astype(jnp.float32),
@@ -206,8 +241,14 @@ def make_moe_layer(mesh, axis, w_in, w_out, capacity_factor=1.25,
             return jnp.einsum("enf,efd->end", h,
                               w_out_l.astype(jnp.float32)).astype(buf.dtype)
 
-        out, _ = dispatch(x, logits, expert_fn, axis,
-                          capacity_factor=capacity_factor)
+        out, aux = dispatch(x, logits, expert_fn, axis,
+                            capacity_factor=capacity_factor)
+        return out, aux["dropped_fraction"]
+
+    def run(x, logits):
+        out, dropped = fn(x, logits, w_in, w_out)
+        if report:
+            report_dispatch(float(dropped), x.shape[0])
         return out
 
-    return lambda x, logits: fn(x, logits, w_in, w_out)
+    return run

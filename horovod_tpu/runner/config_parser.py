@@ -60,6 +60,10 @@ ARG_TO_ENV = {
     "reduce_threads": ("HVD_REDUCE_THREADS", lambda v: str(int(v))),
     "compression": ("HVD_COMPRESS", str),
     "topk_frac": ("HVD_COMPRESS_TOPK_FRAC", lambda v: str(float(v))),
+    "alltoall": ("HVD_ALLTOALL", str),
+    "alltoall_compress": ("HVD_ALLTOALL_COMPRESS", lambda v: str(int(v))),
+    "ep_capacity_factor": ("HVD_EP_CAPACITY_FACTOR",
+                           lambda v: str(float(v))),
     "pipeline_schedule": ("HVD_PIPE_SCHEDULE", str),
     "wire": ("HVD_WIRE", str),
     "wire_zc_threshold": ("HVD_WIRE_ZC_THRESHOLD", lambda v: str(int(v))),
@@ -116,6 +120,9 @@ _FILE_SECTIONS = {
                "reduce-threads": "reduce_threads",
                "compression": "compression",
                "topk-frac": "topk_frac",
+               "alltoall": "alltoall",
+               "alltoall-compress": "alltoall_compress",
+               "ep-capacity-factor": "ep_capacity_factor",
                "pipeline-schedule": "pipeline_schedule",
                "wire": "wire",
                "wire-zc-threshold": "wire_zc_threshold",

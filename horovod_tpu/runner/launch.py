@@ -100,6 +100,30 @@ def parse_args(argv=None):
                         "(0, 1] (HVD_COMPRESS_TOPK_FRAC, default 0.01): "
                         "wire bytes scale with k = max(1, round(frac*n)) "
                         "per rank; only meaningful with --compression topk")
+    p.add_argument("--alltoall", dest="alltoall",
+                   choices=["auto", "basic"], default=None,
+                   help="alltoallv routing (HVD_ALLTOALL): auto (the "
+                        "default) rides the intra-host shm plane for "
+                        "same-host members and the io_uring SG linked-wave "
+                        "path for pairwise chunks above the zero-copy "
+                        "threshold; basic is the kill switch — pairwise "
+                        "full-duplex TCP only, both tier counters stay 0")
+    p.add_argument("--alltoall-compress", dest="alltoall_compress",
+                   type=int, choices=[0, 1], default=None,
+                   help="int8 expert-dispatch wire for f32 alltoallv "
+                        "(HVD_ALLTOALL_COMPRESS): 1 ships each per-peer "
+                        "chunk as a 4-byte f32 scale + int8 payload "
+                        "(>= 3.5x fewer wire bytes) when the int8 codec "
+                        "is live (--compression int8); inert without it. "
+                        "0 (the default) keeps alltoallv bit-exact")
+    p.add_argument("--ep-capacity-factor", dest="ep_capacity_factor",
+                   type=float, default=None,
+                   help="expert-parallel router capacity factor "
+                        "(HVD_EP_CAPACITY_FACTOR, default 1.25): "
+                        "per-expert buffer slots = factor * tokens / "
+                        "experts for moe_dispatch_combine when no "
+                        "explicit capacity is passed; overflow tokens "
+                        "are dropped and counted in hvd.ep_stats()")
     p.add_argument("--pipeline-schedule", dest="pipeline_schedule",
                    default=None,
                    help="pipeline-parallel microbatch schedule for the "

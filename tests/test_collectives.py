@@ -119,8 +119,11 @@ def test_autotune_schedule_column(tmp_path):
         "HVD_WIRE": "basic",
         "EXPECT_DIMS": "1",
     }, timeout=240)
+    from horovod_tpu.observability.autotune_csv import COLUMNS
+
+    sched_col = COLUMNS.index("schedule")
     rows = [l for l in log.read_text().splitlines()[1:] if l]
-    assert all(l.split(",")[12] == "interleaved2" for l in rows), rows[:3]
+    assert all(l.split(",")[sched_col] == "interleaved2" for l in rows), rows[:3]
 
 
 def test_autotune_beats_defaults_32rank(tmp_path):

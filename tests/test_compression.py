@@ -187,18 +187,19 @@ def test_autotune_compress_arm(tmp_path):
     assert {l.split(",")[9] for l in rows} == {"0", "1"}, rows
 
 
-def test_arm_space_is_two_to_the_eighth():
-    """kMaxArms covers the full 2^8 categorical space: eight toggleable
+def test_arm_space_is_two_to_the_ninth():
+    """kMaxArms covers the full 2^9 categorical space: nine toggleable
     dimensions (cache, hier, zerocopy, pipeline, shm, bucket, compress,
-    wire — ISSUE 12) need 256 arm slots. v2 (ISSUE 18) replaces the
-    exhaustive Configure nest with a bit-lattice the bandit searches:
-    every dim must be an AutotuneDim enum bit with init_/can_toggle_
-    config fields, and the lattice size must be 2^dims."""
+    wire — ISSUE 12 — plus alltoall tiering, ISSUE 19) need 512 arm
+    slots. v2 (ISSUE 18) replaces the exhaustive Configure nest with a
+    bit-lattice the bandit searches: every dim must be an AutotuneDim
+    enum bit with init_/can_toggle_ config fields, and the lattice size
+    must be 2^dims."""
     src = open(os.path.join(_CSRC, "autotune.h")).read()
     m = re.search(r"kMaxArms\s*=\s*(\d+)", src)
-    assert m and int(m.group(1)) == 256, m
+    assert m and int(m.group(1)) == 512, m
     for dim in ("cache", "hier", "zerocopy", "pipeline", "shm", "bucket",
-                "compress", "wire"):
+                "compress", "wire", "alltoall"):
         assert re.search(r"kDim%s\b" % dim.capitalize(), src), dim
         assert re.search(r"\binit_%s\b" % dim, src), dim
         assert re.search(r"\bcan_toggle_%s\b" % dim, src), dim
@@ -206,7 +207,7 @@ def test_arm_space_is_two_to_the_eighth():
     assert re.search(r"arm_count_\s*=\s*1\s*<<\s*dim_count_", cc)
     # ...and the shared CSV schema carries one column per dim.
     from horovod_tpu.observability import autotune_csv
-    assert len(autotune_csv.ARM_COLUMNS) == 8, autotune_csv.ARM_COLUMNS
+    assert len(autotune_csv.ARM_COLUMNS) == 9, autotune_csv.ARM_COLUMNS
 
 
 # --- sanitizer tiers --------------------------------------------------------

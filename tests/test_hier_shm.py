@@ -118,10 +118,11 @@ def test_autotune_shm_arm(tmp_path):
         "HVD_BUCKET": "0",
         # wire arm pinned off: covered by test_wire.py::test_autotune_wire_arm
         "HVD_WIRE": "basic",
-        "EXPECT_DIMS": "3",
+        # shm active => the alltoall tier arm (ISSUE 19) joins the sweep.
+        "EXPECT_DIMS": "4",
     }, timeout=240)
-    # The shm column really swept both states (d+1 = 4 probe rows).
-    rows = [l for l in log.read_text().splitlines()[1:5]
+    # The shm column really swept both states (d+1 = 5 probe rows).
+    rows = [l for l in log.read_text().splitlines()[1:6]
             if not l.startswith("#")]
     assert {l.split(",")[7] for l in rows} == {"0", "1"}, rows
 

@@ -215,6 +215,57 @@ def test_ragged_alltoall_uneven_splits():
                     err_msg=f"dst={dst} src={src} slot={s}")
 
 
+def test_ragged_alltoall_overflow_truncates_cleanly():
+    """Counts EXCEEDING ``capacity`` (ISSUE 19 satellite): the sender
+    ships only the first ``capacity`` rows of an overflowing block,
+    recv_counts clamp to ``capacity`` (never point past the drop), and
+    the overflow must not corrupt adjacent (src, dst) slots — every
+    non-overflowing block still arrives byte-exact, padding stays zero."""
+    import functools
+
+    from jax import shard_map
+
+    from horovod_tpu.ops.jax_ops import ragged_alltoall
+
+    Pn, D, cap = 8, 4, 2
+    mesh = Mesh(np.asarray(jax.devices()[:Pn]), ("x",))
+    # Counts 0..4 against cap=2: pairs with (i + 2j) % 5 > 2 overflow.
+    counts = np.array([[(i + 2 * j) % 5 for j in range(Pn)]
+                      for i in range(Pn)], np.int32)
+    assert (counts > cap).any() and (counts <= cap).any()
+
+    @jax.jit
+    @functools.partial(shard_map, mesh=mesh, in_specs=(),
+                       out_specs=(P("x", None, None, None), P("x", None)),
+                       check_vma=False)
+    def go():
+        i = jax.lax.axis_index("x")
+        my_counts = jnp.asarray(counts)[i]                       # [P]
+        starts = jnp.cumsum(my_counts) - my_counts
+        T = int(counts.sum(1).max())
+        row = jnp.arange(T, dtype=jnp.int32)
+        dst = jnp.sum((row[:, None] >= (starts + my_counts)[None, :])
+                      .astype(jnp.int32), axis=1)
+        slot = row - starts[dst]
+        x = (i * 10000 + dst * 100 + slot).astype(jnp.float32)[:, None] \
+            * jnp.ones((1, D), jnp.float32)
+        recv, rcounts = ragged_alltoall(x, my_counts, "x", cap)
+        return recv[None], rcounts[None]
+
+    recv, rcounts = go()
+    recv, rcounts = np.asarray(recv), np.asarray(rcounts)
+    for dst in range(Pn):
+        for src in range(Pn):
+            n = min(int(counts[src, dst]), cap)
+            # clamp contract: counts never exceed the slots that exist
+            assert rcounts[dst, src] == n, (dst, src, rcounts[dst])
+            for s in range(cap):
+                expect = (src * 10000 + dst * 100 + s) if s < n else 0.0
+                np.testing.assert_allclose(
+                    recv[dst, src, s], expect,
+                    err_msg=f"dst={dst} src={src} slot={s}")
+
+
 def _ragged_moe_fn(mesh, axis, **kw):
     """Jitted sharded ragged-MoE layer taking (x, logits, w_in, w_out) as
     traced arguments — usable both for forward parity and for

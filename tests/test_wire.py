@@ -175,11 +175,13 @@ def test_autotune_wire_arm(tmp_path):
     log = tmp_path / "autotune_wire.csv"
     run_worker_job(2, "autotune_worker.py", timeout=240,
                    extra_env=dict(_AUTOTUNE_ENV, HVD_AUTOTUNE_LOG=str(log),
-                                  EXPECT_DIMS="2"))
-    # d+1 = 3 probe rows: baseline, cache flipped, wire flipped.
-    rows = [l for l in log.read_text().splitlines()[1:4]
+                                  EXPECT_DIMS="3"))
+    # d+1 = 4 probe rows: baseline, cache flipped, wire flipped, alltoall
+    # flipped (the ninth dim rides along once the uring tier is up).
+    rows = [l for l in log.read_text().splitlines()[1:5]
             if not l.startswith("#")]
     assert {l.split(",")[10] for l in rows} == {"0", "1"}, rows
+    assert {l.split(",")[11] for l in rows} == {"0", "1"}, rows
 
 
 def test_autotune_wire_arm_absent_when_probe_fails(tmp_path):

@@ -1,12 +1,14 @@
 """Worker: traced bridge ops must fail LOUDLY when an elastic resize
 invalidates their trace-time size hoists (VERDICT r5 #8).
 
-hvd_allgather / hvd_reducescatter hoist the process-set size (and rank)
-at TRACE time to compute static output shapes. A resize between trace
-and execution makes the compiled program's output buffer silently wrong-
-sized. Single rank: trace both ops under jit, run them once, then fake a
-resize by monkeypatching the live size query and assert the callback
-raises the staleness error instead of returning garbage.
+hvd_allgather / hvd_alltoall / hvd_reducescatter hoist the process-set
+size (and rank) at TRACE time to compute static output shapes — alltoall
+additionally derives its uniform per-peer split from the traced size, the
+same hazard the TF binding guards with its traced-world check. A resize
+between trace and execution makes the compiled program's output buffer
+silently wrong-sized. Single rank: trace the ops under jit, run them
+once, then fake a resize by monkeypatching the live size query and assert
+the callback raises the staleness error instead of returning garbage.
 """
 import jax
 import jax.numpy as jnp
@@ -30,9 +32,15 @@ def scatter(x):
     return jax_ops.hvd_reducescatter(x, op=jax_ops.Sum, name="stale.rs")
 
 
+@jax.jit
+def shuffle(x):
+    return jax_ops.hvd_alltoall(x, name="stale.a2a")
+
+
 x = jnp.arange(4, dtype=jnp.float32)
 assert np.array_equal(np.asarray(gather(x)), np.arange(4, dtype=np.float32))
 assert np.array_equal(np.asarray(scatter(x)), np.arange(4, dtype=np.float32))
+assert np.array_equal(np.asarray(shuffle(x)), np.arange(4, dtype=np.float32))
 
 # Fake the resize: the library now reports one more member than the traces
 # hoisted. CDLL instances accept python attribute overrides, so this
@@ -40,7 +48,8 @@ assert np.array_equal(np.asarray(scatter(x)), np.arange(4, dtype=np.float32))
 real_size = _core._lib.hvd_process_set_size
 _core._lib.hvd_process_set_size = lambda ps: int(real_size(int(ps))) + 1
 
-for jitted, tag in ((gather, "allgather"), (scatter, "reducescatter")):
+for jitted, tag in ((gather, "allgather"), (scatter, "reducescatter"),
+                    (shuffle, "alltoall")):
     try:
         jitted(x)
     except Exception as e:  # noqa: BLE001 — jax wraps the callback error

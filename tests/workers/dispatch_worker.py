@@ -18,7 +18,7 @@ assert hvd.op_backends(0) == [
     "hierarchical_allreduce", "ring_allreduce"]
 assert hvd.op_backends(1) == ["ring_allgatherv"]
 assert hvd.op_backends(2) == ["binomial_broadcast"]
-assert hvd.op_backends(3) == ["pairwise_alltoallv"]
+assert hvd.op_backends(3) == ["int8_alltoallv", "pairwise_alltoallv"]
 assert hvd.op_backends(4) == ["ring_reducescatter"]
 
 assert hvd.backend_uses("ring_allreduce") == 0
